@@ -1,0 +1,233 @@
+"""Spark/cudf-shaped logical types over TPU-native physical storage.
+
+Mirrors the type surface the reference's Java API exchanges across JNI
+(``ai.rapids.cudf.DType`` — see reference RowConversionJni.cpp:85 where
+``(types[], scale[])`` pairs are rebuilt into ``data_type``), but the physical
+mapping is chosen for TPU/XLA:
+
+- fixed-width types map 1:1 onto jax dtypes,
+- BOOL8 is stored as uint8 (one byte, Spark semantics: non-zero == true),
+- DECIMAL32/64 store unscaled values in int32/int64 lanes,
+- DECIMAL128 stores unscaled values as 4 x uint32 little-endian limbs
+  (shape ``[N, 4]``) because the TPU MXU/VPU has no 128-bit lanes; all
+  arithmetic is limb-based (see ops/decimal_utils.py),
+- STRING is Arrow-style: int32 offsets + uint8 character bytes,
+- LIST is offsets + child column (used for JCUDF row blobs and Z-order keys).
+
+cudf convention kept throughout: ``scale`` here is the *cudf* scale (negative
+of the Spark/SQL scale); helpers convert at the API boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeId(enum.IntEnum):
+    """Logical type ids, aligned with the surface used by the reference JNI."""
+
+    EMPTY = 0
+    INT8 = 1
+    INT16 = 2
+    INT32 = 3
+    INT64 = 4
+    UINT8 = 5
+    UINT16 = 6
+    UINT32 = 7
+    UINT64 = 8
+    FLOAT32 = 9
+    FLOAT64 = 10
+    BOOL8 = 11
+    TIMESTAMP_DAYS = 12
+    TIMESTAMP_SECONDS = 13
+    TIMESTAMP_MILLISECONDS = 14
+    TIMESTAMP_MICROSECONDS = 15
+    TIMESTAMP_NANOSECONDS = 16
+    DURATION_DAYS = 17
+    DURATION_SECONDS = 18
+    DURATION_MILLISECONDS = 19
+    DURATION_MICROSECONDS = 20
+    DURATION_NANOSECONDS = 21
+    STRING = 23
+    LIST = 24
+    DECIMAL32 = 26
+    DECIMAL64 = 27
+    DECIMAL128 = 28
+    STRUCT = 29
+
+
+# Physical element width in bytes inside a JCUDF row / Arrow buffer.
+_SIZES = {
+    TypeId.INT8: 1,
+    TypeId.INT16: 2,
+    TypeId.INT32: 4,
+    TypeId.INT64: 8,
+    TypeId.UINT8: 1,
+    TypeId.UINT16: 2,
+    TypeId.UINT32: 4,
+    TypeId.UINT64: 8,
+    TypeId.FLOAT32: 4,
+    TypeId.FLOAT64: 8,
+    TypeId.BOOL8: 1,
+    TypeId.TIMESTAMP_DAYS: 4,
+    TypeId.TIMESTAMP_SECONDS: 8,
+    TypeId.TIMESTAMP_MILLISECONDS: 8,
+    TypeId.TIMESTAMP_MICROSECONDS: 8,
+    TypeId.TIMESTAMP_NANOSECONDS: 8,
+    TypeId.DURATION_DAYS: 4,
+    TypeId.DURATION_SECONDS: 8,
+    TypeId.DURATION_MILLISECONDS: 8,
+    TypeId.DURATION_MICROSECONDS: 8,
+    TypeId.DURATION_NANOSECONDS: 8,
+    TypeId.DECIMAL32: 4,
+    TypeId.DECIMAL64: 8,
+    TypeId.DECIMAL128: 16,
+}
+
+# jax storage dtype for each fixed-width logical type.
+_JNP = {
+    TypeId.INT8: jnp.int8,
+    TypeId.INT16: jnp.int16,
+    TypeId.INT32: jnp.int32,
+    TypeId.INT64: jnp.int64,
+    TypeId.UINT8: jnp.uint8,
+    TypeId.UINT16: jnp.uint16,
+    TypeId.UINT32: jnp.uint32,
+    TypeId.UINT64: jnp.uint64,
+    TypeId.FLOAT32: jnp.float32,
+    TypeId.FLOAT64: jnp.float64,
+    TypeId.BOOL8: jnp.uint8,
+    TypeId.TIMESTAMP_DAYS: jnp.int32,
+    TypeId.TIMESTAMP_SECONDS: jnp.int64,
+    TypeId.TIMESTAMP_MILLISECONDS: jnp.int64,
+    TypeId.TIMESTAMP_MICROSECONDS: jnp.int64,
+    TypeId.TIMESTAMP_NANOSECONDS: jnp.int64,
+    TypeId.DURATION_DAYS: jnp.int32,
+    TypeId.DURATION_SECONDS: jnp.int64,
+    TypeId.DURATION_MILLISECONDS: jnp.int64,
+    TypeId.DURATION_MICROSECONDS: jnp.int64,
+    TypeId.DURATION_NANOSECONDS: jnp.int64,
+    TypeId.DECIMAL32: jnp.int32,
+    TypeId.DECIMAL64: jnp.int64,
+    # DECIMAL128 handled specially: [N, 4] uint32 limbs.
+    TypeId.DECIMAL128: jnp.uint32,
+}
+
+_INTEGRAL = frozenset(
+    {
+        TypeId.INT8,
+        TypeId.INT16,
+        TypeId.INT32,
+        TypeId.INT64,
+        TypeId.UINT8,
+        TypeId.UINT16,
+        TypeId.UINT32,
+        TypeId.UINT64,
+    }
+)
+
+_DECIMAL = frozenset({TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128})
+
+
+@dataclasses.dataclass(frozen=True)
+class DType:
+    """A logical type: id + cudf-convention scale (decimals only).
+
+    cudf scale is the negation of SQL scale: value = unscaled * 10**scale,
+    so a SQL DECIMAL(p, 2) has cudf scale -2.
+    """
+
+    id: TypeId
+    scale: int = 0
+
+    def __post_init__(self):
+        if self.scale != 0 and self.id not in _DECIMAL:
+            raise ValueError(f"scale only valid for decimal types, got {self.id!r}")
+
+    @property
+    def size_bytes(self) -> int:
+        if self.id not in _SIZES:
+            raise ValueError(f"{self.id!r} has no fixed width")
+        return _SIZES[self.id]
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return self.id in _SIZES
+
+    @property
+    def is_compound(self) -> bool:
+        return self.id in (TypeId.STRING, TypeId.LIST, TypeId.STRUCT)
+
+    @property
+    def is_integral(self) -> bool:
+        return self.id in _INTEGRAL
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.id in _DECIMAL
+
+    @property
+    def is_signed(self) -> bool:
+        return self.id in _INTEGRAL and not TypeId(self.id).name.startswith("U")
+
+    @property
+    def jnp_dtype(self):
+        if self.id not in _JNP:
+            raise ValueError(f"{self.id!r} has no single jax storage dtype")
+        return _JNP[self.id]
+
+    @property
+    def np_dtype(self):
+        return np.dtype(self.jnp_dtype)
+
+    @property
+    def precision_cap(self) -> int:
+        """Max decimal digits representable (cudf convention)."""
+        return {TypeId.DECIMAL32: 9, TypeId.DECIMAL64: 18, TypeId.DECIMAL128: 38}[self.id]
+
+    def __repr__(self):
+        if self.id in _DECIMAL:
+            return f"DType({self.id.name}, scale={self.scale})"
+        return f"DType({self.id.name})"
+
+
+# Convenience singletons, mirroring ai.rapids.cudf.DType statics.
+INT8 = DType(TypeId.INT8)
+INT16 = DType(TypeId.INT16)
+INT32 = DType(TypeId.INT32)
+INT64 = DType(TypeId.INT64)
+UINT8 = DType(TypeId.UINT8)
+UINT16 = DType(TypeId.UINT16)
+UINT32 = DType(TypeId.UINT32)
+UINT64 = DType(TypeId.UINT64)
+FLOAT32 = DType(TypeId.FLOAT32)
+FLOAT64 = DType(TypeId.FLOAT64)
+BOOL8 = DType(TypeId.BOOL8)
+STRING = DType(TypeId.STRING)
+LIST = DType(TypeId.LIST)
+TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
+TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
+TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)
+TIMESTAMP_MICROSECONDS = DType(TypeId.TIMESTAMP_MICROSECONDS)
+TIMESTAMP_NANOSECONDS = DType(TypeId.TIMESTAMP_NANOSECONDS)
+DURATION_DAYS = DType(TypeId.DURATION_DAYS)
+DURATION_SECONDS = DType(TypeId.DURATION_SECONDS)
+DURATION_MILLISECONDS = DType(TypeId.DURATION_MILLISECONDS)
+DURATION_MICROSECONDS = DType(TypeId.DURATION_MICROSECONDS)
+DURATION_NANOSECONDS = DType(TypeId.DURATION_NANOSECONDS)
+
+
+def decimal32(scale: int) -> DType:
+    return DType(TypeId.DECIMAL32, scale)
+
+
+def decimal64(scale: int) -> DType:
+    return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    return DType(TypeId.DECIMAL128, scale)

@@ -1,0 +1,69 @@
+"""Table: an ordered collection of equal-length Columns.
+
+Analog of ``cudf::table_view`` / ``ai.rapids.cudf.Table`` (the handle type
+every reference JNI entry point receives, e.g. RowConversionJni.cpp:42).
+Registered as a pytree so whole tables pass through jit/pjit boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from .column import Column
+
+__all__ = ["Table"]
+
+
+@jax.tree_util.register_pytree_node_class
+class Table:
+    def __init__(self, columns: Sequence[Column], names: Optional[Sequence[str]] = None):
+        columns = list(columns)
+        if columns:
+            n = len(columns[0])
+            for c in columns[1:]:
+                if len(c) != n:
+                    raise ValueError("all columns in a Table must have equal length")
+        self.columns: List[Column] = columns
+        self.names = list(names) if names is not None else [f"c{i}" for i in range(len(columns))]
+
+    def tree_flatten(self):
+        return tuple(self.columns), tuple(self.names)
+
+    @classmethod
+    def tree_unflatten(cls, names, columns):
+        return cls(list(columns), list(names))
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    def column(self, i) -> Column:
+        if isinstance(i, str):
+            return self.columns[self.names.index(i)]
+        return self.columns[i]
+
+    def __getitem__(self, i) -> Column:
+        return self.column(i)
+
+    def dtypes(self):
+        return [c.dtype for c in self.columns]
+
+    def select(self, idxs) -> "Table":
+        idxs = [self.names.index(i) if isinstance(i, str) else i for i in idxs]
+        return Table([self.columns[i] for i in idxs], [self.names[i] for i in idxs])
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        return Table(self.columns + [col], self.names + [name])
+
+    def to_pydict(self) -> dict:
+        return {n: c.to_pylist() for n, c in zip(self.names, self.columns)}
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}: {c.dtype!r}" for n, c in zip(self.names, self.columns))
+        return f"Table(rows={self.num_rows}, [{cols}])"

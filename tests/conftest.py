@@ -1,0 +1,22 @@
+"""Test harness: hermetic, no-TPU-required tier the reference lacks (SURVEY §4.4).
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+(parallel/shuffle) are exercised without hardware. Set SRJT_TEST_TPU=1 to run
+the same suite against real devices.
+"""
+
+import os
+
+if os.environ.get("SRJT_TEST_TPU", "0") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

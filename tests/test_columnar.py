@@ -1,0 +1,84 @@
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import columnar as c
+from spark_rapids_jni_tpu.columnar import Column, Table
+
+
+def test_fixed_width_roundtrip():
+    vals = [1, -2, 3, None, 5]
+    col = Column.from_pylist(vals, c.INT32)
+    assert len(col) == 5
+    assert col.null_count == 1
+    assert col.to_pylist() == vals
+
+
+@pytest.mark.parametrize(
+    "dt", [c.INT8, c.INT16, c.INT32, c.INT64, c.UINT8, c.UINT64, c.FLOAT32, c.FLOAT64]
+)
+def test_all_fixed_types(dt):
+    vals = [0, 1, 2, 3]
+    col = Column.from_pylist(vals, dt)
+    assert col.to_pylist() == [0, 1, 2, 3]
+
+
+def test_bool8():
+    col = Column.from_pylist([True, False, None, True], c.BOOL8)
+    assert col.to_pylist() == [True, False, None, True]
+
+
+def test_string_roundtrip():
+    vals = ["hello", "", None, "wörld", "a" * 100]
+    col = Column.from_pylist(vals, c.STRING)
+    assert col.to_pylist() == vals
+    assert col.null_count == 1
+
+
+def test_decimal128_roundtrip():
+    vals = [0, 1, -1, (1 << 126), -(1 << 126), None, 12345678901234567890123456789]
+    col = Column.from_pylist(vals, c.decimal128(-2))
+    assert col.to_pylist() == vals
+    decs = col.to_decimal_pylist()
+    assert decs[1] == decimal.Decimal("0.01")
+    assert decs[2] == decimal.Decimal("-0.01")
+
+
+def test_decimal_from_decimal_values():
+    col = Column.from_pylist(
+        [decimal.Decimal("1.23"), decimal.Decimal("-4.56")], c.decimal64(-2)
+    )
+    assert col.to_pylist() == [123, -456]
+
+
+def test_table_basic():
+    t = Table(
+        [Column.from_pylist([1, 2], c.INT32), Column.from_pylist(["a", "b"], c.STRING)],
+        names=["x", "s"],
+    )
+    assert t.num_rows == 2
+    assert t.num_columns == 2
+    assert t["s"].to_pylist() == ["a", "b"]
+    assert t.to_pydict() == {"x": [1, 2], "s": ["a", "b"]}
+
+
+def test_table_unequal_lengths_rejected():
+    with pytest.raises(ValueError):
+        Table([Column.from_pylist([1], c.INT32), Column.from_pylist([1, 2], c.INT32)])
+
+
+def test_column_pytree():
+    import jax
+
+    col = Column.from_pylist([1, 2, None], c.INT32)
+    leaves, treedef = jax.tree_util.tree_flatten(col)
+    col2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert col2.to_pylist() == [1, 2, None]
+
+
+def test_from_numpy():
+    arr = np.arange(10, dtype=np.int64)
+    col = Column.from_numpy(arr)
+    assert col.dtype == c.INT64
+    assert col.to_pylist() == list(range(10))
