@@ -145,6 +145,9 @@ class Column:
         if tid == TypeId.BOOL8:
             host = np.array([0 if v is None else int(bool(v)) for v in values], dtype=np.uint8)
             return cls(dtype, data=jnp.asarray(host), validity=validity)
+        if tid == TypeId.FLOAT64:
+            host = np.array([0.0 if v is None else v for v in values], dtype=np.float64)
+            return cls(dtype, data=jnp.asarray(host.view(np.uint64)), validity=validity)
         host = np.array([0 if v is None else v for v in values], dtype=dtype.np_dtype)
         return cls(dtype, data=jnp.asarray(host), validity=validity)
 
@@ -154,7 +157,11 @@ class Column:
         if dtype is None:
             dtype = _infer_dtype(arr.dtype)
         v = None if validity is None else jnp.asarray(validity.astype(bool))
-        return cls(dtype, data=jnp.asarray(arr.astype(dtype.np_dtype, copy=False)), validity=v)
+        if dtype.id == TypeId.FLOAT64:
+            host = arr.astype(np.float64, copy=False).view(np.uint64)
+        else:
+            host = arr.astype(dtype.np_dtype, copy=False)
+        return cls(dtype, data=jnp.asarray(host), validity=v)
 
     @classmethod
     def strings_from_parts(cls, offsets, chars, validity=None) -> "Column":
@@ -195,6 +202,8 @@ class Column:
         host = np.asarray(self.data)
         if tid == TypeId.BOOL8:
             return [None if not valid[i] else bool(host[i]) for i in range(len(self))]
+        if tid == TypeId.FLOAT64:
+            host = host.view(np.float64)
         return [None if not valid[i] else host[i].item() for i in range(len(self))]
 
     def to_decimal_pylist(self) -> list:

@@ -99,7 +99,13 @@ _JNP = {
     TypeId.UINT32: jnp.uint32,
     TypeId.UINT64: jnp.uint64,
     TypeId.FLOAT32: jnp.float32,
-    TypeId.FLOAT64: jnp.float64,
+    # FLOAT64 stores IEEE-754 *bits* in uint64 lanes: TPU v5e has no f64
+    # datapath (XLA's x64 rewrite demotes f64 buffers and compute to f32,
+    # losing bits even on transfer), while u64 is emulated exactly as u32
+    # pairs. Byte movement (JCUDF rows, shuffle) therefore stays bit-exact;
+    # arithmetic decodes via ops/bitutils.float_view (exact f64 on CPU,
+    # documented f32 approximation on TPU).
+    TypeId.FLOAT64: jnp.uint64,
     TypeId.BOOL8: jnp.uint8,
     TypeId.TIMESTAMP_DAYS: jnp.int32,
     TypeId.TIMESTAMP_SECONDS: jnp.int64,
@@ -169,6 +175,10 @@ class DType:
     @property
     def is_decimal(self) -> bool:
         return self.id in _DECIMAL
+
+    @property
+    def is_floating(self) -> bool:
+        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64)
 
     @property
     def is_signed(self) -> bool:

@@ -1,0 +1,9 @@
+"""Device ops: the TPU-native kernel tier.
+
+Each module replaces one CUDA kernel family from the reference
+(`src/main/cpp/src/*.cu`), re-designed for XLA/TPU: static shapes,
+vectorized byte arithmetic instead of warp-level byte addressing, and
+host code only for metadata (batching, layout).
+"""
+
+from . import row_conversion  # noqa: F401

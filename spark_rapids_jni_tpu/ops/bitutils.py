@@ -1,0 +1,192 @@
+"""Byte/bit reinterpretation helpers, TPU-safe.
+
+TPU v5e has no 64-bit float datapath: XLA's x64-rewrite emulates s64/u64
+exactly as u32 pairs but demotes f64 to f32 (lossy, even for plain
+transfers). The framework therefore stores FLOAT64 columns as IEEE-754
+bit patterns in uint64 lanes (columnar/dtype.py) and this module is the
+single place that moves between bits and arithmetic values:
+
+- ``to_le_bytes`` / ``from_le_bytes``: little-endian byte views for the
+  JCUDF transcode and hashing tiers (pure integer bitcasts — supported
+  on TPU for every integer width).
+- ``float_view``: bits -> floating values for compute ops. Exact f64 on
+  backends with a native f64 datapath (CPU tier); documented f32
+  approximation on TPU.
+- ``float_store``: floating compute results -> FLOAT64 bit storage.
+- ``total_order_key``: IEEE-754 total-order transform so sorts and
+  comparisons on FLOAT64 stay *exact* on TPU (no precision loss — the
+  classic radix-sort bit flip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..columnar.dtype import DType, TypeId
+
+__all__ = [
+    "to_le_bytes",
+    "from_le_bytes",
+    "float_view",
+    "float_store",
+    "total_order_key",
+    "backend_has_f64",
+]
+
+
+def backend_has_f64() -> bool:
+    """True when the default backend computes real float64 (CPU tier)."""
+    return jax.default_backend() == "cpu"
+
+
+def to_le_bytes(data: jnp.ndarray, d: DType) -> jnp.ndarray:
+    """[N] typed storage array -> [N, size] uint8 little-endian bytes."""
+    if d.size_bytes == 1:
+        return lax.bitcast_convert_type(data, jnp.uint8).reshape(-1, 1)
+    return lax.bitcast_convert_type(data, jnp.uint8)
+
+
+def from_le_bytes(bytes_: jnp.ndarray, d: DType) -> jnp.ndarray:
+    """[N, size] uint8 -> [N] typed storage array (inverse of to_le_bytes)."""
+    if d.size_bytes == 1:
+        return lax.bitcast_convert_type(bytes_[:, 0], d.jnp_dtype)
+    return lax.bitcast_convert_type(bytes_, d.jnp_dtype)
+
+
+# ---------------------------------------------------------------------------
+# FLOAT64 bits <-> arithmetic values
+# ---------------------------------------------------------------------------
+
+
+def _f64_bits_to_f32(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint64 IEEE-754 double bits -> float32 values, round-to-nearest-even.
+
+    Pure integer construction of the f32 bit pattern (u32 bitcast is
+    TPU-supported); handles overflow->inf, underflow->0, nan, inf.
+    Subnormal f32 results flush to zero (they are below 1e-38; Spark
+    doubles in that range are astronomically rare and TPU VPUs flush
+    subnormals anyway).
+    """
+    sign32 = (bits >> jnp.uint64(32)).astype(jnp.uint32) & jnp.uint32(0x80000000)
+    exp = ((bits >> jnp.uint64(52)) & jnp.uint64(0x7FF)).astype(jnp.int32)
+    frac = bits & jnp.uint64((1 << 52) - 1)
+
+    # round the 52-bit fraction to 23 bits (nearest even on the 29 dropped bits)
+    keep = (frac >> jnp.uint64(29)).astype(jnp.uint32)
+    dropped = frac & jnp.uint64((1 << 29) - 1)
+    half = jnp.uint64(1 << 28)
+    round_up = (dropped > half) | ((dropped == half) & ((keep & jnp.uint32(1)) == 1))
+    keep = keep + round_up.astype(jnp.uint32)
+    exp = exp + (keep >> jnp.uint32(23)).astype(jnp.int32)  # mantissa carry
+    keep = keep & jnp.uint32((1 << 23) - 1)
+
+    new_exp = exp - 1023 + 127
+    is_nan = (exp == 0x7FF) & (frac != 0)
+    is_inf = (exp == 0x7FF) & (frac == 0)
+    overflow = new_exp >= 0xFF
+    underflow = new_exp <= 0
+    is_zero = (exp == 0)  # f64 zeros/subnormals all flush below f32 range
+
+    out = sign32 | (jnp.clip(new_exp, 1, 0xFE).astype(jnp.uint32) << jnp.uint32(23)) | keep
+    out = jnp.where(underflow | is_zero, sign32, out)
+    out = jnp.where(overflow | is_inf, sign32 | jnp.uint32(0x7F800000), out)
+    out = jnp.where(is_nan, sign32 | jnp.uint32(0x7FC00000), out)
+    return lax.bitcast_convert_type(out, jnp.float32)
+
+
+def _f32_to_f64_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """float32 values -> uint64 IEEE-754 double bits (exact widening)."""
+    b = lax.bitcast_convert_type(x, jnp.uint32).astype(jnp.uint64)
+    sign = (b & jnp.uint64(0x80000000)) << jnp.uint64(32)
+    exp = ((b >> jnp.uint64(23)) & jnp.uint64(0xFF)).astype(jnp.int64)
+    frac = b & jnp.uint64((1 << 23) - 1)
+
+    # normals: rebias 127 -> 1023; widen fraction 23 -> 52 bits
+    norm = ((exp - 127 + 1023).astype(jnp.uint64) << jnp.uint64(52)) | (frac << jnp.uint64(29))
+    # f32 subnormals: frac * 2^-149; normalize into f64 (which has headroom)
+    nz = frac != 0
+    # position of the highest set bit of frac (frac < 2^23)
+    hi = jnp.int64(22) - _clz23(frac)
+    sub_exp = (hi - 23 + 1 - 126 + 1023).astype(jnp.uint64)
+    sub_frac = (frac << (jnp.uint64(52 - 23) + (jnp.int64(22) - hi).astype(jnp.uint64))) & jnp.uint64(
+        (1 << 52) - 1
+    )
+    subnormal = jnp.where(nz, (sub_exp << jnp.uint64(52)) | sub_frac, jnp.uint64(0))
+
+    out = jnp.where(exp == 0, subnormal, norm)
+    out = jnp.where(exp == 0xFF, (jnp.uint64(0x7FF) << jnp.uint64(52)) | (frac << jnp.uint64(29)), out)
+    return sign | out
+
+
+def _clz23(frac: jnp.ndarray) -> jnp.ndarray:
+    """count leading zeros within the low 23 bits (input uint64, frac != 0)."""
+    f = frac.astype(jnp.uint32)
+    n = jnp.zeros(f.shape, jnp.int64)
+    for shift in (16, 8, 4, 2, 1):
+        mask = f < (jnp.uint32(1) << jnp.uint32(23 - shift))
+        n = jnp.where(mask, n + shift, n)
+        f = jnp.where(mask, f << jnp.uint32(shift), f)
+    return n
+
+
+def float_view(data: jnp.ndarray, d: DType) -> jnp.ndarray:
+    """Column storage -> floating array for arithmetic.
+
+    FLOAT64: exact f64 on CPU backends; f32 approximation on TPU.
+    """
+    if d.id == TypeId.FLOAT64:
+        if backend_has_f64():
+            return lax.bitcast_convert_type(data, jnp.float64)
+        return _f64_bits_to_f32(data)
+    if d.id == TypeId.FLOAT32:
+        return data
+    raise ValueError(f"float_view on non-floating dtype {d!r}")
+
+
+def float_store(values: jnp.ndarray, d: DType) -> jnp.ndarray:
+    """Floating compute result -> column storage array."""
+    if d.id == TypeId.FLOAT64:
+        if values.dtype == jnp.float64 and backend_has_f64():
+            return lax.bitcast_convert_type(values, jnp.uint64)
+        return _f32_to_f64_bits(values.astype(jnp.float32))
+    if d.id == TypeId.FLOAT32:
+        return values.astype(jnp.float32)
+    raise ValueError(f"float_store on non-floating dtype {d!r}")
+
+
+def total_order_key(data: jnp.ndarray, d: DType) -> jnp.ndarray:
+    """Monotone integer sort key for any fixed-width column (exact).
+
+    Floats use the IEEE-754 total-order transform on raw bits, so FLOAT64
+    ordering is exact even on TPU where f64 arithmetic is approximated.
+    Signed ints flip the sign bit into unsigned order.
+    """
+    if d.id == TypeId.FLOAT64:
+        bits = data  # already uint64 bit storage
+        sign_all = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        neg = (bits >> jnp.uint64(63)) == 1
+        return jnp.where(neg, bits ^ sign_all, bits | jnp.uint64(1 << 63))
+    if d.id == TypeId.FLOAT32:
+        bits = lax.bitcast_convert_type(data, jnp.uint32)
+        neg = (bits >> jnp.uint32(31)) == 1
+        return jnp.where(neg, bits ^ jnp.uint32(0xFFFFFFFF), bits | jnp.uint32(1 << 31))
+    if d.is_signed or d.id in (
+        TypeId.TIMESTAMP_DAYS,
+        TypeId.TIMESTAMP_SECONDS,
+        TypeId.TIMESTAMP_MILLISECONDS,
+        TypeId.TIMESTAMP_MICROSECONDS,
+        TypeId.TIMESTAMP_NANOSECONDS,
+        TypeId.DURATION_DAYS,
+        TypeId.DURATION_SECONDS,
+        TypeId.DURATION_MILLISECONDS,
+        TypeId.DURATION_MICROSECONDS,
+        TypeId.DURATION_NANOSECONDS,
+        TypeId.DECIMAL32,
+        TypeId.DECIMAL64,
+    ):
+        udt = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[d.size_bytes]
+        bits = lax.bitcast_convert_type(data, udt)
+        return bits ^ (udt(1) << udt(8 * d.size_bytes - 1))
+    return data  # unsigned ints / bool are already in order

@@ -8,10 +8,16 @@ the same suite against real devices.
 import os
 
 if os.environ.get("SRJT_TEST_TPU", "0") != "1":
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # jax is preloaded at interpreter startup in this image with
+    # JAX_PLATFORMS=axon, so the env var alone is too late — update the
+    # live config before any backend initializes.
+    os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
