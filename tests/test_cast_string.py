@@ -1,0 +1,150 @@
+"""String -> integer cast tests.
+
+Ports the golden batteries from reference src/main/cpp/tests/
+cast_string.cpp (Simple :37, Ansi :50, Overflow :107, Empty :233) and the
+JNI-level assertions of CastStringsTest.java:35-99.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401  (enables x64)
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops.cast_string import CastError, string_to_integer
+
+SIGNED = [dt.INT8, dt.INT16, dt.INT32, dt.INT64]
+UNSIGNED = [dt.UINT8, dt.UINT16, dt.UINT32, dt.UINT64]
+
+
+def run(strings, d, ansi=False, in_validity=None):
+    col = Column.from_pylist(strings, dt.STRING)
+    if in_validity is not None:
+        import jax.numpy as jnp
+
+        col = Column(dt.STRING, validity=jnp.asarray(np.array(in_validity, bool)),
+                     offsets=col.offsets, chars=col.chars)
+    return string_to_integer(col, ansi, d)
+
+
+def check(result, values, validity):
+    got = result.to_pylist()
+    expected = [v if ok else None for v, ok in zip(values, validity)]
+    assert got == expected
+
+
+ANSI_STRINGS = [
+    "", "null", "+1", "-0", "4.2",
+    "asdf", "98fe", "  00012", ".--e-37602.n", "\r\r\t\n11.12380",
+    "-.2", ".3", ".", "+1.2", "\n123\n456\n",
+    "1 2", "123", "", "1. 2", "+    7.6",
+    "  12  ", "7.6.2", "15  ", "7  2  ", " 8.2  ",
+    "3..14", "c0", "\r\r", "    ", "+\n",
+]
+ANSI_IN_VALIDITY = [0, 0] + [1] * 28
+
+
+@pytest.mark.parametrize("d", SIGNED + UNSIGNED)
+def test_simple(d):
+    check(run(["1", "0", "42"], d), [1, 0, 42], [1, 1, 1])
+
+
+@pytest.mark.parametrize("d", SIGNED)
+def test_ansi_battery_signed(d):
+    r = run(ANSI_STRINGS, d, ansi=False, in_validity=ANSI_IN_VALIDITY)
+    check(
+        r,
+        [0, 0, 1, 0, 4, 0, 0, 12, 0, 11, 0, 0, 0, 1, 0,
+         0, 123, 0, 0, 0, 12, 0, 15, 0, 8, 0, 0, 0, 0, 0],
+        [0, 0, 1, 1, 1, 0, 0, 1, 0, 1, 1, 1, 1, 1, 0,
+         0, 1, 0, 0, 0, 1, 0, 1, 0, 1, 0, 0, 0, 0, 0],
+    )
+
+
+@pytest.mark.parametrize("d", UNSIGNED)
+def test_ansi_battery_unsigned(d):
+    r = run(ANSI_STRINGS, d, ansi=False, in_validity=ANSI_IN_VALIDITY)
+    check(
+        r,
+        [0, 0, 0, 0, 4, 0, 0, 12, 0, 11, 0, 0, 0, 0, 0,
+         0, 123, 0, 0, 0, 12, 0, 15, 0, 8, 0, 0, 0, 0, 0],
+        [0, 0, 0, 0, 1, 0, 0, 1, 0, 1, 0, 1, 1, 0, 0,
+         0, 1, 0, 0, 0, 1, 0, 1, 0, 1, 0, 0, 0, 0, 0],
+    )
+
+
+@pytest.mark.parametrize("d,row,s", [(dt.INT32, 4, "4.2"), (dt.UINT32, 2, "+1")])
+def test_ansi_throws_first_error(d, row, s):
+    with pytest.raises(CastError) as ei:
+        run(ANSI_STRINGS, d, ansi=True, in_validity=ANSI_IN_VALIDITY)
+    assert ei.value.row_with_error == row
+    assert ei.value.string_with_error == s
+
+
+OVERFLOW_STRINGS = [
+    "127", "128", "-128", "-129", "255", "256", "32767", "32768", "-32768",
+    "-32769", "65525", "65536", "2147483647", "2147483648", "-2147483648",
+    "-2147483649", "4294967295", "4294967296", "-9223372036854775808",
+    "-9223372036854775809", "9223372036854775807", "9223372036854775808",
+    "18446744073709551615", "18446744073709551616",
+]
+
+OVERFLOW_EXPECTED = {
+    dt.TypeId.INT8: (
+        [127, 0, -128] + [0] * 21,
+        [1, 0, 1] + [0] * 21,
+    ),
+    dt.TypeId.UINT8: (
+        [127, 128, 0, 0, 255] + [0] * 19,
+        [1, 1, 0, 0, 1] + [0] * 19,
+    ),
+    dt.TypeId.INT16: (
+        [127, 128, -128, -129, 255, 256, 32767, 0, -32768] + [0] * 15,
+        [1, 1, 1, 1, 1, 1, 1, 0, 1] + [0] * 15,
+    ),
+    dt.TypeId.UINT16: (
+        [127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525] + [0] * 13,
+        [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1] + [0] * 13,
+    ),
+    dt.TypeId.INT32: (
+        [127, 128, -128, -129, 255, 256, 32767, 32768, -32768, -32769, 65525,
+         65536, 2147483647, 0, -2147483648] + [0] * 9,
+        [1] * 13 + [0, 1] + [0] * 9,
+    ),
+    dt.TypeId.UINT32: (
+        [127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525, 65536,
+         2147483647, 2147483648, 0, 0, 4294967295] + [0] * 7,
+        [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1] + [0] * 7,
+    ),
+    dt.TypeId.INT64: (
+        [127, 128, -128, -129, 255, 256, 32767, 32768, -32768, -32769, 65525,
+         65536, 2147483647, 2147483648, -2147483648, -2147483649, 4294967295,
+         4294967296, -9223372036854775808, 0, 9223372036854775807, 0, 0, 0],
+        [1] * 19 + [0, 1, 0, 0, 0],
+    ),
+    dt.TypeId.UINT64: (
+        [127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525, 65536,
+         2147483647, 2147483648, 0, 0, 4294967295, 4294967296, 0, 0,
+         9223372036854775807, 9223372036854775808, 18446744073709551615, 0],
+        [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 0, 0, 1, 1, 1, 0],
+    ),
+}
+
+
+@pytest.mark.parametrize("d", SIGNED + UNSIGNED)
+def test_overflow(d):
+    values, validity = OVERFLOW_EXPECTED[d.id]
+    check(run(OVERFLOW_STRINGS, d), values, validity)
+
+
+@pytest.mark.parametrize("d", [dt.INT32, dt.UINT64])
+def test_empty(d):
+    r = run([], d)
+    assert len(r) == 0
+    assert r.dtype.id == d.id
+
+
+def test_incoming_nulls_not_ansi_errors():
+    # rows that were already null must not trigger ANSI errors
+    r = run(["1", "bad", "3"], dt.INT32, ansi=True, in_validity=[1, 0, 1])
+    check(r, [1, 0, 3], [1, 0, 1])
