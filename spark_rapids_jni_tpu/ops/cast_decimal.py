@@ -1,0 +1,325 @@
+"""Spark-semantics string -> decimal cast (DECIMAL32/64/128).
+
+Behavioral parity with reference cast_string.cu:243-574:
+
+- pass 1 (validate_and_exponent :243-369): state machine over the chars
+  accepting [ws] [+-] digits ['.' digits] [eE [+-] digits] [ws], one
+  decimal point max, whitespace after exponent digits is INVALID (quirk
+  kept), empty/sign-only strings invalid; returns sign, first digit
+  index and the decimal location adjusted by the (overflow-checked)
+  exponent.
+- pass 2 (string_to_decimal_kernel :385-574): accumulate digits up to
+  precision / scale cutoff, round half-up away from zero at the cutoff
+  digit (detecting a digit-count increase from carry ripple), count
+  significant digits before the decimal, zero-pad up to the decimal
+  location and down to scale, with target-type overflow checks at every
+  multiply — rows that fail become null (non-ANSI) or raise CastError.
+
+Scale follows the cudf convention (negative = fractional digits).
+Output type by precision: <=9 DECIMAL32, <=18 DECIMAL64, else DECIMAL128
+(string_to_decimal :792-801).
+
+TPU-first shape: both passes are ``lax.scan`` state machines over the
+padded [N, L] char matrix carried as struct-of-arrays; the digit
+accumulator is a [N, 4] uint32 limb magnitude (ops/limbs.py) so one code
+path serves all three decimal widths; every counter the reference keeps
+per-thread becomes a prefix-sum/cummax over the char axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import Column
+from ..columnar.dtype import DType, TypeId, decimal32, decimal64, decimal128
+from . import limbs as L
+from .cast_string import CastError, _is_ws, _padded_chars, _validate_ansi
+
+__all__ = ["string_to_decimal"]
+
+_LIMITS = {  # (positive magnitude limit, negative magnitude limit)
+    TypeId.DECIMAL32: (2**31 - 1, 2**31),
+    TypeId.DECIMAL64: (2**63 - 1, 2**63),
+    TypeId.DECIMAL128: (2**127 - 1, 2**127),
+}
+
+# pass-1 states
+_D = 0  # reading value digits (includes just-after-dot)
+_EOS = 1  # just read e/E: exponent-or-sign
+_ES = 2  # just read exponent sign
+_E = 3  # reading exponent digits
+_W = 4  # trailing whitespace
+_X = 5  # invalid
+
+
+@partial(jax.jit, static_argnames=("max_len", "precision", "scale", "pos_limit", "neg_limit"))
+def _parse_decimal(
+    chars: jnp.ndarray,  # [N, L] uint8
+    lens: jnp.ndarray,  # [N] int32
+    in_valid: jnp.ndarray,  # [N] bool
+    max_len: int,
+    precision: int,
+    scale: int,
+    pos_limit: int,
+    neg_limit: int,
+):
+    n = chars.shape[0]
+    ws = _is_ws(chars)
+    digit = (chars >= ord("0")) & (chars <= ord("9"))
+    isdot = chars == ord(".")
+    is_e = (chars == ord("e")) | (chars == ord("E"))
+
+    # --- leading whitespace / sign ---------------------------------------
+    inb = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
+    nonws = (~ws) & inb
+    i0 = jnp.where(jnp.any(nonws, axis=1), jnp.argmax(nonws, axis=1).astype(jnp.int32), lens)
+    c0 = jnp.take_along_axis(chars, jnp.clip(i0, 0, max_len - 1)[:, None], axis=1)[:, 0]
+    has_sign = ((c0 == ord("+")) | (c0 == ord("-"))) & (i0 < lens)
+    positive = ~((c0 == ord("-")) & has_sign)
+    istart = i0 + has_sign.astype(jnp.int32)
+    valid = in_valid & (lens > 0) & (istart < lens)
+
+    # --- pass 1: validation state machine + exponent ----------------------
+    def step1(carry, j):
+        state, dot_seen, dot_rel, last_digit_abs, exp_mag, exp_pos, exp_seen, prev_digit = carry
+        c = chars[:, j]
+        active = (j >= istart) & (j < lens)
+        rel = j - istart
+        d, w, dot, e = digit[:, j], ws[:, j], isdot[:, j], is_e[:, j]
+
+        from_d = jnp.where(
+            d, _D,
+            jnp.where(
+                dot & ~dot_seen, _D,
+                jnp.where(e, _EOS, jnp.where(w & (rel != 0), _W, _X)),
+            ),
+        )
+        from_eos = jnp.where(
+            c == ord("+"), _ES,
+            jnp.where(
+                c == ord("-"), _ES,
+                jnp.where(w & (rel != 0), _W, jnp.where(d, _E, _X)),
+            ),
+        )
+        from_es_e = jnp.where(d, _E, _X)
+        from_w = jnp.where(w, _W, _X)
+        nxt = jnp.where(
+            state == _D, from_d,
+            jnp.where(
+                state == _EOS, from_eos,
+                jnp.where((state == _ES) | (state == _E), from_es_e, from_w),
+            ),
+        )
+        nxt = jnp.where(active, nxt, state)
+
+        # record first dot position (relative)
+        new_dot = active & (state == _D) & dot & ~dot_seen
+        dot_rel = jnp.where(new_dot, rel, dot_rel)
+        dot_seen = dot_seen | new_dot
+
+        # last_digit: leaving the digit run for e/ws, only when the previous
+        # char was an actual digit (cast_string.cu:344-347 last_state check)
+        leave = active & (state == _D) & prev_digit & ((nxt == _EOS) | (nxt == _W))
+        last_digit_abs = jnp.where(leave & (last_digit_abs == lens), j, last_digit_abs)
+
+        # exponent sign / digits
+        exp_pos = jnp.where(active & (state == _EOS) & (c == ord("-")), False, exp_pos)
+        consume_exp = active & ((state == _EOS) | (state == _ES) | (state == _E)) & d & (nxt == _E)
+        dig = (c - ord("0")).astype(jnp.uint64)
+        first = consume_exp & (exp_mag == 0)
+        lim = jnp.uint64(2**63 - 1)
+        ovf = (exp_mag > lim // jnp.uint64(10)) | (exp_mag * jnp.uint64(10) > lim - dig)
+        exp_new = jnp.where(first, dig, exp_mag * jnp.uint64(10) + dig)
+        bad_exp = consume_exp & ~first & ovf
+        nxt = jnp.where(bad_exp, _X, nxt)
+        exp_mag = jnp.where(consume_exp & ~bad_exp, exp_new, exp_mag)
+        exp_seen = exp_seen | consume_exp
+
+        prev_digit = jnp.where(active, d, prev_digit)
+        return (nxt, dot_seen, dot_rel, last_digit_abs, exp_mag, exp_pos, exp_seen, prev_digit), None
+
+    init1 = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), bool),
+        jnp.zeros((n,), jnp.int32),
+        lens,  # last_digit defaults to len (abs)
+        jnp.zeros((n,), jnp.uint64),
+        jnp.ones((n,), bool),
+        jnp.zeros((n,), bool),
+        jnp.zeros((n,), bool),
+    )
+    (state, dot_seen, dot_rel, last_digit_abs, exp_mag, exp_pos, _exp_seen, _pd), _ = lax.scan(
+        step1, init1, jnp.arange(max_len, dtype=jnp.int32)
+    )
+    valid = valid & (state != _X)
+
+    exp_val = jnp.where(exp_pos, exp_mag.astype(jnp.int64), -exp_mag.astype(jnp.int64))
+    dl0 = jnp.where(dot_seen, dot_rel, last_digit_abs - istart).astype(jnp.int64)
+    decimal_location = dl0 + exp_val  # pre-rounding (cast_string.cu:363-366)
+
+    # --- pass 2 precomputation (prefix counters over the char axis) -------
+    j_idx = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    after_start = (j_idx >= istart[:, None]) & inb
+    # break at first char after istart that is neither digit nor dot
+    breaker = after_start & ~digit & ~isdot
+    has_break = jnp.any(breaker, axis=1)
+    break_pos = jnp.where(has_break, jnp.argmax(breaker, axis=1).astype(jnp.int32), lens)
+
+    last_digit = decimal_location - scale  # :444
+    in_run = after_start & (j_idx < break_pos[:, None])
+    dmask = in_run & digit & (last_digit >= 0)[:, None]  # :453 loop guard
+
+    td = jnp.cumsum(dmask, axis=1).astype(jnp.int64)  # total_digits incl. current
+    nonzero = chars != ord("0")
+    sig_seed = dmask & (nonzero | (td > decimal_location[:, None]))
+    found_prior = jnp.cumsum(sig_seed, axis=1) - sig_seed.astype(jnp.int64) > 0
+    sig = dmask & (found_prior | nonzero | (td > decimal_location[:, None]))
+    np_ = jnp.cumsum(sig, axis=1).astype(jnp.int64)  # num_precise_digits incl. current
+
+    np_excl = np_ - sig.astype(jnp.int64)
+    td_excl = td - dmask.astype(jnp.int64)
+    cutoff_cond = dmask & ((np_excl + 1 > precision) | (td_excl + 1 > last_digit[:, None]))
+    has_cut = jnp.any(cutoff_cond, axis=1)
+    cut_pos = jnp.where(has_cut, jnp.argmax(cutoff_cond, axis=1).astype(jnp.int32), max_len)
+    acc_mask = dmask & (j_idx < cut_pos[:, None])
+
+    # counters at the end of accumulation (exclusive of the cutoff digit)
+    total_digits = jnp.sum(acc_mask, axis=1).astype(jnp.int64)
+    num_precise = jnp.sum(sig & acc_mask, axis=1).astype(jnp.int64)
+
+    # --- accumulate magnitude over the char axis --------------------------
+    def step2(acc, j):
+        m = acc_mask[:, j]
+        dig = (chars[:, j] - ord("0")).astype(jnp.uint32)
+        nxt = L.mul10_add(acc, jnp.where(m, dig, 0))
+        return jnp.where(m[:, None], nxt, acc), None
+
+    acc0 = jnp.zeros((n, 4), jnp.uint32)
+    acc, _ = lax.scan(step2, acc0, jnp.arange(max_len, dtype=jnp.int32))
+
+    limit = jnp.where(
+        positive[:, None],
+        jnp.asarray(L.from_ints([pos_limit], 4))[0][None, :],
+        jnp.asarray(L.from_ints([neg_limit], 4))[0][None, :],
+    )
+
+    # --- rounding at the cutoff digit (:466-506) --------------------------
+    cut_digit = jnp.take_along_axis(chars, jnp.clip(cut_pos, 0, max_len - 1)[:, None], axis=1)[
+        :, 0
+    ]
+    round_up = has_cut & ((cut_digit - ord("0")) >= 5) & (cut_digit >= ord("0")) & (
+        cut_digit <= ord("9")
+    )
+    acc_inc, carry = L.add_small(acc, jnp.where(round_up, 1, 0))
+    inc_overflow = round_up & (L.gt(acc_inc, limit) | (carry != 0))
+    valid = valid & ~inc_overflow
+    digit_added = round_up & ~L.is_zero(acc) & L.is_all_nines(acc)
+    acc = jnp.where(round_up[:, None], acc_inc, acc)
+    rounding_digits = jnp.where(digit_added, 1, 0).astype(jnp.int64)
+    total_digits = total_digits + rounding_digits
+    num_precise = num_precise + rounding_digits
+    decimal_location_r = decimal_location + rounding_digits
+
+    # --- significant digits before the decimal in the string (:411-433) ---
+    count_region = after_start & ~isdot & (
+        j_idx < jnp.where(jnp.any(after_start & is_e, axis=1),
+                          jnp.argmax(after_start & is_e, axis=1).astype(jnp.int32), lens)[:, None]
+    )
+    df = jnp.cumsum(count_region, axis=1)  # digits_found incl. current
+    counted = count_region & (df <= decimal_location[:, None])
+    started = jnp.cumsum(counted & nonzero, axis=1) > 0
+    sig_in_string = jnp.sum(counted & started, axis=1).astype(jnp.int64)
+
+    # --- zero padding to the decimal location (:527-539) ------------------
+    zeros_to_decimal = jnp.maximum(
+        0,
+        jnp.where(
+            scale > 0,
+            decimal_location_r - total_digits - scale,
+            decimal_location_r - total_digits,
+        ),
+    )
+    sig_before_decimal = sig_in_string + zeros_to_decimal + rounding_digits
+    valid = valid & ~(precision + scale < sig_before_decimal)  # :522
+
+    acc, ovf1 = _mul_pow10_checked(acc, zeros_to_decimal, limit)
+    valid = valid & ~ovf1
+    num_precise = num_precise + zeros_to_decimal
+
+    # --- zero padding down to scale (:541-556) ----------------------------
+    sig_preceding_zeros = jnp.where(decimal_location_r < 0, -decimal_location_r, 0)
+    digits_after_decimal = num_precise - sig_before_decimal + sig_preceding_zeros
+    digits_needed = jnp.minimum(precision - sig_before_decimal, -scale)
+    pad = jnp.maximum(0, digits_needed - digits_after_decimal)
+    acc, ovf2 = _mul_pow10_checked(acc, pad, limit)
+    valid = valid & ~ovf2
+
+    return acc, positive, valid
+
+
+def _mul_pow10_checked(
+    acc: jnp.ndarray, k: jnp.ndarray, limit: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """acc * 10^k with the reference's per-step overflow semantics
+    (will_overflow before each *10, cast_string.cu:528-538): equivalent to
+    checking the final product against the limit; k > 38 with acc != 0
+    always overflows (10^39 > 2^127)."""
+    p = L.pow10(k, 4)
+    prod = L.mul(acc, p, 8)
+    lo, hi = prod[..., :4], prod[..., 4:]
+    nz = ~L.is_zero(acc)
+    overflow = nz & ((k > 38) | ~L.is_zero(hi) | L.gt(lo, limit))
+    out = jnp.where((k > 0)[..., None] & ~overflow[..., None], lo, acc)
+    return out, overflow
+
+
+def string_to_decimal(col: Column, ansi_mode: bool, precision: int, scale: int) -> Column:
+    """String column -> decimal column. Parity: cast_string.cu :785-801.
+
+    ``scale`` is the cudf scale (negative = fractional digits).
+    """
+    if col.dtype.id != TypeId.STRING:
+        raise ValueError("string_to_decimal expects a STRING column")
+    if not (1 <= precision <= 38):
+        raise ValueError(f"precision must be in [1, 38], got {precision}")
+
+    if precision <= 9:
+        out_dtype = decimal32(scale)
+    elif precision <= 18:
+        out_dtype = decimal64(scale)
+    else:
+        out_dtype = decimal128(scale)
+
+    n = len(col)
+    if n == 0:
+        if out_dtype.id == TypeId.DECIMAL128:
+            return Column(out_dtype, data=jnp.zeros((0, 4), jnp.uint32))
+        return Column(out_dtype, data=jnp.zeros((0,), out_dtype.jnp_dtype))
+
+    chars, lens, max_len = _padded_chars(col)
+    pos_limit, neg_limit = _LIMITS[out_dtype.id]
+    acc, positive, valid = _parse_decimal(
+        chars, lens, col.valid_mask(), max_len, precision, scale, pos_limit, neg_limit
+    )
+
+    signed = L.to_twos_complement(acc, ~positive)
+    signed = jnp.where(valid[:, None], signed, 0)
+    if out_dtype.id == TypeId.DECIMAL128:
+        data = signed
+    elif out_dtype.id == TypeId.DECIMAL64:
+        data = (
+            signed[:, 0].astype(jnp.uint64) | (signed[:, 1].astype(jnp.uint64) << jnp.uint64(32))
+        )
+        data = lax.bitcast_convert_type(data, jnp.int64)
+    else:
+        data = lax.bitcast_convert_type(signed[:, 0], jnp.int32)
+
+    if ansi_mode:
+        _validate_ansi(valid, col)
+    return Column(out_dtype, data=data, validity=valid)

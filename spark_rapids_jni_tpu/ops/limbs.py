@@ -1,0 +1,297 @@
+"""Vectorized multi-precision integer arithmetic in uint32 limbs.
+
+TPU v5e lanes are 32-bit: there is no native int128 (and int64 itself is
+emulated as u32 pairs). The reference's ``chunked256`` (4 x u64,
+decimal_utils.cu:31-119) becomes here arrays shaped ``[..., K]`` of
+uint32 limbs, little-endian, with u64 intermediates for carries — K=4
+for 128-bit magnitudes, K=8 for 256-bit products. All ops are
+elementwise-vectorized over the leading axes and unrolled over K (K is
+a static Python int), so XLA sees straight-line vector code.
+
+Magnitude+sign representation is used by the decimal ops (matching the
+reference's approach of tracking sign separately in its division path);
+two's-complement conversion happens only at column-storage boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "from_ints",
+    "to_ints",
+    "add",
+    "add_small",
+    "sub",
+    "negate",
+    "mul10_add",
+    "mul_small",
+    "mul",
+    "gt",
+    "ge",
+    "eq",
+    "is_zero",
+    "count_digits",
+    "POW10_LIMBS",
+    "NINES_LIMBS",
+    "pow10",
+    "shift_left_bits",
+    "divmod_bits",
+    "to_twos_complement",
+    "from_twos_complement",
+]
+
+_MASK = jnp.uint64(0xFFFFFFFF)
+
+
+def from_ints(values, K: int) -> np.ndarray:
+    """Host: python ints (non-negative) -> [N, K] uint32 limbs."""
+    out = np.zeros((len(values), K), dtype=np.uint32)
+    for i, v in enumerate(values):
+        v = int(v)
+        for k in range(K):
+            out[i, k] = (v >> (32 * k)) & 0xFFFFFFFF
+    return out
+
+
+def to_ints(limbs: np.ndarray) -> list:
+    """Host: [N, K] uint32 limbs -> non-negative python ints."""
+    limbs = np.asarray(limbs)
+    out = []
+    for row in limbs:
+        v = 0
+        for k, limb in enumerate(row):
+            v |= int(limb) << (32 * k)
+        out.append(v)
+    return out
+
+
+def _u64(x) -> jnp.ndarray:
+    return x.astype(jnp.uint64)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """a + b -> (sum limbs, carry-out). Shapes [..., K]."""
+    K = a.shape[-1]
+    out = []
+    carry = jnp.zeros(a.shape[:-1], jnp.uint64)
+    for k in range(K):
+        t = _u64(a[..., k]) + _u64(b[..., k]) + carry
+        out.append((t & _MASK).astype(jnp.uint32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=-1), carry.astype(jnp.uint32)
+
+
+def add_small(a: jnp.ndarray, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """a + x (x: scalar or [...] array fitting u32)."""
+    K = a.shape[-1]
+    out = []
+    carry = jnp.asarray(x, jnp.uint64) * jnp.ones(a.shape[:-1], jnp.uint64)
+    for k in range(K):
+        t = _u64(a[..., k]) + carry
+        out.append((t & _MASK).astype(jnp.uint32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=-1), carry.astype(jnp.uint32)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """a - b -> (diff limbs, borrow-out: 1 when b > a)."""
+    K = a.shape[-1]
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], jnp.uint64)
+    for k in range(K):
+        t = _u64(a[..., k]) - _u64(b[..., k]) - borrow
+        out.append((t & _MASK).astype(jnp.uint32))
+        borrow = (t >> jnp.uint64(63)) & jnp.uint64(1)  # wrapped negative
+    return jnp.stack(out, axis=-1), borrow.astype(jnp.uint32)
+
+
+def negate(a: jnp.ndarray) -> jnp.ndarray:
+    """Two's complement negation."""
+    K = a.shape[-1]
+    inv = (~a).astype(jnp.uint32)
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    s, _ = add(inv, one)
+    return s
+
+
+def mul10_add(a: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """a * 10 + d  (d: [...] small non-negative)."""
+    K = a.shape[-1]
+    out = []
+    carry = jnp.asarray(d, jnp.uint64)
+    for k in range(K):
+        t = _u64(a[..., k]) * jnp.uint64(10) + carry
+        out.append((t & _MASK).astype(jnp.uint32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=-1)
+
+
+def mul_small(a: jnp.ndarray, m) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """a * m (m fits u32) -> (product limbs, carry-out)."""
+    K = a.shape[-1]
+    mm = jnp.asarray(m, jnp.uint64)
+    out = []
+    carry = jnp.zeros(a.shape[:-1], jnp.uint64)
+    for k in range(K):
+        t = _u64(a[..., k]) * mm + carry
+        out.append((t & _MASK).astype(jnp.uint32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=-1), carry.astype(jnp.uint32)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Schoolbook a * b -> [..., out_limbs] (like chunked256::multiply,
+    decimal_utils.cu:127-146, re-expressed in 32-bit lanes)."""
+    Ka, Kb = a.shape[-1], b.shape[-1]
+    acc = [jnp.zeros(a.shape[:-1], jnp.uint64) for _ in range(out_limbs + 1)]
+    for i in range(Ka):
+        for j in range(Kb):
+            k = i + j
+            if k >= out_limbs:
+                continue
+            p = _u64(a[..., i]) * _u64(b[..., j])
+            acc[k] = acc[k] + (p & _MASK)
+            acc[k + 1] = acc[k + 1] + (p >> jnp.uint64(32))
+    out = []
+    carry = jnp.zeros(a.shape[:-1], jnp.uint64)
+    for k in range(out_limbs):
+        t = acc[k] + carry
+        out.append((t & _MASK).astype(jnp.uint32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=-1)
+
+
+def _cmp_reduce(a: jnp.ndarray, b: jnp.ndarray, op) -> jnp.ndarray:
+    K = a.shape[-1]
+    res = op(a[..., 0], b[..., 0])
+    for k in range(1, K):
+        hi_eq = a[..., k] == b[..., k]
+        res = jnp.where(hi_eq, res, op(a[..., k], b[..., k]))
+    return res
+
+
+def gt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cmp_reduce(a, b, lambda x, y: x > y)
+
+
+def ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cmp_reduce(a, b, lambda x, y: x >= y)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+# 10^k and (10^k - 1) tables as [40, 4] / [40, 8] uint32 (10^38 < 2^127).
+def _table(K: int, minus_one: bool) -> np.ndarray:
+    vals = [(10**k - (1 if minus_one else 0)) for k in range(39)]
+    return from_ints(vals, K)
+
+
+POW10_LIMBS = {4: _table(4, False), 8: _table(8, False)}
+NINES_LIMBS = {4: _table(4, True), 8: _table(8, True)}
+
+
+def pow10(k: jnp.ndarray, K: int) -> jnp.ndarray:
+    """10^k as limbs; k clipped to [0, 38]."""
+    tbl = jnp.asarray(POW10_LIMBS[K])
+    return tbl[jnp.clip(k, 0, 38)]
+
+
+def count_digits(a: jnp.ndarray) -> jnp.ndarray:
+    """Number of decimal digits (0 for value 0), like decimal_utils-style
+    precision10 but via table compares: digits = #{k : a >= 10^k}."""
+    K = a.shape[-1]
+    tbl = jnp.asarray(POW10_LIMBS[K])  # [39, K]
+    c = jnp.zeros(a.shape[:-1], jnp.int32)
+    for k in range(39):
+        c = c + ge(a, tbl[k]).astype(jnp.int32)
+    return c
+
+
+def is_all_nines(a: jnp.ndarray) -> jnp.ndarray:
+    """True when a == 10^k - 1 for some k >= 1 (rounding carried through
+    every digit — the digit-count-increase test of cast_string.cu:479-498)."""
+    K = a.shape[-1]
+    tbl = jnp.asarray(NINES_LIMBS[K])
+    r = jnp.zeros(a.shape[:-1], bool)
+    for k in range(1, 39):
+        r = r | eq(a, tbl[k])
+    return r
+
+
+def shift_left_bits(a: jnp.ndarray, n) -> jnp.ndarray:
+    """a << n for per-element n in [0, 32*K)."""
+    K = a.shape[-1]
+    n = jnp.asarray(n, jnp.int32)
+    word = n // 32
+    bit = (n % 32).astype(jnp.uint32)
+    out = []
+    for k in range(K):
+        acc = jnp.zeros(a.shape[:-1], jnp.uint64)
+        for src in range(K):
+            sel = word == (k - src)
+            lo = _u64(a[..., src]) << _u64(bit)
+            contrib = jnp.where(sel, lo, 0)
+            sel_hi = word == (k - src - 1)
+            hi = jnp.where(
+                bit > 0, _u64(a[..., src]) >> _u64(jnp.uint32(32) - bit), jnp.uint64(0)
+            )
+            contrib = contrib + jnp.where(sel_hi, hi, 0)
+            acc = acc + contrib
+        out.append((acc & _MASK).astype(jnp.uint32))
+    return jnp.stack(out, axis=-1)
+
+
+def divmod_bits(num: jnp.ndarray, den: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Unsigned long division num / den -> (quotient, remainder).
+
+    Bit-serial restoring division over 32*K bits (the TPU-vector analog of
+    the reference's Knuth divide, decimal_utils.cu:148-167): K*32 steps of
+    shift/compare/subtract, each fully vectorized across rows. den == 0
+    yields quotient/remainder of 0 (caller must flag div-by-zero).
+    """
+    K = num.shape[-1]
+    nbits = 32 * K
+    den_zero = is_zero(den)
+    q = jnp.zeros_like(num)
+    r = jnp.zeros_like(num)
+    one0 = jnp.zeros_like(num).at[..., 0].set(1)
+    for i in range(nbits - 1, -1, -1):
+        # r = (r << 1) | bit_i(num)
+        bit = (num[..., i // 32] >> jnp.uint32(i % 32)) & jnp.uint32(1)
+        r = shift_left_one(r)
+        r = r.at[..., 0].set(r[..., 0] | bit)
+        fits = ge(r, den) & ~den_zero
+        r_sub, _ = sub(r, den)
+        r = jnp.where(fits[..., None], r_sub, r)
+        q_set = q.at[..., i // 32].set(q[..., i // 32] | (jnp.uint32(1) << jnp.uint32(i % 32)))
+        q = jnp.where(fits[..., None], q_set, q)
+    return q, r
+
+
+def shift_left_one(a: jnp.ndarray) -> jnp.ndarray:
+    K = a.shape[-1]
+    out = [(a[..., 0] << jnp.uint32(1)).astype(jnp.uint32)]
+    for k in range(1, K):
+        out.append(((a[..., k] << jnp.uint32(1)) | (a[..., k - 1] >> jnp.uint32(31))).astype(jnp.uint32))
+    return jnp.stack(out, axis=-1)
+
+
+def to_twos_complement(mag: jnp.ndarray, negative: jnp.ndarray) -> jnp.ndarray:
+    """(magnitude, sign) -> two's complement limbs."""
+    return jnp.where(negative[..., None], negate(mag), mag)
+
+
+def from_twos_complement(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """two's complement limbs -> (magnitude, negative)."""
+    neg = (a[..., -1] >> jnp.uint32(31)) == 1
+    return jnp.where(neg[..., None], negate(a), a), neg

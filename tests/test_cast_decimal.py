@@ -1,0 +1,131 @@
+"""String -> decimal cast tests.
+
+Ports every golden from reference src/main/cpp/tests/cast_string.cpp
+StringToDecimalTests (:245-540) plus ANSI-protocol checks.
+"""
+
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops.cast_string import CastError, string_to_decimal
+
+
+def run(strings, precision, scale, ansi=False):
+    col = Column.from_pylist(strings, dt.STRING)
+    return string_to_decimal(col, ansi, precision, scale)
+
+
+def check(strings, precision, scale, values, validity, expect_type=None):
+    r = run(strings, precision, scale)
+    if expect_type is not None:
+        assert r.dtype.id == expect_type
+    assert r.dtype.scale == scale
+    got = r.to_pylist()
+    expected = [v if ok else None for v, ok in zip(values, validity)]
+    assert got == expected, f"got {got} expected {expected}"
+
+
+def test_simple():
+    check(["1", "0", "-1"], 1, 0, [1, 0, -1], [1, 1, 1], dt.TypeId.DECIMAL32)
+
+
+def test_overprecise():
+    check(["123456", "999999", "-123456", "-999999"], 5, 0, [0] * 4, [0] * 4)
+
+
+def test_rounding():
+    check(
+        ["1.23456", "9.99999", "-1.23456", "-9.99999"], 5, -4,
+        [12346, 100000, -12346, -100000], [1, 0, 1, 0],
+    )
+
+
+def test_decimal_values():
+    check(
+        ["1.234", "0.12345", "-1.034", "-0.001234567890123456"], 6, -5,
+        [123400, 12345, -103400, -123], [1, 1, 1, 1],
+    )
+
+
+def test_exponential_notation():
+    check(
+        ["1.234e-1", "0.12345e1", "-1.034e-2", "-0.001234567890123456e2"], 6, -5,
+        [12340, 123450, -1034, -12346], [1, 1, 1, 1],
+    )
+
+
+def test_positive_scale():
+    check(
+        ["1234e-1", "12345e1", "-1234.5678", "-0.001234567890123456e6"], 6, 2,
+        [1, 1235, -12, -12], [1, 1, 1, 1],
+    )
+
+
+def test_positive_scale_battery():
+    strings = [
+        "813847339", "043469773", "548977048", "985946604", "325679554", "null",
+        "957413342", "541903389", "150050891", "663968655", "976832602",
+        "757172936", "968693314", "106046331", "965120263", "354546567",
+        "108127101", "339513621", "980338159", "593267777",
+    ]
+    values = [
+        813847, 43470, 548977, 985947, 325680, 0, 957413, 541903, 150051,
+        663969, 976833, 757173, 968693, 106046, 965120, 354547, 108127,
+        339514, 980338, 593268,
+    ]
+    validity = [1, 1, 1, 1, 1, 0, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1]
+    check(strings, 8, 3, values, validity)
+
+
+def test_edges():
+    big = (123456789012345678 * 10**15 + 901234567890123) * 100000 + 45601
+    check(["123456789012345678901234567890123456.01"], 38, -2, [big], [1],
+          dt.TypeId.DECIMAL128)
+    check(["8.483315330475049E-4"], 15, -1, [0], [1], dt.TypeId.DECIMAL64)
+    check(["8.483315330475049E-2"], 15, -1, [1], [1])
+    check(["-1.0E14"], 15, -1, [0], [0])
+    check(["-1.0E14"], 16, -1, [-1_000_000_000_000_000], [1])
+    check(["8.575859E8"], 15, -1, [8575859000], [1])
+    check(["10.0"], 3, -1, [100], [1])
+    check(["1.7142857343"], 9, -8, [171428573], [1])
+    check(["1.71428573437482136712623"], 9, -8, [171428573], [1])
+    check(["1.71428573437482136712623"], 9, -9, [0], [0])
+    check(["12.345678901"], 9, -8, [0], [0])
+    check(["0.12345678901"], 6, -6, [123457], [1])
+    check(["1.2345678901"], 6, -6, [0], [0])
+    check(["NaN", "inf", "-inf", "0"], 6, 0, [0, 0, 0, 0], [0, 0, 0, 1])
+    check(["1234567809"], 8, 3, [1234568], [1])
+    check(["4347202159", "4347802159"], 4, 6, [4347, 4348], [1, 1])
+
+
+def test_empty():
+    r = run([], 8, 2)
+    assert len(r) == 0
+    assert r.dtype.id == dt.TypeId.DECIMAL32
+    assert r.dtype.scale == 2
+
+
+def test_type_dispatch_by_precision():
+    assert run(["1"], 9, 0).dtype.id == dt.TypeId.DECIMAL32
+    assert run(["1"], 10, 0).dtype.id == dt.TypeId.DECIMAL64
+    assert run(["1"], 18, 0).dtype.id == dt.TypeId.DECIMAL64
+    assert run(["1"], 19, 0).dtype.id == dt.TypeId.DECIMAL128
+
+
+def test_ansi_throws():
+    with pytest.raises(CastError) as ei:
+        run(["1", "bad", "2"], 5, 0, ansi=True)
+    assert ei.value.row_with_error == 1
+    assert ei.value.string_with_error == "bad"
+
+
+def test_whitespace_and_signs():
+    check(["  1.5 ", "+2.5", "-  1", "1e", "1e2 "], 5, -1,
+          [15, 25, 0, 10, 0], [1, 1, 0, 1, 0])
+
+
+def test_decimal128_large_values():
+    v = 10**37 - 1
+    check([str(v), "-" + str(v)], 38, 0, [v, -v], [1, 1], dt.TypeId.DECIMAL128)
