@@ -1,0 +1,198 @@
+"""DECIMAL128 multiply/divide with Spark-compatible rounding + overflow.
+
+Behavioral parity with reference src/main/cpp/src/decimal_utils.cu:
+
+- ``multiply128`` (dec128_multiplier :524-592): 256-bit product, then the
+  SPARK-40129 double-rounding bug-compatibility — first round to
+  precision 38 using ``precision10`` (which undercounts exact powers of
+  ten by one), then rescale to the requested product scale; overflow when
+  the 256-bit value cannot fit a signed 128-bit integer.
+- ``divide128`` (dec128_divider :595-684): three scaling regimes keyed by
+  ``n_shift_exp = quot_scale - (a_scale - b_scale)``: divide-then-divide
+  (> 0), multiply-then-divide (in [-38, 0]), and base-10 long division
+  via a 10^38 split (< -38); divide-by-zero sets the overflow flag
+  (:608-612); rounding is half-up away from zero driven by the remainder
+  (round_from_remainder :196-227).
+- both return a 2-column Table {BOOL8 overflow, DECIMAL128 result} whose
+  null mask is the AND of the inputs (:690-733).
+
+TPU-first shape: signs are split off and all arithmetic runs on uint32
+limb magnitudes (ops/limbs.py) — [N,8] 256-bit intermediates, scan-based
+bit-serial division — fully vectorized across rows instead of
+thread-per-row functors.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..columnar.dtype import TypeId, decimal128
+from ..columnar import dtype as dt
+from . import limbs as L
+
+__all__ = ["multiply128", "divide128"]
+
+
+_SIGNED128_POS_MAX = 2**127 - 1
+_SIGNED128_NEG_MAX = 2**127
+
+
+def _check_inputs(a: Column, b: Column) -> None:
+    if a.dtype.id != TypeId.DECIMAL128 or b.dtype.id != TypeId.DECIMAL128:
+        raise ValueError("inputs must be DECIMAL128 columns")
+    if len(a) != len(b):
+        raise ValueError("inputs have mismatched row counts")
+
+
+def _and_validity(a: Column, b: Column):
+    if a.validity is None and b.validity is None:
+        return None
+    return a.valid_mask() & b.valid_mask()
+
+
+def _fits_128(mag: jnp.ndarray, negative: jnp.ndarray) -> jnp.ndarray:
+    """Signed-128 fit test on a [..., 8] magnitude (chunked256
+    fits_in_128_bits :108-118): |v| <= 2^127-1, or 2^127 when negative."""
+    pos_max = jnp.asarray(L.from_ints([_SIGNED128_POS_MAX], 8))[0]
+    neg_max = jnp.asarray(L.from_ints([_SIGNED128_NEG_MAX], 8))[0]
+    return jnp.where(negative, ~L.gt(mag, neg_max), ~L.gt(mag, pos_max))
+
+
+def _round_half_up(
+    q_mag: jnp.ndarray, r_mag: jnp.ndarray, d_mag: jnp.ndarray
+) -> jnp.ndarray:
+    """Add 1 to |q| when 2*|r| >= |d| (round_from_remainder :196-227;
+    away-from-zero in magnitude form). Shapes [..., K]."""
+    r2 = L.shift_left_one(r_mag)
+    lost = (r_mag[..., -1] >> jnp.uint32(31)) == 1  # doubling overflowed
+    need_inc = lost | L.ge(r2, d_mag)
+    q_inc, _ = L.add_small(q_mag, jnp.where(need_inc, 1, 0))
+    return q_inc
+
+
+def _divide_and_round(n_mag: jnp.ndarray, d_mag: jnp.ndarray) -> jnp.ndarray:
+    q, r = L.divmod_bits(n_mag, d_mag)
+    return _round_half_up(q, r, d_mag)
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale", "prod_scale"))
+def _multiply_kernel(a2c, b2c, a_scale: int, b_scale: int, prod_scale: int):
+    a_mag, a_neg = L.from_twos_complement(a2c)
+    b_mag, b_neg = L.from_twos_complement(b2c)
+    negative = a_neg ^ b_neg
+
+    product = L.mul(a_mag, b_mag, 8)  # [N, 8] magnitude
+
+    # SPARK-40129 first rounding: to precision 38 by the product's own
+    # precision10 (:538-553)
+    dec_precision = L.precision10(product)
+    first_div_precision = dec_precision - 38
+    do_first = first_div_precision > 0
+    divisor1 = L.pow10(jnp.maximum(first_div_precision, 0), 8)
+    rounded1 = _divide_and_round(product, divisor1)
+    product = jnp.where(do_first[..., None], rounded1, product)
+    mult_scale = a_scale + b_scale + jnp.where(do_first, first_div_precision, 0)
+
+    exponent = prod_scale - mult_scale
+
+    # exponent < 0: multiply up unless it would exceed precision 38 (:556-567)
+    new_precision = L.precision10(product)
+    would_overflow = (exponent < 0) & (new_precision - exponent > 38)
+    scale_mult = L.pow10(jnp.maximum(-exponent, 0), 8)
+    multiplied = L.mul(product[..., :4], scale_mult[..., :4], 8)
+    # product may exceed 4 limbs only when it will overflow anyway
+    product_up = jnp.where(would_overflow[..., None], product, multiplied)
+
+    # exponent >= 0: divide and round (:568-576)
+    divisor2 = L.pow10(jnp.maximum(exponent, 0), 8)
+    divided = _divide_and_round(product, divisor2)
+
+    product = jnp.where((exponent < 0)[..., None], product_up, divided)
+    overflow = would_overflow | ~_fits_128(product, negative)
+
+    result = L.to_twos_complement(product[..., :4], negative)
+    return result, overflow
+
+
+def multiply128(a: Column, b: Column, product_scale: int) -> Table:
+    """Parity: DecimalUtils.multiply128 (DecimalUtils.java:40) ->
+    cudf::jni::multiply_decimal128 (decimal_utils.cu:690-711)."""
+    _check_inputs(a, b)
+    # check_scale_divisor (:500-503)
+    if product_scale - (a.dtype.scale + b.dtype.scale) > 38:
+        raise ValueError("divisor too big")
+    result, overflow = _multiply_kernel(
+        a.data, b.data, a.dtype.scale, b.dtype.scale, product_scale
+    )
+    validity = _and_validity(a, b)
+    return Table(
+        [
+            Column(dt.BOOL8, data=overflow.astype(jnp.uint8), validity=validity),
+            Column(decimal128(product_scale), data=result, validity=validity),
+        ],
+        names=["overflow", "product"],
+    )
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale", "quot_scale"))
+def _divide_kernel(a2c, b2c, a_scale: int, b_scale: int, quot_scale: int):
+    n_mag4, n_neg = L.from_twos_complement(a2c)
+    d_mag4, d_neg = L.from_twos_complement(b2c)
+    negative = n_neg ^ d_neg
+    div_by_zero = L.is_zero(d_mag4)
+
+    pad = jnp.zeros_like(n_mag4)
+    n_mag = jnp.concatenate([n_mag4, pad], axis=-1)  # [N, 8]
+    d_mag = jnp.concatenate([d_mag4, pad], axis=-1)
+    # avoid 0-divisor garbage inside the shared kernel; flagged at the end
+    d_safe = jnp.where(div_by_zero[..., None], jnp.zeros_like(d_mag).at[..., 0].set(1), d_mag)
+
+    n_shift_exp = quot_scale - (a_scale - b_scale)  # static int
+
+    if n_shift_exp > 0:
+        # divide twice (:617-630)
+        q1, _ = L.divmod_bits(n_mag, d_safe)
+        divisor = L.pow10(jnp.full(q1.shape[:-1], n_shift_exp, jnp.int32), 8)
+        result = _divide_and_round(q1, divisor)
+    elif n_shift_exp < -38:
+        # base-10 long division via 10^38 split (:631-658)
+        n38 = L.mul(n_mag4, jnp.asarray(L.from_ints([10**38], 8))[0], 8)
+        q1, r1 = L.divmod_bits(n38, d_safe)
+        remaining = -n_shift_exp - 38
+        scale_mult = jnp.asarray(L.from_ints([10**min(remaining, 76)], 8))[0]
+        # mod-2^256 products, same wrap semantics as chunked256::multiply
+        result = L.mul(q1, scale_mult, 8)
+        scaled_r = L.mul(r1, scale_mult, 8)
+        q2, r2 = L.divmod_bits(scaled_r, d_safe)
+        result, _ = L.add(result, q2)
+        result = _round_half_up(result, r2, d_safe)
+    else:
+        # multiply then divide (:660-672)
+        if n_shift_exp < 0:
+            n_mag = L.mul(n_mag4, jnp.asarray(L.from_ints([10 ** (-n_shift_exp)], 8))[0], 8)
+        result = _divide_and_round(n_mag, d_safe)
+
+    overflow = div_by_zero | ~_fits_128(result, negative)
+    quotient = L.to_twos_complement(result[..., :4], negative)
+    quotient = jnp.where(div_by_zero[..., None], 0, quotient)
+    return quotient, overflow
+
+
+def divide128(a: Column, b: Column, quotient_scale: int) -> Table:
+    """Parity: DecimalUtils.divide128 (DecimalUtils.java:55) ->
+    cudf::jni::divide_decimal128 (decimal_utils.cu:713-733)."""
+    _check_inputs(a, b)
+    result, overflow = _divide_kernel(a.data, b.data, a.dtype.scale, b.dtype.scale, quotient_scale)
+    validity = _and_validity(a, b)
+    return Table(
+        [
+            Column(dt.BOOL8, data=overflow.astype(jnp.uint8), validity=validity),
+            Column(decimal128(quotient_scale), data=result, validity=validity),
+        ],
+        names=["overflow", "quotient"],
+    )

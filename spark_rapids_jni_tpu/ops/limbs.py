@@ -190,9 +190,13 @@ def is_zero(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(a == 0, axis=-1)
 
 
-# 10^k and (10^k - 1) tables as [40, 4] / [40, 8] uint32 (10^38 < 2^127).
+# 10^k and (10^k - 1) tables: 10^38 < 2^127 (K=4); 10^76 < 2^253 (K=8,
+# matching the reference's device pow_ten table, decimal_utils.cu:235-498).
+_MAX_POW = {4: 38, 8: 76}
+
+
 def _table(K: int, minus_one: bool) -> np.ndarray:
-    vals = [(10**k - (1 if minus_one else 0)) for k in range(39)]
+    vals = [(10**k - (1 if minus_one else 0)) for k in range(_MAX_POW[K] + 1)]
     return from_ints(vals, K)
 
 
@@ -201,19 +205,31 @@ NINES_LIMBS = {4: _table(4, True), 8: _table(8, True)}
 
 
 def pow10(k: jnp.ndarray, K: int) -> jnp.ndarray:
-    """10^k as limbs; k clipped to [0, 38]."""
+    """10^k as limbs; k clipped to [0, 38] (K=4) / [0, 76] (K=8)."""
     tbl = jnp.asarray(POW10_LIMBS[K])
-    return tbl[jnp.clip(k, 0, 38)]
+    return tbl[jnp.clip(k, 0, _MAX_POW[K])]
 
 
 def count_digits(a: jnp.ndarray) -> jnp.ndarray:
-    """Number of decimal digits (0 for value 0), like decimal_utils-style
-    precision10 but via table compares: digits = #{k : a >= 10^k}."""
+    """Number of decimal digits (0 for value 0): #{k : a >= 10^k}."""
     K = a.shape[-1]
-    tbl = jnp.asarray(POW10_LIMBS[K])  # [39, K]
+    tbl = jnp.asarray(POW10_LIMBS[K])
     c = jnp.zeros(a.shape[:-1], jnp.int32)
-    for k in range(39):
+    for k in range(_MAX_POW[K] + 1):
         c = c + ge(a, tbl[k]).astype(jnp.int32)
+    return c
+
+
+def precision10(a: jnp.ndarray) -> jnp.ndarray:
+    """Smallest i with 10^i >= a (the reference's precision10,
+    decimal_utils.cu:505-521 — note exact powers of ten give i, one LESS
+    than their digit count; this quirk feeds SPARK-40129 compatibility).
+    Equals #{i : 10^i < a}."""
+    K = a.shape[-1]
+    tbl = jnp.asarray(POW10_LIMBS[K])
+    c = jnp.zeros(a.shape[:-1], jnp.int32)
+    for k in range(_MAX_POW[K] + 1):
+        c = c + gt(a, tbl[k]).astype(jnp.int32)
     return c
 
 
@@ -255,26 +271,39 @@ def divmod_bits(num: jnp.ndarray, den: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.nd
     """Unsigned long division num / den -> (quotient, remainder).
 
     Bit-serial restoring division over 32*K bits (the TPU-vector analog of
-    the reference's Knuth divide, decimal_utils.cu:148-167): K*32 steps of
-    shift/compare/subtract, each fully vectorized across rows. den == 0
-    yields quotient/remainder of 0 (caller must flag div-by-zero).
+    the reference's long division, decimal_utils.cu:148-167): 32*K
+    ``lax.scan`` steps of shift/compare/subtract, each fully vectorized
+    across rows. den == 0 yields quotient/remainder 0 (caller must flag
+    div-by-zero).
     """
+    import jax
+    from jax import lax
+
     K = num.shape[-1]
     nbits = 32 * K
     den_zero = is_zero(den)
-    q = jnp.zeros_like(num)
-    r = jnp.zeros_like(num)
-    one0 = jnp.zeros_like(num).at[..., 0].set(1)
-    for i in range(nbits - 1, -1, -1):
-        # r = (r << 1) | bit_i(num)
-        bit = (num[..., i // 32] >> jnp.uint32(i % 32)) & jnp.uint32(1)
+    limb_iota = jnp.arange(K, dtype=jnp.uint32)
+
+    def step(carry, i):
+        q, r = carry
+        block = (i // 32).astype(jnp.uint32)
+        bit = (i % 32).astype(jnp.uint32)
+        limb = jnp.sum(jnp.where(limb_iota == block, num, 0), axis=-1).astype(jnp.uint32)
+        b = (limb >> bit) & jnp.uint32(1)
         r = shift_left_one(r)
-        r = r.at[..., 0].set(r[..., 0] | bit)
+        r = r.at[..., 0].set(r[..., 0] | b)
         fits = ge(r, den) & ~den_zero
         r_sub, _ = sub(r, den)
         r = jnp.where(fits[..., None], r_sub, r)
-        q_set = q.at[..., i // 32].set(q[..., i // 32] | (jnp.uint32(1) << jnp.uint32(i % 32)))
-        q = jnp.where(fits[..., None], q_set, q)
+        q_bit = jnp.where(limb_iota == block, jnp.uint32(1) << bit, jnp.uint32(0))
+        q = jnp.where(fits[..., None], q | q_bit, q)
+        return (q, r), None
+
+    (q, r), _ = lax.scan(
+        step,
+        (jnp.zeros_like(num), jnp.zeros_like(num)),
+        jnp.arange(nbits - 1, -1, -1, dtype=jnp.int32),
+    )
     return q, r
 
 

@@ -1,0 +1,153 @@
+"""DECIMAL128 multiply/divide tests.
+
+Ports every case from reference src/test/java/.../DecimalUtilsTest.java
+(:42-316), including the SPARK-40129 spark-compat battery and div17/div21.
+"""
+
+import decimal
+
+decimal.getcontext().prec = 100  # 38-digit literals must not round
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.ops.decimal_utils import divide128, multiply128
+
+
+def dec_col(*values):
+    """Mirror of makeDec128Column: scale inferred from the string literals
+    (BigDecimal semantics: '1.0' -> scale -1 cudf, '1e1' -> scale +1)."""
+    decs = [decimal.Decimal(v) for v in values]
+    exp = min(d.as_tuple().exponent for d in decs)
+    unscaled = [int(d.scaleb(-exp)) for d in decs]
+    return Column.from_pylist(unscaled, dt.decimal128(exp))
+
+
+def unscaled(*values, scale):
+    return [int(decimal.Decimal(v).scaleb(-scale)) for v in values]
+
+
+def check(found, overflow, result_strings, scale):
+    assert found.columns[0].to_pylist() == [bool(o) for o in overflow]
+    got = found.columns[1].to_pylist()
+    exp = unscaled(*result_strings, scale=scale)
+    for i, (g, e, ov) in enumerate(zip(got, exp, overflow)):
+        if not ov:
+            assert g == e, f"row {i}: got {g} expected {e}"
+    assert found.columns[1].dtype.scale == scale
+
+
+def test_simple_pos_multiply_one_by_zero():
+    f = multiply128(dec_col("1.0", "10.0", "1000000000000000000000000000000000000.0"),
+                    dec_col("1", "1", "1"), -1)
+    check(f, [0, 0, 0], ["1.0", "10.0", "1000000000000000000000000000000000000.0"], -1)
+
+
+def test_simple_pos_multiply_one_by_one():
+    f = multiply128(dec_col("1.0", "3.7"), dec_col("1.0", "1.5"), -1)
+    check(f, [0, 0], ["1.0", "5.6"], -1)
+
+
+def test_simple_pos_multiply_zero_by_neg_one():
+    f = multiply128(dec_col("1"), dec_col("1e1"), -1)
+    check(f, [0], ["10.0"], -1)
+
+
+def test_large_pos_multiply_ten_by_ten():
+    f = multiply128(dec_col("577694940161436285811555447.3103121126"),
+                    dec_col("100.0000000000"), -6)
+    check(f, [0], ["57769494016143628581155544731.031211"], -6)
+
+
+def test_overflow_mult():
+    f = multiply128(dec_col("577694938495380589068894346.7625198736"),
+                    dec_col("-1258508260891400005608241690.1564700995"), -6)
+    assert f.columns[0].to_pylist() == [True]
+
+
+def test_simple_neg_multiply():
+    f = multiply128(dec_col("1.0", "-1.0", "10.0"), dec_col("-1", "1", "-1"), -1)
+    check(f, [0, 0, 0], ["-1.0", "-1.0", "-10.0"], -1)
+
+
+def test_simple_neg_multiply_one_by_one():
+    f = multiply128(dec_col("1.0", "-1.0", "3.7"), dec_col("-1.0", "-1.0", "-1.5"), -1)
+    check(f, [0, 0, 0], ["-1.0", "1.0", "-5.6"], -1)
+
+
+def test_spark_compat_multiply():
+    # SPARK-40129 double-rounding bug-compatibility (DecimalUtilsTest.java:151)
+    f = multiply128(
+        dec_col("3358377338823096511784947656.4650294583",
+                "7161021785186010157110137546.5940777916",
+                "9173594185998001607642838421.5479932913"),
+        dec_col("-12.0000000000", "-12.0000000000", "-12.0000000000"),
+        -6,
+    )
+    check(f, [0, 0, 0],
+          ["-40300528065877158141419371877.580354",
+           "-85932261422232121885321650559.128933",
+           "-110083130231976019291714061058.575920"], -6)
+
+
+def test_simple_pos_div_with_zero():
+    f = divide128(dec_col("1.0", "10.0", "1.0", "1000000000000000000000000000000000000.0"),
+                  dec_col("1", "2", "0", "5"), -1)
+    assert f.columns[0].to_pylist() == [False, False, True, False]
+    got = f.columns[1].to_pylist()
+    exp = unscaled("1.0", "5.0", "0", "200000000000000000000000000000000000.0", scale=-1)
+    assert got[0] == exp[0] and got[1] == exp[1] and got[3] == exp[3]
+    assert got[2] == 0  # div-by-zero writes 0 (decimal_utils.cu:610)
+
+
+def test_simple_pos_div_one_by_one():
+    f = divide128(dec_col("1.0", "3.7", "99.9"), dec_col("1.0", "1.5", "4.5"), -1)
+    check(f, [0, 0, 0], ["1.0", "2.5", "22.2"], -1)
+
+
+def test_simple_neg_div_one_by_one():
+    f = divide128(dec_col("1.0", "-3.7", "-99.9"), dec_col("-1.0", "1.5", "-4.5"), -1)
+    check(f, [0, 0, 0], ["-1.0", "-2.5", "22.2"], -1)
+
+
+def test_div_complex():
+    f = divide128(dec_col("100000000000000000000000000000000"),
+                  dec_col("3.0000000000000000000000000000000000000"), -6)
+    check(f, [0], ["33333333333333333333333333333333.333333"], -6)
+
+
+def test_div17():
+    f = divide128(dec_col("1454.48287885760884146", "3655.54438423288356646"),
+                  dec_col("100.00000000000000000", "100.00000000000000000"), -17)
+    check(f, [0, 0], ["14.54482878857608841", "36.55544384232883566"], -17)
+
+
+def test_div17_with_pos_scale():
+    f = divide128(dec_col("1454.48287885760884146"), dec_col("1e2"), -17)
+    check(f, [0], ["14.54482878857608841"], -17)
+
+
+def test_div21_with_pos_scale():
+    f = divide128(dec_col("5776949401614362.858115554473103121126"), dec_col("1e2"), -6)
+    check(f, [0], ["57769494016143.628581"], -6)
+
+
+def test_div21():
+    f = divide128(
+        dec_col("60250054953505368.439892586764888491018",
+                "91910085134512953.335347579448489062875",
+                "51312633107598808.869351260608653423886"),
+        dec_col("97982875273794447.385070145919990343867",
+                "94478503341597285.814104936062234698349",
+                "92266075543848323.800466593082956765923"),
+        -6,
+    )
+    check(f, [0, 0, 0], ["0.614904", "0.972815", "0.556138"], -6)
+
+
+def test_null_propagation():
+    a = Column.from_pylist([1000, None], dt.decimal128(-1))
+    b = Column.from_pylist([15, 15], dt.decimal128(-1))
+    f = multiply128(a, b, -1)
+    assert f.columns[0].to_pylist() == [False, None]
+    assert f.columns[1].to_pylist() == [1500, None]
