@@ -1,0 +1,173 @@
+"""Parquet footer service tests, with pyarrow as the metadata oracle.
+
+Covers: thrift compact round-trip fidelity, column pruning (flat, struct,
+list, map), case folding, row-group split selection, and re-serialized
+footers being readable by an independent parquet implementation.
+"""
+
+import io
+import struct
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.io import thrift_compact as tc
+from spark_rapids_jni_tpu.io.parquet_footer import (
+    ListElement,
+    MapElement,
+    ParquetFooter,
+    StructElement,
+    ValueElement,
+    read_and_filter,
+)
+
+
+def make_parquet(table: pa.Table, row_group_size=None) -> bytes:
+    buf = io.BytesIO()
+    pq.write_table(table, buf, row_group_size=row_group_size, compression="snappy")
+    return buf.getvalue()
+
+
+def footer_bytes(file_bytes: bytes) -> bytes:
+    (flen,) = struct.unpack("<I", file_bytes[-8:-4])
+    return file_bytes[-8 - flen : -8]
+
+
+@pytest.fixture
+def flat_file():
+    t = pa.table({
+        "a": pa.array(range(100), pa.int32()),
+        "b": pa.array([f"s{i}" for i in range(100)]),
+        "c": pa.array([i * 0.5 for i in range(100)]),
+    })
+    return make_parquet(t, row_group_size=30)
+
+
+def test_thrift_roundtrip_bytes(flat_file):
+    raw = footer_bytes(flat_file)
+    meta = tc.read_struct(raw)
+    out = tc.write_struct(meta)
+    # round-trip must be parseable and stable
+    again = tc.write_struct(tc.read_struct(out))
+    assert out == again
+    # and readable by pyarrow when re-framed
+    framed = b"PAR1" + out + struct.pack("<I", len(out)) + b"PAR1"
+    md = pq.read_metadata(io.BytesIO(framed))
+    assert md.num_rows == 100
+    assert md.num_columns == 3
+
+
+def test_filter_flat_columns(flat_file):
+    schema = StructElement().add_child("a", ValueElement()).add_child("c", ValueElement())
+    f = read_and_filter(flat_file, 0, len(flat_file), schema)
+    assert f.get_num_rows() == 100
+    assert f.get_num_columns() == 2
+    md = pq.read_metadata(io.BytesIO(f.serialize_thrift_file()))
+    assert md.num_columns == 2
+    assert [md.schema.column(i).name for i in range(2)] == ["a", "c"]
+    # chunk stats survive for the right columns
+    assert md.row_group(0).column(0).path_in_schema == "a"
+    assert md.row_group(0).column(1).path_in_schema == "c"
+
+
+def test_filter_case_insensitive(flat_file):
+    schema = StructElement().add_child("A", ValueElement())
+    f = read_and_filter(flat_file, 0, len(flat_file), schema, ignore_case=False)
+    assert f.get_num_columns() == 0  # no case folding -> no match
+    f2 = read_and_filter(flat_file, 0, len(flat_file),
+                         StructElement().add_child("a", ValueElement()), ignore_case=True)
+    assert f2.get_num_columns() == 1
+
+
+def test_filter_missing_column_ok(flat_file):
+    schema = StructElement().add_child("a", ValueElement()).add_child("zz", ValueElement())
+    f = read_and_filter(flat_file, 0, len(flat_file), schema)
+    assert f.get_num_columns() == 1
+
+
+def test_row_group_split_selection(flat_file):
+    # row groups of 30/30/30/10 rows; select splits by byte ranges
+    md = pq.read_metadata(io.BytesIO(flat_file))
+    assert md.num_row_groups == 4
+    schema = StructElement().add_child("a", ValueElement())
+
+    whole = read_and_filter(flat_file, 0, len(flat_file), schema)
+    assert whole.get_num_rows() == 100
+
+    # a zero-length split selects nothing
+    none = read_and_filter(flat_file, 0, 0, schema)
+    assert none.get_num_rows() == 0
+
+    # part_length < 0 keeps all groups (ParquetFooter.java readAndFilter contract)
+    all_groups = read_and_filter(flat_file, 0, -1, schema)
+    assert all_groups.get_num_rows() == 100
+
+    # split covering only the first half of the file bytes
+    half = read_and_filter(flat_file, 0, len(flat_file) // 2, schema)
+    assert 0 < half.get_num_rows() < 100
+
+
+def test_nested_struct_pruning():
+    t = pa.table({
+        "s": pa.array([{"x": i, "y": f"v{i}", "z": i * 1.0} for i in range(10)],
+                      pa.struct([("x", pa.int64()), ("y", pa.string()), ("z", pa.float64())])),
+        "plain": pa.array(range(10), pa.int64()),
+    })
+    data = make_parquet(t)
+    schema = StructElement().add_child(
+        "s", StructElement().add_child("x", ValueElement())
+    )
+    f = read_and_filter(data, 0, len(data), schema)
+    md = pq.read_metadata(io.BytesIO(f.serialize_thrift_file()))
+    assert md.num_columns == 1
+    assert md.schema.column(0).path.split(".") == ["s", "x"]
+
+
+def test_list_pruning():
+    t = pa.table({
+        "l": pa.array([[1, 2], [3], []], pa.list_(pa.int32())),
+        "o": pa.array([1, 2, 3], pa.int32()),
+    })
+    data = make_parquet(t)
+    schema = StructElement().add_child("l", ListElement(ValueElement()))
+    f = read_and_filter(data, 0, len(data), schema)
+    md = pq.read_metadata(io.BytesIO(f.serialize_thrift_file()))
+    assert md.num_columns == 1
+    assert md.schema.column(0).path.startswith("l.")
+
+
+def test_map_pruning():
+    t = pa.table({
+        "m": pa.array([{"k1": 1}, {"k2": 2}, {}], pa.map_(pa.string(), pa.int32())),
+        "o": pa.array([1, 2, 3], pa.int32()),
+    })
+    data = make_parquet(t)
+    schema = StructElement().add_child("m", MapElement(ValueElement(), ValueElement()))
+    f = read_and_filter(data, 0, len(data), schema)
+    md = pq.read_metadata(io.BytesIO(f.serialize_thrift_file()))
+    assert md.num_columns == 2  # key + value leaves
+    paths = {md.schema.column(i).path for i in range(2)}
+    assert any(p.endswith("key") for p in paths)
+    assert any(p.endswith("value") for p in paths)
+
+
+def test_struct_of_list_of_struct():
+    inner = pa.struct([("a", pa.int32()), ("b", pa.string())])
+    t = pa.table({
+        "outer": pa.array(
+            [{"items": [{"a": 1, "b": "x"}]}] * 3,
+            pa.struct([("items", pa.list_(inner))]),
+        ),
+    })
+    data = make_parquet(t)
+    schema = StructElement().add_child(
+        "outer",
+        StructElement().add_child(
+            "items", ListElement(StructElement().add_child("b", ValueElement()))
+        ),
+    )
+    f = read_and_filter(data, 0, len(data), schema)
+    md = pq.read_metadata(io.BytesIO(f.serialize_thrift_file()))
+    assert md.num_columns == 1
+    assert md.schema.column(0).path.endswith(".b")
