@@ -1,0 +1,400 @@
+"""Parquet data decode: column chunks -> device Columns.
+
+Replaces the capability the reference inherits from cudf's GPU parquet
+decode (SURVEY §2.8). Round-1 scope: flat schemas, PLAIN +
+PLAIN_DICTIONARY/RLE_DICTIONARY encodings, RLE/bit-packed definition
+levels, data page v1/v2, UNCOMPRESSED/SNAPPY/ZSTD/GZIP codecs
+(decompression via pyarrow's bundled codecs — the analog of the
+reference statically linking libsnappy et al).
+
+Decode runs host-side in numpy and lands device-resident ``Column``s —
+the same host->device split as the reference's CPU thrift + GPU decode,
+with the device-side decode kernel left as a later optimization.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from . import thrift_compact as tc
+
+__all__ = ["read_table", "ParquetReadError"]
+
+
+class ParquetReadError(RuntimeError):
+    pass
+
+
+# physical types (parquet.thrift Type)
+_T_BOOLEAN = 0
+_T_INT32 = 1
+_T_INT64 = 2
+_T_INT96 = 3
+_T_FLOAT = 4
+_T_DOUBLE = 5
+_T_BYTE_ARRAY = 6
+_T_FIXED_LEN_BYTE_ARRAY = 7
+
+# encodings
+_E_PLAIN = 0
+_E_PLAIN_DICTIONARY = 2
+_E_RLE = 3
+_E_RLE_DICTIONARY = 8
+
+# page types
+_P_DATA = 0
+_P_DICTIONARY = 2
+_P_DATA_V2 = 3
+
+# compression codecs (parquet.thrift CompressionCodec)
+_CODECS = {0: None, 1: "snappy", 2: "gzip", 4: "brotli", 5: "lz4", 6: "zstd", 7: "lz4_raw"}
+
+# converted types
+_C_UTF8 = 0
+
+# PageHeader field ids
+_PH_TYPE = 1
+_PH_UNCOMP = 2
+_PH_COMP = 3
+_PH_DATA = 5
+_PH_DICT = 7
+_PH_DATA_V2 = 8
+# DataPageHeader
+_DPH_NUM_VALUES = 1
+_DPH_ENCODING = 2
+# DataPageHeaderV2
+_DPH2_NUM_VALUES = 1
+_DPH2_NUM_NULLS = 2
+_DPH2_NUM_ROWS = 3
+_DPH2_ENCODING = 4
+_DPH2_DEF_BYTES = 5
+_DPH2_REP_BYTES = 6
+_DPH2_COMPRESSED = 7
+# SchemaElement / metadata ids reused from parquet_footer
+from .parquet_footer import (  # noqa: E402
+    _CC_META_DATA,
+    _CMD_DATA_PAGE_OFFSET,
+    _CMD_DICT_PAGE_OFFSET,
+    _CMD_TOTAL_COMPRESSED_SIZE,
+    _FMD_ROW_GROUPS,
+    _FMD_SCHEMA,
+    _RG_COLUMNS,
+    _RG_NUM_ROWS,
+    _SE_CONVERTED_TYPE,
+    _SE_NAME,
+    _SE_NUM_CHILDREN,
+    _SE_REPETITION,
+    _SE_TYPE,
+)
+
+_CMD_TYPE = 1
+_CMD_ENCODINGS = 2
+_CMD_PATH = 3
+_CMD_CODEC = 4
+_CMD_NUM_VALUES = 5
+_CMD_TOTAL_UNCOMPRESSED = 6
+
+
+def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> bytes:
+    if codec is None:
+        return data
+    import pyarrow as pa
+
+    return pa.Codec(codec).decompress(data, decompressed_size=uncompressed_size).to_pybytes()
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid (parquet format spec)
+# ---------------------------------------------------------------------------
+
+
+def _read_rle_bitpacked(data: bytes, bit_width: int, num_values: int) -> np.ndarray:
+    """Decode the RLE/bit-packed hybrid encoding into int32 values."""
+    out = np.empty(num_values, dtype=np.int32)
+    pos = 0
+    filled = 0
+    if bit_width == 0:
+        out[:] = 0
+        return out
+    byte_width = (bit_width + 7) // 8
+    while filled < num_values:
+        header = 0
+        shift = 0
+        while True:
+            if pos >= len(data):
+                raise ParquetReadError("rle: truncated varint")
+            b = data[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        if header & 1:
+            # bit-packed run: (header >> 1) groups of 8 values
+            groups = header >> 1
+            count = groups * 8
+            nbytes = groups * bit_width
+            chunk = np.frombuffer(data[pos : pos + nbytes], dtype=np.uint8)
+            pos += nbytes
+            bits = np.unpackbits(chunk, bitorder="little")
+            vals = bits.reshape(-1, bit_width)
+            weights = (1 << np.arange(bit_width, dtype=np.int64))
+            decoded = (vals.astype(np.int64) * weights).sum(axis=1).astype(np.int32)
+            take = min(count, num_values - filled)
+            out[filled : filled + take] = decoded[:take]
+            filled += take
+        else:
+            # rle run
+            count = header >> 1
+            raw = data[pos : pos + byte_width]
+            pos += byte_width
+            val = int.from_bytes(raw, "little")
+            take = min(count, num_values - filled)
+            out[filled : filled + take] = val
+            filled += take
+    return out
+
+
+def _read_plain(data: bytes, ptype: int, num: int, type_length: int = 0):
+    if ptype == _T_INT32:
+        return np.frombuffer(data, dtype=np.int32, count=num), 4 * num
+    if ptype == _T_INT64:
+        return np.frombuffer(data, dtype=np.int64, count=num), 8 * num
+    if ptype == _T_FLOAT:
+        return np.frombuffer(data, dtype=np.float32, count=num), 4 * num
+    if ptype == _T_DOUBLE:
+        return np.frombuffer(data, dtype=np.float64, count=num), 8 * num
+    if ptype == _T_BOOLEAN:
+        bits = np.unpackbits(
+            np.frombuffer(data, dtype=np.uint8, count=(num + 7) // 8), bitorder="little"
+        )[:num]
+        return bits.astype(np.uint8), (num + 7) // 8
+    if ptype == _T_BYTE_ARRAY:
+        vals = []
+        pos = 0
+        for _ in range(num):
+            (ln,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            vals.append(data[pos : pos + ln])
+            pos += ln
+        return vals, pos
+    raise ParquetReadError(f"unsupported physical type {ptype}")
+
+
+class _ChunkDecoder:
+    def __init__(self, file_bytes: bytes, chunk: tc.ThriftStruct, max_def: int):
+        md = chunk.get(_CC_META_DATA)
+        self.ptype = md.get(_CMD_TYPE)
+        self.codec = _CODECS.get(md.get(_CMD_CODEC, 0))
+        self.num_values = md.get(_CMD_NUM_VALUES, 0)
+        self.max_def = max_def
+        start = md.get(_CMD_DATA_PAGE_OFFSET, 0)
+        dict_off = md.get(_CMD_DICT_PAGE_OFFSET)
+        if dict_off is not None and dict_off < start:
+            start = dict_off
+        self.data = file_bytes
+        self.pos = start
+        self.dictionary = None
+
+    def _read_page_header(self) -> tc.ThriftStruct:
+        r = tc._Reader(self.data, self.pos)
+        hdr = tc._read_struct_body(r)
+        self.pos = r.pos
+        return hdr
+
+    def decode(self) -> Tuple[object, np.ndarray]:
+        """Returns (values, def_levels) concatenated across pages."""
+        vals_parts: List = []
+        defs_parts: List[np.ndarray] = []
+        remaining = self.num_values
+        while remaining > 0:
+            hdr = self._read_page_header()
+            ptype_page = hdr.get(_PH_TYPE)
+            comp_size = hdr.get(_PH_COMP)
+            uncomp_size = hdr.get(_PH_UNCOMP)
+            raw = self.data[self.pos : self.pos + comp_size]
+            self.pos += comp_size
+
+            if ptype_page == _P_DICTIONARY:
+                page = _decompress(raw, self.codec, uncomp_size)
+                n = hdr.get(_PH_DICT).get(_DPH_NUM_VALUES)
+                self.dictionary, _ = _read_plain(page, self.ptype, n)
+                continue
+
+            if ptype_page == _P_DATA:
+                dph = hdr.get(_PH_DATA)
+                n = dph.get(_DPH_NUM_VALUES)
+                enc = dph.get(_DPH_ENCODING)
+                page = _decompress(raw, self.codec, uncomp_size)
+                off = 0
+                if self.max_def > 0:
+                    (ln,) = struct.unpack_from("<I", page, off)
+                    off += 4
+                    bw = max(self.max_def.bit_length(), 1)
+                    defs = _read_rle_bitpacked(page[off : off + ln], bw, n)
+                    off += ln
+                else:
+                    defs = np.ones(n, dtype=np.int32)
+            elif ptype_page == _P_DATA_V2:
+                dph = hdr.get(_PH_DATA_V2)
+                n = dph.get(_DPH2_NUM_VALUES)
+                enc = dph.get(_DPH2_ENCODING)
+                def_bytes = dph.get(_DPH2_DEF_BYTES, 0)
+                rep_bytes = dph.get(_DPH2_REP_BYTES, 0)
+                if rep_bytes:
+                    raise ParquetReadError("nested columns not supported yet")
+                levels = raw[: def_bytes + rep_bytes]  # v2 levels are never compressed
+                if self.max_def > 0 and def_bytes:
+                    bw = max(self.max_def.bit_length(), 1)
+                    defs = _read_rle_bitpacked(levels[rep_bytes:], bw, n)
+                else:
+                    defs = np.ones(n, dtype=np.int32)
+                body = raw[def_bytes + rep_bytes :]
+                compressed_flag = dph.get(_DPH2_COMPRESSED, True)
+                page = (
+                    _decompress(body, self.codec, uncomp_size - def_bytes - rep_bytes)
+                    if compressed_flag
+                    else body
+                )
+                off = 0
+            else:
+                raise ParquetReadError(f"unsupported page type {ptype_page}")
+
+            n_present = int(np.count_nonzero(defs == self.max_def)) if self.max_def else n
+            if enc == _E_RLE and self.ptype == _T_BOOLEAN:
+                # v2 boolean values: u32 length + RLE/bit-packed, bit width 1
+                (ln,) = struct.unpack_from("<I", page, off)
+                vals = _read_rle_bitpacked(page[off + 4 : off + 4 + ln], 1, n_present).astype(
+                    np.uint8
+                )
+            elif enc == _E_PLAIN:
+                vals, _ = _read_plain(page[off:], self.ptype, n_present)
+            elif enc in (_E_PLAIN_DICTIONARY, _E_RLE_DICTIONARY):
+                if self.dictionary is None:
+                    raise ParquetReadError("dictionary page missing")
+                bw = page[off]
+                idx = _read_rle_bitpacked(page[off + 1 :], bw, n_present)
+                if self.ptype == _T_BYTE_ARRAY:
+                    vals = [self.dictionary[i] for i in idx]
+                else:
+                    vals = np.asarray(self.dictionary)[idx]
+            else:
+                raise ParquetReadError(f"unsupported encoding {enc}")
+
+            vals_parts.append(vals)
+            defs_parts.append(defs)
+            remaining -= n
+
+        defs = np.concatenate(defs_parts) if defs_parts else np.zeros(0, np.int32)
+        if self.ptype == _T_BYTE_ARRAY:
+            values: List[bytes] = []
+            for v in vals_parts:
+                values.extend(v)
+            return values, defs
+        values = np.concatenate(vals_parts) if vals_parts else np.zeros(0, np.int32)
+        return values, defs
+
+
+def _leaf_schema_elements(meta: tc.ThriftStruct):
+    """Flat-schema leaves with their max definition level (root's children)."""
+    schema = meta.get(_FMD_SCHEMA).values
+    root_n = schema[0].get(_SE_NUM_CHILDREN, 0)
+    if len(schema) != root_n + 1:
+        raise ParquetReadError("nested schemas not supported yet")
+    leaves = []
+    for e in schema[1:]:
+        name = e.get(_SE_NAME, b"").decode()
+        optional = e.get(_SE_REPETITION, 0) == 1
+        leaves.append((name, e, 1 if optional else 0))
+    return leaves
+
+
+def _to_column(name: str, elem: tc.ThriftStruct, values, defs, max_def: int) -> Column:
+    present = defs == max_def if max_def else np.ones(len(defs), bool)
+    n = len(defs)
+    validity = None if present.all() else present
+    ptype = elem.get(_SE_TYPE)
+    conv = elem.get(_SE_CONVERTED_TYPE)
+
+    if ptype == _T_BYTE_ARRAY:
+        # scatter present byte strings into full row set
+        full: List[bytes] = [b""] * n
+        j = 0
+        for i in range(n):
+            if present[i]:
+                full[i] = values[j]
+                j += 1
+        lens = np.fromiter((len(b) for b in full), dtype=np.int32, count=n)
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        chars = np.frombuffer(b"".join(full), dtype=np.uint8).copy()
+        import jax.numpy as jnp
+
+        return Column(
+            dt.STRING,
+            validity=None if validity is None else jnp.asarray(validity),
+            offsets=jnp.asarray(offsets),
+            chars=jnp.asarray(chars),
+        )
+
+    np_map = {
+        _T_INT32: (np.int32, dt.INT32),
+        _T_INT64: (np.int64, dt.INT64),
+        _T_FLOAT: (np.float32, dt.FLOAT32),
+        _T_DOUBLE: (np.float64, dt.FLOAT64),
+        _T_BOOLEAN: (np.uint8, dt.BOOL8),
+    }
+    if ptype not in np_map:
+        raise ParquetReadError(f"unsupported type {ptype}")
+    np_dt, col_dt = np_map[ptype]
+    full_arr = np.zeros(n, dtype=np_dt)
+    full_arr[present] = values
+    return Column.from_numpy(full_arr, col_dt, validity=None if validity is None else validity)
+
+
+def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
+    """Read a flat-schema parquet file into a device Table."""
+    if file_bytes[:4] != b"PAR1" or file_bytes[-4:] != b"PAR1":
+        raise ParquetReadError("not a parquet file")
+    (flen,) = struct.unpack("<I", file_bytes[-8:-4])
+    meta = tc.read_struct(file_bytes[-8 - flen : -8])
+
+    leaves = _leaf_schema_elements(meta)
+    if columns is not None:
+        name_set = set(columns)
+        sel = [(i, leaf) for i, leaf in enumerate(leaves) if leaf[0] in name_set]
+    else:
+        sel = list(enumerate(leaves))
+
+    rgs = meta.get(_FMD_ROW_GROUPS).values
+    out_cols: Dict[str, Tuple[List, List, tc.ThriftStruct, int]] = {}
+    order: List[str] = []
+    for i, (name, elem, max_def) in sel:
+        vparts: List = []
+        dparts: List[np.ndarray] = []
+        for rg in rgs:
+            chunk = rg.get(_RG_COLUMNS).values[i]
+            dec = _ChunkDecoder(file_bytes, chunk, max_def)
+            vals, defs = dec.decode()
+            vparts.append(vals)
+            dparts.append(defs)
+        if elem.get(_SE_TYPE) == _T_BYTE_ARRAY:
+            values: List[bytes] = []
+            for v in vparts:
+                values.extend(v)
+        else:
+            values = np.concatenate(vparts) if vparts else np.zeros(0, np.int32)
+        defs = np.concatenate(dparts) if dparts else np.zeros(0, np.int32)
+        out_cols[name] = (values, defs, elem, max_def)
+        order.append(name)
+
+    cols = [
+        _to_column(name, out_cols[name][2], out_cols[name][0], out_cols[name][1], out_cols[name][3])
+        for name in order
+    ]
+    return Table(cols, names=order)
