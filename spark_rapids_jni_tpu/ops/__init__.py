@@ -6,4 +6,18 @@ vectorized byte arithmetic instead of warp-level byte addressing, and
 host code only for metadata (batching, layout).
 """
 
-from . import row_conversion  # noqa: F401
+from . import (  # noqa: F401
+    aggregate,
+    bitutils,
+    cast_decimal,
+    cast_string,
+    copying,
+    decimal_utils,
+    expressions,
+    hashing,
+    join,
+    limbs,
+    row_conversion,
+    sort,
+    zorder,
+)

@@ -1,0 +1,185 @@
+"""Hash-aggregate tier: groupby + reductions (cudf groupby, SURVEY §2.8).
+
+TPU-first: sort-based grouping instead of a device hash table — XLA has
+a first-class sort but no general hash table; sort + segment-reduce is
+the canonical accelerator formulation. Pipeline:
+
+1. stable sort rows by key columns (ops/sort total-order keys),
+2. group boundaries from neighbor inequality (nulls compare equal,
+   SQL GROUP BY semantics),
+3. ``jax.ops.segment_*`` reductions with num_segments synced to host
+   once (the output-allocation sync every engine pays),
+4. group keys gathered from each segment's first row.
+
+Supported aggs: sum, count (valid), count_all, min, max, mean.
+FLOAT64 reduces via bitutils.float_view (exact f64 on CPU backends, f32
+on TPU — documented platform approximation); min/max on floats use the
+exact total-order transform, so they are exact everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from ..columnar.dtype import TypeId
+from . import bitutils
+from .copying import gather
+from .sort import sorted_order
+
+__all__ = ["groupby_aggregate"]
+
+
+def _keys_equal_neighbor(col: Column, order: jnp.ndarray) -> jnp.ndarray:
+    """[N-1] bool: sorted row i equals row i-1 for this key (nulls equal)."""
+    v = col.valid_mask()[order]
+    same_valid = v[1:] == v[:-1]
+    if col.dtype.id == TypeId.STRING:
+        offs = col.offsets
+        lens = (offs[1:] - offs[:-1])[order]
+        same_len = lens[1:] == lens[:-1]
+        # compare up to 16-byte prefix lanes (sort key resolution)
+        from .sort import _string_prefix_keys
+
+        k1, k2 = _string_prefix_keys(Column(col.dtype, offsets=col.offsets, chars=col.chars))
+        same_data = (k1[order][1:] == k1[order][:-1]) & (k2[order][1:] == k2[order][:-1])
+        same = same_len & same_data
+    elif col.dtype.id == TypeId.DECIMAL128:
+        d = col.data[order]
+        same = jnp.all(d[1:] == d[:-1], axis=1)
+    else:
+        d = col.data[order]
+        same = d[1:] == d[:-1]
+    both_null = (~v[1:]) & (~v[:-1])
+    return same_valid & (same | both_null)
+
+
+def _segment_ids(keys: Table, order: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    n = keys.num_rows
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32), 0
+    eq = jnp.ones((n - 1,), bool)
+    for col in keys.columns:
+        eq = eq & _keys_equal_neighbor(col, order)
+    starts = jnp.concatenate([jnp.ones((1,), bool), ~eq])
+    seg = jnp.cumsum(starts).astype(jnp.int32) - 1
+    num = int(seg[-1]) + 1  # host sync: group count
+    return seg, num
+
+
+def _agg_column(col: Column, order, seg, num, how: str) -> Column:
+    d = col.dtype
+    sorted_valid = col.valid_mask()[order]
+
+    if how == "count_all":
+        data = jax.ops.segment_sum(jnp.ones_like(seg, jnp.int64), seg, num)
+        return Column(dt.INT64, data=data)
+    if how == "count":
+        data = jax.ops.segment_sum(sorted_valid.astype(jnp.int64), seg, num)
+        return Column(dt.INT64, data=data)
+
+    any_valid = jax.ops.segment_max(sorted_valid.astype(jnp.int32), seg, num) > 0
+
+    if how in ("min", "max") and d.is_fixed_width and d.id != TypeId.DECIMAL128:
+        # exact via total-order keys even for floats on TPU
+        key = bitutils.total_order_key(col.data, d)[order]
+        udt = key.dtype
+        fill = jnp.asarray(~jnp.zeros((), udt)) if how == "min" else jnp.zeros((), udt)
+        key = jnp.where(sorted_valid, key, fill)
+        red = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+        best = red(key, seg, num)
+        data = _from_total_order(best, d)
+        return Column(d, data=data, validity=any_valid)
+
+    if how in ("sum", "mean"):
+        if d.is_floating:
+            vals = bitutils.float_view(col.data, d)[order]
+            vals = jnp.where(sorted_valid, vals, 0)
+            s = jax.ops.segment_sum(vals, seg, num)
+            if how == "mean":
+                cnt = jax.ops.segment_sum(sorted_valid.astype(vals.dtype), seg, num)
+                s = s / jnp.maximum(cnt, 1)
+                out_d = dt.FLOAT64
+            else:
+                out_d = dt.FLOAT64 if d.id == TypeId.FLOAT64 else dt.FLOAT32
+                if d.id == TypeId.FLOAT32:
+                    return Column(out_d, data=s.astype(jnp.float32), validity=any_valid)
+            return Column(dt.FLOAT64, data=bitutils.float_store(s, dt.FLOAT64), validity=any_valid)
+        if d.id == TypeId.DECIMAL128:
+            # limb-wise int64 partial sums + carry renormalize: summing
+            # two's-complement limbs mod 2^128 is exact signed addition
+            # (wraps on >128-bit overflow, like int128 accumulation would)
+            limbs = col.data[order]
+            limbs = jnp.where(sorted_valid[:, None], limbs, 0)
+            parts = [
+                jax.ops.segment_sum(limbs[:, k].astype(jnp.int64), seg, num) for k in range(4)
+            ]
+            out = jnp.zeros((num, 4), jnp.uint32)
+            carry = jnp.zeros((num,), jnp.int64)
+            for k in range(4):
+                t = parts[k] + carry
+                out = out.at[:, k].set((t & 0xFFFFFFFF).astype(jnp.uint32))
+                carry = t >> 32
+            return Column(d, data=out, validity=any_valid)
+        if how == "mean":
+            vals = col.data[order].astype(jnp.float64)
+            vals = jnp.where(sorted_valid, vals, 0)
+            s = jax.ops.segment_sum(vals, seg, num)
+            cnt = jax.ops.segment_sum(sorted_valid.astype(jnp.float64), seg, num)
+            m = s / jnp.maximum(cnt, 1)
+            return Column(dt.FLOAT64, data=bitutils.float_store(m, dt.FLOAT64), validity=any_valid)
+        # integral sum -> int64 (Spark sum semantics)
+        vals = col.data[order].astype(jnp.int64)
+        vals = jnp.where(sorted_valid, vals, 0)
+        s = jax.ops.segment_sum(vals, seg, num)
+        return Column(dt.INT64, data=s, validity=any_valid)
+
+    raise ValueError(f"unsupported aggregation {how!r} on {d!r}")
+
+
+def _from_total_order(key: jnp.ndarray, d) -> jnp.ndarray:
+    """Inverse of bitutils.total_order_key."""
+    from jax import lax
+
+    if d.id == TypeId.FLOAT64:
+        neg = (key >> jnp.uint64(63)) == 0
+        bits = jnp.where(neg, key ^ jnp.uint64(0xFFFFFFFFFFFFFFFF), key & ~jnp.uint64(1 << 63))
+        return bits
+    if d.id == TypeId.FLOAT32:
+        neg = (key >> jnp.uint32(31)) == 0
+        bits = jnp.where(neg, key ^ jnp.uint32(0xFFFFFFFF), key & ~jnp.uint32(1 << 31))
+        return lax.bitcast_convert_type(bits, jnp.float32)
+    if d.is_signed or d.np_dtype.kind == "i":
+        udt = key.dtype
+        return lax.bitcast_convert_type(key ^ (udt(1) << udt(8 * d.size_bytes - 1)), d.jnp_dtype)
+    return key.astype(d.jnp_dtype)
+
+
+def groupby_aggregate(
+    keys: Table, values: Table, aggs: Sequence[Tuple[str, str]]
+) -> Table:
+    """GROUP BY keys, computing aggs = [(value_col_name, how), ...].
+
+    Returns a Table of unique keys followed by one column per agg named
+    ``{col}_{how}``. Row order is key-sorted (callers needing original
+    first-appearance order can re-sort; SQL imposes none).
+    """
+    n = keys.num_rows
+    order = sorted_order(keys)
+    seg, num = _segment_ids(keys, order)
+
+    first_of_group = jnp.searchsorted(seg, jnp.arange(num, dtype=jnp.int32), side="left")
+    out_keys = gather(keys, order[first_of_group] if n else jnp.zeros((0,), jnp.int32))
+
+    out_cols: List[Column] = list(out_keys.columns)
+    out_names: List[str] = list(out_keys.names)
+    for col_name, how in aggs:
+        col = values.column(col_name)
+        out_cols.append(_agg_column(col, order, seg, num, how))
+        out_names.append(f"{col_name}_{how}")
+    return Table(out_cols, out_names)

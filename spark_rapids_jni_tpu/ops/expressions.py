@@ -1,0 +1,269 @@
+"""Expression evaluation over Tables (cudf AST / Spark expression tier).
+
+A small composable AST — column refs, literals, arithmetic, comparisons,
+boolean logic, null predicates — evaluated column-at-a-time with Spark
+SQL null semantics (null propagates through operators; AND/OR are
+three-valued-logic). The TPU shape: every node is a pure jnp map over
+[N] arrays, so an entire predicate/projection tree fuses into one XLA
+kernel at jit time.
+
+Example::
+
+    e = (col("qty") * col("price")).alias("revenue")
+    pred = (col("qty") > lit(5)) & ~col("returned").is_null()
+    revenue = e.evaluate(table)
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from ..columnar.dtype import DType, TypeId
+from . import bitutils
+
+__all__ = ["col", "lit", "Expression"]
+
+
+class _Value:
+    """Evaluated expression: floating data is carried as arithmetic values
+    (float_view) and re-bit-packed only at column materialization."""
+
+    __slots__ = ("data", "valid", "dtype")
+
+    def __init__(self, data, valid, dtype: Optional[DType]):
+        self.data = data
+        self.valid = valid  # None == all valid
+        self.dtype = dtype
+
+
+def _to_value(col_: Column) -> _Value:
+    d = col_.dtype
+    if d.id == TypeId.FLOAT64:
+        return _Value(bitutils.float_view(col_.data, d), col_.validity, d)
+    if d.id == TypeId.BOOL8:
+        return _Value(col_.data.astype(bool), col_.validity, d)
+    return _Value(col_.data, col_.validity, d)
+
+
+def _both_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class Expression:
+    def evaluate(self, table: Table) -> Column:
+        v = self._eval(table)
+        data = v.data
+        if isinstance(data, jnp.ndarray) and data.dtype == bool:
+            return Column(dt.BOOL8, data=data.astype(jnp.uint8), validity=v.valid)
+        if data.dtype in (jnp.float64, jnp.float32) and (
+            v.dtype is None or v.dtype.id == TypeId.FLOAT64
+        ):
+            return Column(dt.FLOAT64, data=bitutils.float_store(data.astype(jnp.float64) if bitutils.backend_has_f64() else data, dt.FLOAT64), validity=v.valid)
+        out_d = v.dtype if v.dtype is not None else _infer(data.dtype)
+        return Column(out_d, data=data, validity=v.valid)
+
+    def _eval(self, table: Table) -> _Value:
+        raise NotImplementedError
+
+    # -- operator sugar -----------------------------------------------------
+    def _bin(self, other, fn, bool_out=False):
+        return _BinOp(self, _wrap(other), fn, bool_out)
+
+    def __add__(self, o):
+        return self._bin(o, operator.add)
+
+    def __sub__(self, o):
+        return self._bin(o, operator.sub)
+
+    def __mul__(self, o):
+        return self._bin(o, operator.mul)
+
+    def __truediv__(self, o):
+        return _Div(self, _wrap(o))
+
+    def __mod__(self, o):
+        return self._bin(o, operator.mod)
+
+    def __eq__(self, o):  # noqa: A003
+        return self._bin(o, operator.eq, bool_out=True)
+
+    def __ne__(self, o):
+        return self._bin(o, operator.ne, bool_out=True)
+
+    def __lt__(self, o):
+        return self._bin(o, operator.lt, bool_out=True)
+
+    def __le__(self, o):
+        return self._bin(o, operator.le, bool_out=True)
+
+    def __gt__(self, o):
+        return self._bin(o, operator.gt, bool_out=True)
+
+    def __ge__(self, o):
+        return self._bin(o, operator.ge, bool_out=True)
+
+    def __and__(self, o):
+        return _And(self, _wrap(o))
+
+    def __or__(self, o):
+        return _Or(self, _wrap(o))
+
+    def __invert__(self):
+        return _Not(self)
+
+    def is_null(self):
+        return _IsNull(self, True)
+
+    def is_not_null(self):
+        return _IsNull(self, False)
+
+    def cast(self, d: DType):
+        return _Cast(self, d)
+
+    __hash__ = None
+
+
+class _ColumnRef(Expression):
+    def __init__(self, name: str):
+        self.name = name
+
+    def _eval(self, table: Table) -> _Value:
+        return _to_value(table.column(self.name))
+
+
+class _Literal(Expression):
+    def __init__(self, value):
+        self.value = value
+
+    def _eval(self, table: Table) -> _Value:
+        if self.value is None:
+            n = table.num_rows
+            return _Value(jnp.zeros((n,), jnp.int32), jnp.zeros((n,), bool), None)
+        return _Value(jnp.asarray(self.value), None, None)
+
+
+class _BinOp(Expression):
+    def __init__(self, a, b, fn, bool_out):
+        self.a, self.b, self.fn, self.bool_out = a, b, fn, bool_out
+
+    def _eval(self, table):
+        va, vb = self.a._eval(table), self.b._eval(table)
+        data = self.fn(va.data, vb.data)
+        d = None if self.bool_out else (va.dtype if va.dtype is not None else vb.dtype)
+        if d is not None and not d.is_fixed_width:
+            d = None
+        # arithmetic output dtype follows jnp promotion unless it matches input
+        if d is not None and not self.bool_out:
+            if data.dtype != d.jnp_dtype and not d.is_floating:
+                d = None
+        return _Value(data, _both_valid(va.valid, vb.valid), d)
+
+
+class _Div(Expression):
+    """SQL divide: always floating point, null on divide-by-zero."""
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def _eval(self, table):
+        va, vb = self.a._eval(table), self.b._eval(table)
+        denom = vb.data.astype(jnp.float32) if not bitutils.backend_has_f64() else vb.data.astype(jnp.float64)
+        zero = vb.data == 0
+        data = va.data / jnp.where(zero, 1, denom)
+        valid = _both_valid(va.valid, vb.valid)
+        valid = _both_valid(valid, ~zero)
+        return _Value(data, valid, dt.FLOAT64)
+
+
+class _And(Expression):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def _eval(self, table):
+        va, vb = self.a._eval(table), self.b._eval(table)
+        a, b = va.data.astype(bool), vb.data.astype(bool)
+        av = jnp.ones_like(a) if va.valid is None else va.valid
+        bv = jnp.ones_like(b) if vb.valid is None else vb.valid
+        data = a & b
+        # 3VL: false dominates null
+        valid = (av & bv) | (av & ~a) | (bv & ~b)
+        return _Value(data, valid, None)
+
+
+class _Or(Expression):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def _eval(self, table):
+        va, vb = self.a._eval(table), self.b._eval(table)
+        a, b = va.data.astype(bool), vb.data.astype(bool)
+        av = jnp.ones_like(a) if va.valid is None else va.valid
+        bv = jnp.ones_like(b) if vb.valid is None else vb.valid
+        data = a | b
+        valid = (av & bv) | (av & a) | (bv & b)  # true dominates null
+        return _Value(data, valid, None)
+
+
+class _Not(Expression):
+    def __init__(self, a):
+        self.a = a
+
+    def _eval(self, table):
+        v = self.a._eval(table)
+        return _Value(~v.data.astype(bool), v.valid, None)
+
+
+class _IsNull(Expression):
+    def __init__(self, a, want_null):
+        self.a, self.want_null = a, want_null
+
+    def _eval(self, table):
+        v = self.a._eval(table)
+        if v.valid is None:
+            shape = v.data.shape[:1]
+            res = jnp.zeros(shape, bool) if self.want_null else jnp.ones(shape, bool)
+        else:
+            res = ~v.valid if self.want_null else v.valid
+        return _Value(res, None, None)
+
+
+class _Cast(Expression):
+    def __init__(self, a, d: DType):
+        self.a, self.d = a, d
+
+    def _eval(self, table):
+        v = self.a._eval(table)
+        if self.d.is_floating:
+            target = jnp.float64 if bitutils.backend_has_f64() else jnp.float32
+            return _Value(v.data.astype(target), v.valid, self.d)
+        return _Value(v.data.astype(self.d.jnp_dtype), v.valid, self.d)
+
+
+def _infer(np_dtype) -> DType:
+    m = {
+        "int8": dt.INT8, "int16": dt.INT16, "int32": dt.INT32, "int64": dt.INT64,
+        "uint8": dt.UINT8, "uint16": dt.UINT16, "uint32": dt.UINT32, "uint64": dt.UINT64,
+        "float32": dt.FLOAT32, "float64": dt.FLOAT64, "bool": dt.BOOL8,
+    }
+    return m[str(np_dtype)]
+
+
+def _wrap(v) -> Expression:
+    return v if isinstance(v, Expression) else _Literal(v)
+
+
+def col(name: str) -> Expression:
+    return _ColumnRef(name)
+
+
+def lit(value) -> Expression:
+    return _Literal(value)

@@ -1,0 +1,143 @@
+"""Spark-compatible Murmur3 hashing (cudf hashing tier, SURVEY §2.8).
+
+Spark's Murmur3Hash (and cudf's MurmurHash3_32) hash each column value
+with the running hash as seed, default seed 42; ints are hashed as their
+4-byte block, longs/doubles as two blocks, strings per 4-byte chunk with
+tail handling. Used by hash_partition (the shuffle partitioner) and the
+join/groupby tier.
+
+Vectorized: block loops are unrolled per column width; string chunk
+count is the padded max length (static per batch).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..columnar import Column, Table
+from ..columnar.dtype import TypeId
+
+__all__ = ["murmur3_table", "hash_partition_map"]
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+
+def _rotl(x, r):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _mix_k(k):
+    k = k * _C1
+    k = _rotl(k, 15)
+    return k * _C2
+
+
+def _mix_h(h, k):
+    h = h ^ _mix_k(k)
+    h = _rotl(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h):
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> jnp.uint32(16))
+
+
+def _hash_fixed(col: Column, seed: jnp.ndarray) -> jnp.ndarray:
+    d = col.dtype
+    data = col.data
+    if d.id == TypeId.DECIMAL128:
+        words = [col.data[:, k] for k in range(4)]
+    elif d.size_bytes == 8 or d.id == TypeId.FLOAT64:
+        u = lax.bitcast_convert_type(data, jnp.uint32)  # [N, 2]
+        words = [u[:, 0], u[:, 1]]
+    elif d.size_bytes <= 4:
+        # promote small ints to a single 4-byte block (Spark hashes
+        # byte/short/int identically after widening to int)
+        if d.id == TypeId.BOOL8:
+            w = data.astype(jnp.uint32)
+        else:
+            udt = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32}.get(d.size_bytes)
+            signed = data.astype(jnp.int32) if d.is_signed or d.id == TypeId.BOOL8 else data
+            w = lax.bitcast_convert_type(signed.astype(jnp.int32), jnp.uint32)
+        words = [w]
+    else:
+        raise ValueError(f"cannot hash dtype {d!r}")
+
+    h = seed
+    for w in words:
+        h = _mix_h(h, w.astype(jnp.uint32))
+    h = h ^ jnp.uint32(4 * len(words))
+    return _fmix(h)
+
+
+def _hash_string(col: Column, seed: jnp.ndarray) -> jnp.ndarray:
+    offs = col.offsets
+    lens = offs[1:] - offs[:-1]
+    n = len(col)
+    max_len = max(int(jnp.max(lens)) if n else 0, 1)
+    pad4 = (max_len + 3) // 4 * 4
+    idx = offs[:-1, None] + jnp.arange(pad4, dtype=jnp.int32)[None, :]
+    inb = jnp.arange(pad4, dtype=jnp.int32)[None, :] < lens[:, None]
+    nchars = max(int(col.chars.shape[0]), 1)
+    chars = jnp.where(inb, col.chars[jnp.clip(idx, 0, nchars - 1)], 0).astype(jnp.uint32)
+
+    h = seed
+    nblocks = lens // 4
+    for b in range(pad4 // 4):
+        k = (
+            chars[:, 4 * b]
+            | (chars[:, 4 * b + 1] << jnp.uint32(8))
+            | (chars[:, 4 * b + 2] << jnp.uint32(16))
+            | (chars[:, 4 * b + 3] << jnp.uint32(24))
+        )
+        h = jnp.where(b < nblocks, _mix_h(h, k), h)
+
+    # tail: remaining 1-3 bytes, mixed k1-style without the h-mix
+    tail_start = (nblocks * 4).astype(jnp.int32)
+    tail_len = lens - tail_start
+    k1 = jnp.zeros((n,), jnp.uint32)
+    for t in (2, 1, 0):
+        byte = jnp.take_along_axis(
+            chars, jnp.clip(tail_start + t, 0, pad4 - 1)[:, None], axis=1
+        )[:, 0]
+        k1 = jnp.where(tail_len > t, (k1 << jnp.uint32(8)) | byte, k1)
+    h = jnp.where(tail_len > 0, h ^ _mix_k(k1), h)
+
+    h = h ^ lens.astype(jnp.uint32)
+    return _fmix(h)
+
+
+def murmur3_table(table_or_cols, seed: int = 42) -> jnp.ndarray:
+    """[N] uint32 row hashes; columns chain with h as the next seed
+    (Spark Murmur3Hash semantics)."""
+    cols: Sequence[Column] = (
+        table_or_cols.columns if isinstance(table_or_cols, Table) else list(table_or_cols)
+    )
+    n = len(cols[0])
+    h = jnp.full((n,), seed, jnp.uint32)
+    for col in cols:
+        if col.dtype.id == TypeId.STRING:
+            nh = _hash_string(col, h)
+        else:
+            nh = _hash_fixed(col, h)
+        # null values leave the running hash unchanged (Spark semantics)
+        if col.validity is not None:
+            nh = jnp.where(col.validity, nh, h)
+        h = nh
+    return h
+
+
+def hash_partition_map(table_or_cols, num_partitions: int, seed: int = 42) -> jnp.ndarray:
+    """[N] int32 partition of each row: pmod(murmur3, num_partitions)."""
+    h = murmur3_table(table_or_cols, seed)
+    signed = lax.bitcast_convert_type(h, jnp.int32)
+    m = signed % jnp.int32(num_partitions)
+    return jnp.where(m < 0, m + num_partitions, m)

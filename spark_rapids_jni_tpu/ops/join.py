@@ -1,0 +1,120 @@
+"""Equi-join tier (cudf hash join, SURVEY §2.8) — inner / left joins.
+
+TPU-first: XLA has no device hash table, so the join is the canonical
+sort-probe formulation:
+
+1. factorize both sides' key rows into dense ids by sorting the
+   concatenated key table once (shared total-order key machinery),
+2. sort the right side's ids; probe each left id with two searchsorted
+   calls giving its match range [lo, hi),
+3. expand match ranges into (left_idx, right_idx) gather-map pairs with
+   a cumsum + searchsorted enumeration (the output-size host sync every
+   join implementation pays at allocation time).
+
+SQL semantics: null keys never match (inner rows dropped; left rows
+survive with null right side).
+
+Returns cudf-style gather maps; ``inner_join``/``left_join`` build the
+joined Table via ops.copying.gather with NULLIFY bounds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..columnar.dtype import TypeId
+from .aggregate import _segment_ids
+from .copying import concatenate, gather, gather_column
+from .sort import sorted_order
+
+__all__ = ["join_gather_maps", "inner_join", "left_join"]
+
+
+def _factorize(left_keys: Table, right_keys: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense group ids for each row of both sides (equal keys <-> equal id)."""
+    nl, nr = left_keys.num_rows, right_keys.num_rows
+    both = concatenate([left_keys, right_keys])
+    order = sorted_order(both)
+    seg, _num = _segment_ids(both, order)
+    ids = jnp.zeros((nl + nr,), jnp.int32).at[order].set(seg)
+    return ids[:nl], ids[nl:]
+
+
+def _any_null(keys: Table) -> Optional[jnp.ndarray]:
+    m = None
+    for c in keys.columns:
+        if c.validity is not None:
+            bad = ~c.validity
+            m = bad if m is None else (m | bad)
+    return m
+
+
+def join_gather_maps(
+    left_keys: Table, right_keys: Table, how: str = "inner"
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(left_idx, right_idx) gather maps; right_idx == -1 marks the
+    null-extended rows of a left join."""
+    if how not in ("inner", "left"):
+        raise ValueError(f"unsupported join type {how!r}")
+    nl, nr = left_keys.num_rows, right_keys.num_rows
+    lid, rid = _factorize(left_keys, right_keys)
+
+    lnull = _any_null(left_keys)
+    rnull = _any_null(right_keys)
+    if rnull is not None:
+        # null right keys can never match: pull them out of the probe set
+        rid = jnp.where(rnull, jnp.int32(-1), rid)
+
+    r_order = jnp.argsort(rid).astype(jnp.int32)
+    rid_sorted = rid[r_order]
+
+    probe_id = lid if lnull is None else jnp.where(lnull, jnp.int32(-2), lid)
+    lo = jnp.searchsorted(rid_sorted, probe_id, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rid_sorted, probe_id, side="right").astype(jnp.int32)
+    counts = hi - lo
+
+    if how == "left":
+        counts = jnp.maximum(counts, 1)
+
+    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    total = int(cum[-1])  # host sync: output size
+    if total == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z
+    pair = jnp.arange(total, dtype=jnp.int32)
+    lrow = jnp.searchsorted(cum, pair, side="right").astype(jnp.int32) - 1
+    within = pair - cum[lrow]
+    matched = (hi - lo)[lrow] > 0
+    rpos = jnp.where(matched, lo[lrow] + within, jnp.int32(-1))
+    rrow = jnp.where(rpos >= 0, r_order[jnp.clip(rpos, 0, max(nr - 1, 0))], jnp.int32(-1))
+    return lrow, rrow
+
+
+def _joined_table(
+    left: Table, right: Table, lmap, rmap, on: Sequence[str], keep_right_on: bool
+) -> Table:
+    cols: List[Column] = []
+    names: List[str] = []
+    for name, col in zip(left.names, left.columns):
+        cols.append(gather_column(col, lmap))
+        names.append(name)
+    for name, col in zip(right.names, right.columns):
+        if not keep_right_on and name in on:
+            continue
+        cols.append(gather_column(col, rmap, check_bounds=True))
+        names.append(name)
+    return Table(cols, names)
+
+
+def inner_join(left: Table, right: Table, on: Sequence[str]) -> Table:
+    lmap, rmap = join_gather_maps(left.select(on), right.select(on), "inner")
+    return _joined_table(left, right, lmap, rmap, list(on), keep_right_on=False)
+
+
+def left_join(left: Table, right: Table, on: Sequence[str]) -> Table:
+    lmap, rmap = join_gather_maps(left.select(on), right.select(on), "left")
+    return _joined_table(left, right, lmap, rmap, list(on), keep_right_on=False)

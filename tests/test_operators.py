@@ -1,0 +1,275 @@
+"""Query-operator tier tests: gather/filter/sort/hash/groupby/join/expr.
+
+pandas is the oracle for the relational semantics (it shares SQL's
+null-handling for the cases under test).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.ops import copying, hashing, sort
+from spark_rapids_jni_tpu.ops.aggregate import groupby_aggregate
+from spark_rapids_jni_tpu.ops.expressions import col, lit
+from spark_rapids_jni_tpu.ops.join import inner_join, left_join
+
+
+def make_table(**cols):
+    names, columns = [], []
+    for name, (vals, d) in cols.items():
+        names.append(name)
+        columns.append(Column.from_pylist(vals, d))
+    return Table(columns, names)
+
+
+# ---------------------------------------------------------------------------
+# copying
+# ---------------------------------------------------------------------------
+
+
+def test_gather_fixed_and_string():
+    t = make_table(
+        a=([10, 20, 30, 40], dt.INT32),
+        s=(["aa", "b", None, "dddd"], dt.STRING),
+    )
+    g = copying.gather(t, jnp.asarray([3, 0, 0, 2], jnp.int32))
+    assert g.column("a").to_pylist() == [40, 10, 10, 30]
+    assert g.column("s").to_pylist() == ["dddd", "aa", "aa", None]
+
+
+def test_gather_bounds_nullify():
+    t = make_table(a=([1, 2], dt.INT32))
+    g = copying.gather(t, jnp.asarray([0, 5, -1], jnp.int32), check_bounds=True)
+    assert g.column("a").to_pylist() == [1, None, None]
+
+
+def test_apply_boolean_mask():
+    t = make_table(a=([1, 2, 3, 4, 5], dt.INT32), s=(["a", "b", "c", "d", "e"], dt.STRING))
+    m = Column.from_pylist([True, False, None, True, False], dt.BOOL8)
+    f = copying.apply_boolean_mask(t, m)
+    assert f.column("a").to_pylist() == [1, 4]
+    assert f.column("s").to_pylist() == ["a", "d"]
+
+
+def test_concatenate():
+    t1 = make_table(a=([1, 2], dt.INT32), s=(["x", None], dt.STRING))
+    t2 = make_table(a=([3], dt.INT32), s=(["yz"], dt.STRING))
+    c = copying.concatenate([t1, t2])
+    assert c.column("a").to_pylist() == [1, 2, 3]
+    assert c.column("s").to_pylist() == ["x", None, "yz"]
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+
+def test_sort_multi_key_with_nulls(rng):
+    a = [3, 1, None, 2, 1, None, 3]
+    b = [1.5, -2.0, 0.0, None, 7.25, 1.0, -1.5]
+    t = make_table(a=(a, dt.INT32), b=(b, dt.FLOAT64))
+    order = np.asarray(sort.sorted_order(t))
+    df = pd.DataFrame({"a": a, "b": b})
+    expected = df.sort_values(["a", "b"], na_position="first", kind="stable").index.tolist()
+    # nulls_first=True for both; pandas puts NaN per-key: emulate by ranking
+    key_a = [(-1 if v is None else v) for v in a]
+    key_b = [(-np.inf if v is None else v) for v in b]
+    expected = sorted(range(len(a)), key=lambda i: (key_a[i], key_b[i]))
+    assert order.tolist() == expected
+
+
+def test_sort_descending():
+    t = make_table(a=([5, 1, 9, 3], dt.INT64))
+    order = np.asarray(sort.sorted_order(t, ascending=[False]))
+    assert order.tolist() == [2, 0, 3, 1]
+
+
+def test_sort_float64_exact_order():
+    vals = [1e300, -1e300, 1.0 + 2**-50, 1.0, -0.0, 0.0, 5e-324]
+    t = make_table(a=(vals, dt.FLOAT64))
+    order = np.asarray(sort.sorted_order(t))
+    got = [vals[i] for i in order]
+    assert got == sorted(vals)
+
+
+def test_sort_strings():
+    s = ["pear", "apple", None, "banana", "app"]
+    t = make_table(s=(s, dt.STRING))
+    order = np.asarray(sort.sorted_order(t))
+    got = [s[i] for i in order]
+    assert got == [None, "app", "apple", "banana", "pear"]
+
+
+# ---------------------------------------------------------------------------
+# hashing
+# ---------------------------------------------------------------------------
+
+
+def test_murmur3_deterministic_and_spread():
+    t = make_table(a=(list(range(1000)), dt.INT32))
+    h1 = np.asarray(hashing.murmur3_table(t))
+    h2 = np.asarray(hashing.murmur3_table(t))
+    assert (h1 == h2).all()
+    assert len(np.unique(h1)) > 990  # good dispersion
+
+
+def _mm3_oracle(v, seed=42):
+    """Murmur3_x86_32 hashInt, pure python (Spark Murmur3Hash semantics)."""
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    k = (v & M) * 0xCC9E2D51 & M
+    k = rotl(k, 15) * 0x1B873593 & M
+    h = (rotl(seed ^ k, 13) * 5 + 0xE6546B64) & M
+    h ^= 4
+    h ^= h >> 16
+    h = h * 0x85EBCA6B & M
+    h ^= h >> 13
+    h = h * 0xC2B2AE35 & M
+    return h ^ (h >> 16)
+
+
+def test_murmur3_int_oracle_values():
+    vals = [0, 1, -1, 42, 2**31 - 1]
+    t = make_table(a=(vals, dt.INT32))
+    h = np.asarray(hashing.murmur3_table(t))
+    assert h.tolist() == [_mm3_oracle(v) for v in vals]
+
+
+def test_hash_partition_map_balanced():
+    t = make_table(a=(list(range(10000)), dt.INT64))
+    p = np.asarray(hashing.hash_partition_map(t, 8))
+    counts = np.bincount(p, minlength=8)
+    assert (p >= 0).all() and (p < 8).all()
+    assert counts.min() > 1000  # roughly balanced
+
+
+# ---------------------------------------------------------------------------
+# groupby
+# ---------------------------------------------------------------------------
+
+
+def test_groupby_sum_count_minmax(rng):
+    keys = [int(k) for k in rng.integers(0, 7, 200)]
+    vals = [float(v) for v in rng.normal(size=200)]
+    some_null = [v if i % 13 else None for i, v in enumerate(vals)]
+    t_keys = make_table(k=(keys, dt.INT32))
+    t_vals = make_table(v=(some_null, dt.FLOAT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "sum"), ("v", "count"), ("v", "min"), ("v", "max")])
+
+    df = pd.DataFrame({"k": keys, "v": some_null})
+    exp = df.groupby("k")["v"].agg(["sum", "count", "min", "max"]).reset_index()
+    assert out.column("k").to_pylist() == exp["k"].tolist()
+    np.testing.assert_allclose(out.column("v_sum").to_pylist(), exp["sum"], rtol=1e-6)
+    assert out.column("v_count").to_pylist() == exp["count"].tolist()
+    np.testing.assert_allclose(out.column("v_min").to_pylist(), exp["min"], rtol=0)
+    np.testing.assert_allclose(out.column("v_max").to_pylist(), exp["max"], rtol=0)
+
+
+def test_groupby_int64_sum_exact():
+    t_keys = make_table(k=(["a", "b", "a", "b", "a"], dt.STRING))
+    t_vals = make_table(v=([2**40, 1, 2**40, 2, 5], dt.INT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "sum")])
+    assert out.column("k").to_pylist() == ["a", "b"]
+    assert out.column("v_sum").to_pylist() == [2**41 + 5, 3]
+
+
+def test_groupby_null_keys_group_together():
+    t_keys = make_table(k=([1, None, 1, None], dt.INT32))
+    t_vals = make_table(v=([1, 2, 3, 4], dt.INT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "sum")])
+    assert out.column("k").to_pylist() == [None, 1]
+    assert out.column("v_sum").to_pylist() == [6, 4]
+
+
+def test_groupby_count_all_vs_count():
+    t_keys = make_table(k=([1, 1, 2], dt.INT32))
+    t_vals = make_table(v=([None, 5, None], dt.INT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "count"), ("v", "count_all")])
+    assert out.column("v_count").to_pylist() == [1, 0]
+    assert out.column("v_count_all").to_pylist() == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+
+def test_inner_join_duplicates():
+    left = make_table(k=([1, 2, 2, 3], dt.INT32), lv=([10, 20, 21, 30], dt.INT64))
+    right = make_table(k=([2, 2, 4, 1], dt.INT32), rv=([200, 201, 400, 100], dt.INT64))
+    out = inner_join(left, right, ["k"])
+    df = pd.merge(
+        pd.DataFrame({"k": [1, 2, 2, 3], "lv": [10, 20, 21, 30]}),
+        pd.DataFrame({"k": [2, 2, 4, 1], "rv": [200, 201, 400, 100]}),
+        on="k",
+    )
+    got = sorted(zip(out.column("k").to_pylist(), out.column("lv").to_pylist(),
+                     out.column("rv").to_pylist()))
+    exp = sorted(zip(df["k"], df["lv"], df["rv"]))
+    assert got == exp
+
+
+def test_left_join_unmatched_null():
+    left = make_table(k=([1, 5], dt.INT32), lv=([10, 50], dt.INT64))
+    right = make_table(k=([1], dt.INT32), rv=([100], dt.INT64))
+    out = left_join(left, right, ["k"])
+    rows = sorted(zip(out.column("k").to_pylist(), out.column("lv").to_pylist(),
+                      out.column("rv").to_pylist()))
+    assert rows == [(1, 10, 100), (5, 50, None)]
+
+
+def test_join_null_keys_never_match():
+    left = make_table(k=([None, 1], dt.INT32), lv=([1, 2], dt.INT64))
+    right = make_table(k=([None, 1], dt.INT32), rv=([3, 4], dt.INT64))
+    out = inner_join(left, right, ["k"])
+    assert out.num_rows == 1
+    assert out.column("k").to_pylist() == [1]
+
+
+def test_join_string_keys():
+    left = make_table(k=(["apple", "pear", "fig"], dt.STRING), lv=([1, 2, 3], dt.INT64))
+    right = make_table(k=(["fig", "apple"], dt.STRING), rv=([30, 10], dt.INT64))
+    out = inner_join(left, right, ["k"])
+    rows = sorted(zip(out.column("k").to_pylist(), out.column("rv").to_pylist()))
+    assert rows == [("apple", 10), ("fig", 30)]
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+
+def test_expression_arithmetic_and_compare():
+    t = make_table(q=([1, 6, 3, None], dt.INT64), p=([2.0, 0.5, 1.0, 4.0], dt.FLOAT64))
+    revenue = (col("q").cast(dt.FLOAT64) * col("p")).evaluate(t)
+    assert revenue.to_pylist()[:3] == [2.0, 3.0, 3.0]
+    assert revenue.to_pylist()[3] is None
+
+    pred = ((col("q") > lit(2)) & col("q").is_not_null()).evaluate(t)
+    assert pred.to_pylist() == [False, True, True, False]
+
+
+def test_expression_three_valued_logic():
+    t = make_table(a=([True, False, None], dt.BOOL8))
+    # null AND false == false; null OR true == true
+    f = (col("a") & lit(False)).evaluate(t)
+    assert f.to_pylist() == [False, False, False]
+    tr = (col("a") | lit(True)).evaluate(t)
+    assert tr.to_pylist() == [True, True, True]
+    n = (col("a") & lit(True)).evaluate(t)
+    assert n.to_pylist() == [True, False, None]
+
+
+def test_expression_divide_by_zero_null():
+    t = make_table(a=([4, 9], dt.INT64), b=([2, 0], dt.INT64))
+    r = (col("a") / col("b")).evaluate(t)
+    vals = r.to_pylist()
+    assert vals[0] == 2.0
+    assert vals[1] is None
