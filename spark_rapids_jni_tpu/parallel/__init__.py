@@ -1,0 +1,12 @@
+"""Parallel tier: device mesh, executor binding, ICI exchange.
+
+The reference delegates all distribution upward to Spark (SURVEY §2.9):
+its only multi-device machinery is per-call ``auto_set_device`` and
+per-thread CUDA streams, with the UCX shuffle living in the plugin.
+Here the exchange is first-class and TPU-native: ``jax.sharding.Mesh``
+over ICI (with a DCN outer axis for multi-pod), ``shard_map`` +
+``lax.all_to_all`` for the repartition collective, and static-shape
+bucket framing so the whole shuffle compiles into one XLA program.
+"""
+
+from . import device, distributed, mesh, shuffle  # noqa: F401

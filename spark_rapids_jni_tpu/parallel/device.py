@@ -1,0 +1,46 @@
+"""Executor <-> device binding: the ``auto_set_device`` analog.
+
+The reference binds every JNI call to the executor's GPU via
+``cudf::jni::auto_set_device(env)`` (RowConversionJni.cpp:29 et al,
+SURVEY §2.9). The TPU analog: each Spark executor process owns one PJRT
+device; ops dispatch under ``jax.default_device``. PTDS (per-thread
+streams) maps onto XLA's async dispatch — each executor task thread
+enqueues independently, the runtime orders by data dependence.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["device_for_executor", "bind_executor", "current_device"]
+
+_local = threading.local()
+
+
+def device_for_executor(executor_id: int):
+    """Deterministic executor -> device mapping (round robin over local
+    devices, as Spark maps executors to GPUs by ordinal)."""
+    devs = jax.local_devices()
+    return devs[executor_id % len(devs)]
+
+
+@contextlib.contextmanager
+def bind_executor(executor_id: int):
+    """Scope ops to this executor's device; reentrant per thread."""
+    dev = device_for_executor(executor_id)
+    prev = getattr(_local, "device", None)
+    _local.device = dev
+    try:
+        with jax.default_device(dev):
+            yield dev
+    finally:
+        _local.device = prev
+
+
+def current_device():
+    dev = getattr(_local, "device", None)
+    return dev if dev is not None else jax.local_devices()[0]

@@ -1,0 +1,128 @@
+"""Distributed relational ops over the mesh: the 1M-row GROUP BY SUM
+stepping stone (BASELINE.json configs[0]) end to end on ICI.
+
+Pipeline (all one XLA program under shard_map — zero host round-trips
+between stages):
+
+1. hash each shard's local key rows -> destination shard (pmod),
+2. all_to_all bucket exchange (parallel/shuffle framing),
+3. static-capacity local groupby on each shard: sort received rows,
+   segment-reduce into a fixed [capacity] accumulator (XLA-friendly
+   replacement for a hash table),
+4. tiny host-side compaction of the [n_shards, capacity] partials.
+
+``shard_groupby_sum`` is the static-shape groupby usable inside
+``shard_map`` (the jit-safe sibling of ops.aggregate.groupby_aggregate,
+which host-syncs its group count).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from ..ops.hashing import _fmix, _mix_h  # murmur building blocks
+from .shuffle import _bucketize
+
+__all__ = ["shard_groupby_sum", "distributed_groupby_sum"]
+
+
+def _hash_dest(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
+    """Murmur3(int64 key) pmod n_parts — same dispersion as the
+    single-device partitioner, jit-safe on raw arrays."""
+    u = keys.astype(jnp.uint64)
+    h = jnp.full(keys.shape, 42, jnp.uint32)
+    h = _mix_h(h, (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+    h = _mix_h(h, (u >> jnp.uint64(32)).astype(jnp.uint32))
+    h = _fmix(h ^ jnp.uint32(8))
+    signed = lax.bitcast_convert_type(h, jnp.int32)
+    m = signed % jnp.int32(n_parts)
+    return jnp.where(m < 0, m + n_parts, m)
+
+
+def shard_groupby_sum(
+    keys: jnp.ndarray,  # [n] int key lanes (one column, int32/int64)
+    vals: jnp.ndarray,  # [n] numeric values
+    present: jnp.ndarray,  # [n] bool occupancy (exchange padding mask)
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Static-shape groupby-sum: returns (keys[capacity], sums[capacity],
+    group_valid[capacity], overflow[]). Absent rows are excluded; group
+    count beyond capacity flags overflow."""
+    big = jnp.iinfo(keys.dtype).max
+    k_eff = jnp.where(present, keys, big)  # padding sorts to the end
+    order = jnp.argsort(k_eff)
+    ks = k_eff[order]
+    vs = jnp.where(present, vals, 0)[order]
+    ps = present[order]
+
+    n = keys.shape[0]
+    new_seg = jnp.concatenate([jnp.ones((1,), bool), ks[1:] != ks[:-1]]) & ps
+    seg = jnp.cumsum(new_seg).astype(jnp.int32) - 1  # -1 for leading absent rows
+    num_groups = jnp.maximum(seg[-1] + 1, 0)
+    overflow = num_groups > capacity
+    seg = jnp.where(ps, jnp.clip(seg, 0, capacity - 1), capacity)  # drop absent
+
+    sums = jax.ops.segment_sum(vs, seg, num_segments=capacity + 1)[:capacity]
+    out_keys = jnp.zeros((capacity,), keys.dtype).at[seg].set(ks, mode="drop")
+    group_valid = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+    return out_keys, sums, group_valid, overflow
+
+
+def distributed_groupby_sum(
+    keys: jnp.ndarray,  # [N_global] int64/int32 keys, row-sharded
+    vals: jnp.ndarray,  # [N_global] values, row-sharded
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+    group_capacity: Optional[int] = None,
+):
+    """GROUP BY key SUM(val) across the mesh. Returns (keys, sums) as a
+    host pair of np arrays (compacted), plus an overflow flag.
+
+    One compiled program: pmod partition -> all_to_all -> per-shard
+    sort+segment-reduce. capacity = per-destination bucket rows;
+    group_capacity = max distinct keys per shard.
+    """
+    n_parts = mesh.shape[axis]
+    n_global = keys.shape[0]
+    per_shard = n_global // n_parts
+    if capacity is None:
+        capacity = per_shard
+    if group_capacity is None:
+        group_capacity = capacity * n_parts
+
+    cap_g = int(group_capacity)
+
+    def body(k, v):
+        dest = _hash_dest(k, n_parts)
+        kb, mask, ovf1 = _bucketize(k, dest, n_parts, capacity)
+        vb, _, _ = _bucketize(v, dest, n_parts, capacity)
+        kr = lax.all_to_all(kb, axis, split_axis=0, concat_axis=0, tiled=True)
+        vr = lax.all_to_all(vb, axis, split_axis=0, concat_axis=0, tiled=True)
+        mr = lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True)
+        gk, gs, gv, ovf2 = shard_groupby_sum(
+            kr.reshape(-1), vr.reshape(-1), mr.reshape(-1), cap_g
+        )
+        return gk[None], gs[None], gv[None], (ovf1 | ovf2)[None]
+
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    )
+    gk, gs, gv, ovf = f(keys, vals)
+
+    gk_h = np.asarray(gk).reshape(-1)
+    gs_h = np.asarray(gs).reshape(-1)
+    gv_h = np.asarray(gv).reshape(-1)
+    keep = gv_h
+    return gk_h[keep], gs_h[keep], bool(np.asarray(ovf).any())

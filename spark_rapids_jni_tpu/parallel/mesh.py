@@ -1,0 +1,70 @@
+"""Device mesh construction for single-chip to multi-pod topologies.
+
+The scaling recipe: pick a mesh, annotate shardings, let XLA insert the
+collectives. Axis convention (outer -> inner):
+
+- ``dcn``  : across pods/hosts (slow interconnect) — data parallel only
+- ``data`` : across chips on ICI — Spark-partition parallelism, the axis
+             the shuffle's all_to_all rides
+- ``model``: optional intra-op axis (large joins/aggs can shard the
+             build side across it)
+
+``make_mesh`` with no arguments gives the whole-process default: all
+devices on one ``data`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "row_sharding", "replicated", "shard_table_rows"]
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"data": len(devs)}
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    total = int(np.prod(shape))
+    if total != len(devs):
+        raise ValueError(f"mesh axes {axes} need {total} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(shape), names)
+
+
+def row_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Rows split along `axis`, other dims replicated."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_table_rows(table, mesh: Mesh, axis: str = "data"):
+    """Place each column's buffers row-sharded over the mesh axis.
+
+    Rows must divide the axis size (pad upstream); string columns keep
+    offsets/chars replicated (exchange of ragged payloads happens via
+    the dictionary/byte-matrix paths).
+    """
+    import jax
+
+    from ..columnar import Column, Table
+
+    sh = row_sharding(mesh, axis)
+    cols = []
+    for c in table.columns:
+        if c.data is not None:
+            data = jax.device_put(c.data, sh)
+            validity = None if c.validity is None else jax.device_put(c.validity, sh)
+            cols.append(Column(c.dtype, data=data, validity=validity))
+        else:
+            cols.append(c)
+    return Table(cols, table.names)

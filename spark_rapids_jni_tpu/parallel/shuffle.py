@@ -1,0 +1,146 @@
+"""Shuffle: hash partition + the ICI all-to-all exchange.
+
+Replaces the UCX/NVLink RapidsShuffleManager path (SURVEY §2.9, §5
+"distributed communication backend"): executor-partitioned row batches
+are repartitioned with ONE ``lax.all_to_all`` over the mesh's data axis
+inside ``shard_map`` — on-pod exchanges ride ICI; put a ``dcn`` outer
+axis on the mesh and XLA layers the collective across pods.
+
+Static-shape framing (XLA compiles one program, no data-dependent
+shapes): each shard scatters its rows into a [P, capacity] bucket
+matrix + occupancy mask, all_to_all swaps bucket axes, receivers get
+[P, capacity] from every peer. ``capacity`` bounds rows any shard may
+send to one destination; overflow is *detected* (per-shard flag, rows
+dropped deterministically) so callers size capacity like any shuffle
+buffer. Compaction back to dense rows happens host-side or in the
+consuming kernel via the mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..columnar import Column, Table
+from ..columnar.dtype import TypeId
+from ..ops.hashing import hash_partition_map
+from ..ops.sort import sorted_order
+from ..ops.copying import gather
+
+__all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
+
+
+def hash_partition(table: Table, num_partitions: int, key_cols: Sequence[str]) -> Tuple[Table, List[int]]:
+    """Single-device cudf-style hash_partition: rows reordered so each
+    partition is contiguous; returns (table, partition start offsets)."""
+    pmap = hash_partition_map([table.column(c) for c in key_cols], num_partitions)
+    order = jnp.argsort(pmap, stable=True).astype(jnp.int32)
+    out = gather(table, order)
+    counts = np.bincount(np.asarray(pmap), minlength=num_partitions)
+    offsets = np.concatenate([[0], np.cumsum(counts)])[:-1].tolist()
+    return out, offsets
+
+
+def _bucketize(vals: jnp.ndarray, dest: jnp.ndarray, n_parts: int, capacity: int):
+    """Per-shard scatter of [n] rows into [P, capacity] buckets.
+
+    Returns (buckets, mask, overflow). Rows beyond capacity for their
+    destination are dropped and flagged.
+    """
+    n = dest.shape[0]
+    order = jnp.argsort(dest)  # group rows by destination
+    d_sorted = dest[order]
+    # position within destination bucket: index along the sorted run
+    idx = jnp.arange(n, dtype=jnp.int32)
+    run_start = jnp.searchsorted(d_sorted, jnp.arange(n_parts, dtype=jnp.int32), side="left")
+    slot = idx - run_start[d_sorted]
+    overflow = jnp.any(slot >= capacity)
+    keep = slot < capacity
+    flat = d_sorted.astype(jnp.int32) * capacity + jnp.clip(slot, 0, capacity - 1)
+
+    shape = (n_parts * capacity,) + vals.shape[1:]
+    buckets = jnp.zeros(shape, vals.dtype)
+    buckets = buckets.at[flat].set(jnp.where(_bmask(keep, vals.ndim), vals[order], 0))
+    mask = jnp.zeros((n_parts * capacity,), bool).at[flat].set(keep)
+    return (
+        buckets.reshape((n_parts, capacity) + vals.shape[1:]),
+        mask.reshape(n_parts, capacity),
+        overflow,
+    )
+
+
+def _bmask(m, ndim):
+    return m.reshape(m.shape + (1,) * (ndim - 1))
+
+
+def all_to_all_exchange(
+    arrays: Sequence[jnp.ndarray],
+    dest: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+):
+    """Exchange row-sharded arrays so row i lands on shard dest[i].
+
+    arrays: row-sharded along `axis` ([N_global, ...] each); dest:
+    [N_global] int32 in [0, mesh axis size). Returns (received_arrays,
+    recv_mask, overflow): received arrays are [P * capacity * ...] per
+    shard, i.e. globally [N_shards, P, capacity, ...] flattened on the
+    leading axis, with recv_mask marking occupied slots.
+    """
+    n_parts = mesh.shape[axis]
+    n_global = dest.shape[0]
+    per_shard = n_global // n_parts
+    if capacity is None:
+        capacity = per_shard  # safe: one shard can absorb everything
+
+    def body(dest_local, *arrs):
+        outs = []
+        ovf = jnp.zeros((), bool)
+        mask = None
+        for a in arrs:
+            b, m, o = _bucketize(a, dest_local, n_parts, capacity)
+            # all_to_all: split axis 0 (destinations), concat received
+            r = lax.all_to_all(b, axis, split_axis=0, concat_axis=0, tiled=True)
+            outs.append(r)
+            ovf = ovf | o
+            mask = m
+        rm = lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True)
+        return tuple(outs) + (rm, ovf[None])
+
+    spec = P(axis)
+    in_specs = (spec,) + tuple(spec for _ in arrays)
+    out_specs = tuple(spec for _ in arrays) + (spec, spec)
+    f = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    *received, recv_mask, overflow = f(dest, *arrays)
+    return received, recv_mask, overflow
+
+
+def exchange_by_key(
+    table: Table,
+    key_cols: Sequence[str],
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+):
+    """Hash-repartition a row-sharded fixed-width Table over the mesh.
+
+    Returns (arrays_by_column, recv_mask, overflow); rows of one key all
+    land on the same shard (hash pmod, ops/hashing parity with the
+    single-device partitioner).
+    """
+    for c in table.columns:
+        if c.dtype.id in (TypeId.STRING, TypeId.LIST):
+            raise ValueError(
+                "exchange_by_key moves fixed-width payloads; dictionary-encode "
+                "strings before the exchange"
+            )
+    dest = hash_partition_map([table.column(c) for c in key_cols], mesh.shape[axis])
+    arrays = [c.data for c in table.columns]
+    return all_to_all_exchange(arrays, dest.astype(jnp.int32), mesh, axis, capacity)
