@@ -1,0 +1,90 @@
+"""Benchmark: the BASELINE.json stepping-stone config[0] — single-table
+GROUP BY SUM over 1M rows — on the live device (TPU chip under the
+driver; CPU if forced), compared against the config's stated reference
+("CPU ColumnarBatch ref"): a numpy columnar groupby on this host.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference's nvbench discipline (SURVEY.md §6):
+deterministic seeded input, warmup compile excluded, steady-state
+median over repeated timed runs, rows/s reported.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+N_ROWS = 1 << 20  # 1M-row stepping stone
+N_KEYS = 4096  # distinct groups
+REPS = 20
+
+
+def _device_groupby(keys, vals, present, capacity):
+    from spark_rapids_jni_tpu.parallel.distributed import shard_groupby_sum
+
+    return shard_groupby_sum(keys, vals, present, capacity)
+
+
+def bench_device() -> float:
+    rng = np.random.default_rng(42)
+    keys_h = rng.integers(0, N_KEYS, N_ROWS).astype(np.int64)
+    vals_h = rng.standard_normal(N_ROWS).astype(np.float32)
+
+    keys = jnp.asarray(keys_h)
+    vals = jnp.asarray(vals_h)
+    present = jnp.ones((N_ROWS,), bool)
+
+    fn = jax.jit(_device_groupby, static_argnums=(3,))
+    out = fn(keys, vals, present, N_KEYS * 2)  # warmup/compile
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(keys, vals, present, N_KEYS * 2)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_cpu_ref() -> float:
+    """CPU ColumnarBatch reference: numpy bincount groupby (the fastest
+    plain-columnar host implementation, favoring the baseline)."""
+    rng = np.random.default_rng(42)
+    keys_h = rng.integers(0, N_KEYS, N_ROWS).astype(np.int64)
+    vals_h = rng.standard_normal(N_ROWS).astype(np.float32)
+
+    np.bincount(keys_h, weights=vals_h, minlength=N_KEYS)  # warmup
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        np.bincount(keys_h, weights=vals_h, minlength=N_KEYS)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    t_dev = bench_device()
+    t_cpu = bench_cpu_ref()
+    mrows_s = (N_ROWS / t_dev) / 1e6
+    vs_baseline = t_cpu / t_dev  # >1 means faster than the CPU ref
+    print(
+        json.dumps(
+            {
+                "metric": "groupby_sum_1M_rows",
+                "value": round(mrows_s, 2),
+                "unit": "Mrows/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

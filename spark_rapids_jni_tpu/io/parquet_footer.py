@@ -439,6 +439,10 @@ def read_and_filter(
     meta = tc.read_struct(_extract_footer_bytes(buf))
 
     names, num_children, tags, parent_n = flatten_schema(schema)
+    if ignore_case:
+        # requested names fold at the API layer (ParquetFooter.java:207);
+        # footer-side names fold in _SchemaWalk.name
+        names = [n.lower() for n in names]
     pruner = build_pruner(names, num_children, tags, parent_n)
 
     schema_list = meta.get(_FMD_SCHEMA)

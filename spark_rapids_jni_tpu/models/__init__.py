@@ -1,0 +1,8 @@
+"""Benchmark "models": deterministic data generation and the
+BASELINE.json stepping-stone query pipelines (GROUP BY SUM, TPC-H q1/q6,
+TPC-DS q3/q95, XGBoost ETL->DMatrix). These are the workloads the
+reference's surrounding stack runs; here they are first-class so the
+framework can be benchmarked standalone, without a Spark driver.
+"""
+
+from . import datagen, tpch, tpcds  # noqa: F401

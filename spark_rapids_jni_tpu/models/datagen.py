@@ -1,0 +1,157 @@
+"""Deterministic random table generation — the benchmark datagen tier.
+
+TPU-native analog of the reference's nvbench input generator
+(src/main/cpp/benchmarks/common/generate_input.hpp:33-35, 55-63 and
+random_distribution_factory.cuh): seeded, per-type distribution profiles
+(UNIFORM / NORMAL / GEOMETRIC), default value ranges per type, string
+length distributions, null probability, and ``cycle_dtypes`` to build
+wide tables from a small type list. Generation happens host-side with
+numpy (like the reference, which generates on CPU and copies to device)
+and lands as device-resident Columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from ..columnar.dtype import DType, TypeId
+
+__all__ = [
+    "Distribution",
+    "Profile",
+    "create_random_column",
+    "create_random_table",
+    "cycle_dtypes",
+]
+
+
+class Distribution(enum.Enum):
+    UNIFORM = "uniform"
+    NORMAL = "normal"
+    GEOMETRIC = "geometric"
+
+
+@dataclasses.dataclass
+class Profile:
+    """Per-column generation profile (generate_input.hpp distribution_params)."""
+
+    distribution: Distribution = Distribution.UNIFORM
+    lower: Optional[float] = None
+    upper: Optional[float] = None
+    null_probability: float = 0.0
+    # string-only knobs
+    min_length: int = 0
+    max_length: int = 32
+
+
+# Default ranges per type id (generate_input.hpp:86-117 equivalents,
+# narrowed so sums stay exactly representable in the test oracles).
+_DEFAULT_RANGE = {
+    TypeId.INT8: (-100, 100),
+    TypeId.INT16: (-10_000, 10_000),
+    TypeId.INT32: (-1_000_000, 1_000_000),
+    TypeId.INT64: (-1_000_000_000, 1_000_000_000),
+    TypeId.UINT8: (0, 200),
+    TypeId.UINT16: (0, 20_000),
+    TypeId.UINT32: (0, 2_000_000),
+    TypeId.UINT64: (0, 2_000_000_000),
+    TypeId.FLOAT32: (-1000.0, 1000.0),
+    TypeId.FLOAT64: (-1000.0, 1000.0),
+    TypeId.BOOL8: (0, 1),
+    TypeId.TIMESTAMP_DAYS: (0, 20_000),
+    TypeId.DECIMAL32: (-(10**8), 10**8),
+    TypeId.DECIMAL64: (-(10**15), 10**15),
+}
+
+
+def _draw(rng: np.random.Generator, n: int, lo: float, hi: float, dist: Distribution) -> np.ndarray:
+    if dist is Distribution.UNIFORM:
+        return rng.uniform(lo, hi, n)
+    if dist is Distribution.NORMAL:
+        mid, spread = (lo + hi) / 2.0, max((hi - lo) / 6.0, 1e-9)
+        return np.clip(rng.normal(mid, spread, n), lo, hi)
+    if dist is Distribution.GEOMETRIC:
+        span = max(hi - lo, 1e-9)
+        g = rng.geometric(p=min(4.0 / span, 0.5), size=n).astype(np.float64)
+        return np.clip(lo + g, lo, hi)
+    raise ValueError(dist)
+
+
+def create_random_column(
+    d: DType, num_rows: int, rng: np.random.Generator, profile: Optional[Profile] = None
+) -> Column:
+    p = profile or Profile()
+    tid = d.id
+
+    validity = None
+    if p.null_probability > 0:
+        validity = jnp.asarray(rng.random(num_rows) >= p.null_probability)
+
+    if tid == TypeId.STRING:
+        lens = rng.integers(p.min_length, p.max_length + 1, num_rows).astype(np.int32)
+        offsets = np.zeros(num_rows + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        chars = rng.integers(97, 123, int(offsets[-1])).astype(np.uint8)  # a-z
+        return Column(d, validity=validity, offsets=jnp.asarray(offsets), chars=jnp.asarray(chars))
+
+    lo, hi = (p.lower, p.upper)
+    if lo is None or hi is None:
+        dlo, dhi = _DEFAULT_RANGE[tid]
+        lo = dlo if lo is None else lo
+        hi = dhi if hi is None else hi
+
+    raw = _draw(rng, num_rows, lo, hi, p.distribution)
+    if tid in (TypeId.FLOAT32, TypeId.FLOAT64):
+        np_dt = np.float32 if tid == TypeId.FLOAT32 else np.float64
+        from ..ops import bitutils
+
+        data = bitutils.float_store(jnp.asarray(raw.astype(np_dt)), d)
+        return Column(d, data=data, validity=validity)
+    if tid == TypeId.DECIMAL128:
+        ints = np.rint(raw).astype(np.int64)
+        limbs = np.zeros((num_rows, 4), np.uint32)
+        v = ints.astype(np.uint64)
+        limbs[:, 0] = (v & 0xFFFFFFFF).astype(np.uint32)
+        limbs[:, 1] = (v >> 32).astype(np.uint32)
+        sign = (ints < 0).astype(np.uint32) * 0xFFFFFFFF
+        limbs[:, 2] = sign
+        limbs[:, 3] = sign
+        return Column(d, data=jnp.asarray(limbs), validity=validity)
+
+    ints = np.rint(raw).astype(np.int64)
+    data = jnp.asarray(ints.astype(_np_of(d)))
+    return Column(d, data=data, validity=validity)
+
+
+def _np_of(d: DType):
+    return np.dtype(jnp.dtype(d.jnp_dtype).name)
+
+
+def cycle_dtypes(dtypes: Sequence[DType], num_cols: int) -> list:
+    """Reference benchmarks build wide tables by cycling a dtype list
+    (row_conversion.cpp:31-40)."""
+    return [dtypes[i % len(dtypes)] for i in range(num_cols)]
+
+
+def create_random_table(
+    dtypes: Sequence[DType],
+    num_rows: int,
+    seed: int = 42,
+    profiles: Optional[Dict[int, Profile]] = None,
+    names: Optional[Sequence[str]] = None,
+) -> Table:
+    """Deterministic random table: same (dtypes, num_rows, seed) ->
+    identical values on every host/run."""
+    rng = np.random.default_rng(seed)
+    cols = [
+        create_random_column(d, num_rows, rng, (profiles or {}).get(i))
+        for i, d in enumerate(dtypes)
+    ]
+    return Table(cols, names)

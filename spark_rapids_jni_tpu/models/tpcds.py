@@ -1,0 +1,188 @@
+"""TPC-DS stepping-stone queries (BASELINE.json configs[2]/[3]): q3
+(2-way hash join + sort) and q95 (multi-join with semi-join order
+filtering — the exchange-heavy shape). Dimension values that are strings
+in the spec are dictionary codes here (int lanes); the relational
+algebra — joins, semi-joins, grouped aggregates, order-by — is the part
+under test.
+
+Deterministic generators produce a coherent star schema at a row-count
+scale: foreign keys reference the generated dimension key ranges.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, Table
+from ..columnar import dtype as dt
+from ..ops import bitutils, copying
+from ..ops.aggregate import groupby_aggregate
+from ..ops.expressions import col, lit
+from ..ops.join import inner_join
+from ..ops.sort import sort_by_key
+
+__all__ = ["gen_store", "gen_web", "q3", "q95"]
+
+
+def _int_col(arr: np.ndarray, d=dt.INT32) -> Column:
+    return Column(d, data=jnp.asarray(arr.astype(np.dtype(jnp.dtype(d.jnp_dtype).name))))
+
+
+def _f64_col(arr: np.ndarray) -> Column:
+    return Column(dt.FLOAT64, data=bitutils.float_store(jnp.asarray(arr), dt.FLOAT64))
+
+
+def gen_store(num_sales: int, seed: int = 42) -> Dict[str, Table]:
+    """store_sales + date_dim + item star for q3."""
+    rng = np.random.default_rng(seed)
+    n_dates, n_items = 365 * 5, 1000
+
+    date_dim = Table(
+        [
+            _int_col(np.arange(n_dates)),  # d_date_sk
+            _int_col(1998 + np.arange(n_dates) // 365),  # d_year
+            _int_col(1 + (np.arange(n_dates) % 365) // 31),  # d_moy (approx calendar)
+        ],
+        ["d_date_sk", "d_year", "d_moy"],
+    )
+    item = Table(
+        [
+            _int_col(np.arange(n_items)),  # i_item_sk
+            _int_col(rng.integers(1, 1000, n_items)),  # i_manufact_id
+            _int_col(rng.integers(1, 500, n_items)),  # i_brand_id (dict code)
+        ],
+        ["i_item_sk", "i_manufact_id", "i_brand_id"],
+    )
+    store_sales = Table(
+        [
+            _int_col(rng.integers(0, n_dates, num_sales)),  # ss_sold_date_sk
+            _int_col(rng.integers(0, n_items, num_sales)),  # ss_item_sk
+            _f64_col(rng.uniform(1, 1000, num_sales).round(2)),  # ss_ext_sales_price
+        ],
+        ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"],
+    )
+    return {"store_sales": store_sales, "date_dim": date_dim, "item": item}
+
+
+def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Table:
+    """SELECT d_year, i_brand_id, sum(ss_ext_sales_price) sum_agg
+    FROM date_dim, store_sales, item
+    WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+      AND i_manufact_id = :m AND d_moy = :mo
+    GROUP BY d_year, i_brand_id
+    ORDER BY d_year, sum_agg DESC, i_brand_id
+    """
+    item = tables["item"]
+    keep_item = (col("i_manufact_id") == lit(np.int32(manufact_id))).evaluate(item)
+    item_f = copying.apply_boolean_mask(item, keep_item)
+
+    dates = tables["date_dim"]
+    keep_date = (col("d_moy") == lit(np.int32(month))).evaluate(dates)
+    dates_f = copying.apply_boolean_mask(dates, keep_date)
+
+    ss = tables["store_sales"]
+    # join small dims into the fact table (hash join, build = dim side)
+    j1 = _join_on_renamed(ss, dates_f, "ss_sold_date_sk", "d_date_sk", ["d_year"])
+    j2 = _join_on_renamed(j1, item_f, "ss_item_sk", "i_item_sk", ["i_brand_id"])
+
+    keys = j2.select(["d_year", "i_brand_id"])
+    vals = j2.select(["ss_ext_sales_price"])
+    agg = groupby_aggregate(keys, vals, [("ss_ext_sales_price", "sum")])
+    # ORDER BY d_year asc, sum desc, brand asc
+    order_keys = Table(
+        [agg.column("d_year"), agg.column("ss_ext_sales_price_sum"), agg.column("i_brand_id")],
+        ["d_year", "s", "b"],
+    )
+    return sort_by_key(agg, order_keys, ascending=[True, False, True])
+
+
+def _join_on_renamed(left: Table, right: Table, lkey: str, rkey: str, payload) -> Table:
+    """Join where key columns have different names: present the right
+    table with its key renamed to the left's."""
+    rsel = right.select([rkey] + list(payload))
+    rsel = Table(rsel.columns, [lkey] + list(payload))
+    return inner_join(left, rsel, on=[lkey])
+
+
+def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:
+    """web_sales + web_returns + date_dim for q95. Orders have 1-4 line
+    items; some span multiple warehouses; some are returned."""
+    rng = np.random.default_rng(seed)
+    n_orders = max(num_sales // 2, 1)
+    n_dates = 365 * 5
+
+    order_of_row = rng.integers(0, n_orders, num_sales)
+    web_sales = Table(
+        [
+            _int_col(order_of_row),  # ws_order_number
+            _int_col(rng.integers(0, 15, num_sales)),  # ws_warehouse_sk
+            _int_col(rng.integers(0, n_dates, num_sales)),  # ws_ship_date_sk
+            _f64_col(rng.uniform(1, 100, num_sales).round(2)),  # ws_ext_ship_cost
+            _f64_col(rng.uniform(-50, 200, num_sales).round(2)),  # ws_net_profit
+        ],
+        ["ws_order_number", "ws_warehouse_sk", "ws_ship_date_sk", "ws_ext_ship_cost", "ws_net_profit"],
+    )
+    returned = rng.choice(n_orders, size=max(n_orders // 10, 1), replace=False)
+    web_returns = Table([_int_col(returned)], ["wr_order_number"])
+    date_dim = Table([_int_col(np.arange(n_dates))], ["d_date_sk"])
+    return {"web_sales": web_sales, "web_returns": web_returns, "date_dim": date_dim}
+
+
+def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dict:
+    """Returned-order shipping report. SQL shape:
+
+        WITH ws_wh AS (SELECT ws_order_number FROM web_sales
+                       GROUP BY ws_order_number
+                       HAVING count(distinct ws_warehouse_sk) > 1)
+        SELECT count(distinct ws_order_number), sum(ws_ext_ship_cost),
+               sum(ws_net_profit)
+        FROM web_sales ws1
+        WHERE ws_ship_date_sk BETWEEN :lo AND :hi
+          AND ws_order_number IN (SELECT * FROM ws_wh)
+          AND ws_order_number IN (SELECT wr_order_number FROM web_returns)
+
+    Semi-joins run as inner joins against deduplicated key tables (the
+    plan spark-rapids produces for IN-subqueries after dedup).
+    """
+    ws = tables["web_sales"]
+
+    # ws_wh: orders shipped from >1 distinct warehouse == per-order
+    # min(warehouse) != max(warehouse)
+    per_order = groupby_aggregate(
+        ws.select(["ws_order_number"]),
+        ws.select(["ws_warehouse_sk"]),
+        [("ws_warehouse_sk", "min"), ("ws_warehouse_sk", "max")],
+    )
+    multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
+    ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
+
+    # returned orders, deduplicated
+    wr = tables["web_returns"]
+    wr_dedup = groupby_aggregate(
+        wr.select(["wr_order_number"]), wr.select(["wr_order_number"]), [("wr_order_number", "count_all")]
+    ).select(["wr_order_number"])
+    wr_dedup = Table(wr_dedup.columns, ["ws_order_number"])
+
+    pred = (
+        (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
+        & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
+    ).evaluate(ws)
+    ws1 = copying.apply_boolean_mask(ws, pred)
+    ws1 = inner_join(ws1, ws_wh, on=["ws_order_number"])  # semi: right is unique
+    ws1 = inner_join(ws1, wr_dedup, on=["ws_order_number"])
+
+    per = groupby_aggregate(
+        ws1.select(["ws_order_number"]),
+        ws1.select(["ws_ext_ship_cost", "ws_net_profit"]),
+        [("ws_ext_ship_cost", "sum"), ("ws_net_profit", "sum")],
+    )
+    ship = bitutils.float_view(per.column("ws_ext_ship_cost_sum").data, dt.FLOAT64)
+    prof = bitutils.float_view(per.column("ws_net_profit_sum").data, dt.FLOAT64)
+    return {
+        "order_count": int(per.num_rows),
+        "total_shipping_cost": float(np.asarray(jnp.sum(ship))),
+        "total_net_profit": float(np.asarray(jnp.sum(prof))),
+    }

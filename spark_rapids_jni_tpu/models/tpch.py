@@ -1,0 +1,132 @@
+"""TPC-H stepping-stone queries (BASELINE.json configs[1]): q1 and q6 —
+scan + filter + aggregate, no join. These are the first end-to-end
+pipelines the RAPIDS accelerator offloads wholesale; here each runs as
+one fused XLA program over device-resident Columns (expression eval ->
+boolean mask -> sort-based group aggregate), with no host round-trip
+between operators.
+
+Data: a deterministic `lineitem` generator at a row-count "scale". Flag
+columns are dictionary codes (int8), dates are TIMESTAMP_DAYS ints,
+money columns FLOAT64 (bit-stored; see columnar/dtype.py FLOAT64 note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..columnar import Table
+from ..columnar import dtype as dt
+from ..ops import copying
+from ..ops.aggregate import groupby_aggregate
+from ..ops.expressions import col, lit
+from ..ops.sort import sort_by_key
+from .datagen import Profile, create_random_table
+
+__all__ = ["gen_lineitem", "q1", "q6"]
+
+# l_returnflag codes: 0='A', 1='N', 2='R'; l_linestatus: 0='F', 1='O'
+_LINEITEM_SCHEMA = [
+    ("l_quantity", dt.FLOAT64, Profile(lower=1, upper=50)),
+    ("l_extendedprice", dt.FLOAT64, Profile(lower=900, upper=105_000)),
+    ("l_discount", dt.FLOAT64, Profile(lower=0.0, upper=0.1)),
+    ("l_tax", dt.FLOAT64, Profile(lower=0.0, upper=0.08)),
+    ("l_returnflag", dt.INT8, Profile(lower=0, upper=2)),
+    ("l_linestatus", dt.INT8, Profile(lower=0, upper=1)),
+    # days since 1992-01-01; TPC-H dates span 1992-01-01..1998-12-31 (~2557d)
+    ("l_shipdate", dt.TIMESTAMP_DAYS, Profile(lower=0, upper=2557)),
+]
+
+
+def gen_lineitem(num_rows: int, seed: int = 42) -> Table:
+    names = [n for n, _, _ in _LINEITEM_SCHEMA]
+    dtypes = [d for _, d, _ in _LINEITEM_SCHEMA]
+    profiles = {i: p for i, (_, _, p) in enumerate(_LINEITEM_SCHEMA)}
+    return create_random_table(dtypes, num_rows, seed=seed, profiles=profiles, names=names)
+
+
+# TPC-H dates as days since 1992-01-01 (the generator's epoch)
+_D_1998_09_02 = 2436  # 1998-12-01 minus 90 days
+_D_1994_01_01 = 731
+_D_1995_01_01 = 1096
+
+
+def q1(lineitem: Table, delta_days: int = 90) -> Table:
+    """Pricing summary report. SQL:
+
+        SELECT l_returnflag, l_linestatus, sum(qty), sum(price),
+               sum(price*(1-disc)), sum(price*(1-disc)*(1+tax)),
+               avg(qty), avg(price), avg(disc), count(*)
+        FROM lineitem WHERE l_shipdate <= date '1998-12-01' - delta days
+        GROUP BY l_returnflag, l_linestatus ORDER BY 1, 2
+    """
+    cutoff = 2526 - delta_days  # 1998-12-01 in generator-epoch days
+    pred = (col("l_shipdate") <= lit(np.int32(cutoff))).evaluate(lineitem)
+    t = copying.apply_boolean_mask(lineitem, pred)
+
+    disc_price = (col("l_extendedprice") * (lit(1.0) - col("l_discount"))).evaluate(t)
+    charge = (
+        col("l_extendedprice") * (lit(1.0) - col("l_discount")) * (lit(1.0) + col("l_tax"))
+    ).evaluate(t)
+    values = Table(
+        [
+            t.column("l_quantity"),
+            t.column("l_extendedprice"),
+            disc_price,
+            charge,
+            t.column("l_discount"),
+        ],
+        ["qty", "price", "disc_price", "charge", "disc"],
+    )
+    keys = t.select(["l_returnflag", "l_linestatus"])
+    out = groupby_aggregate(
+        keys,
+        values,
+        [
+            ("qty", "sum"),
+            ("price", "sum"),
+            ("disc_price", "sum"),
+            ("charge", "sum"),
+            ("qty", "mean"),
+            ("price", "mean"),
+            ("disc", "mean"),
+            ("qty", "count_all"),
+        ],
+    )
+    # groupby_aggregate returns key-sorted rows == ORDER BY 1, 2
+    return out
+
+
+def q6(lineitem: Table) -> float:
+    """Forecasting revenue change. SQL:
+
+        SELECT sum(l_extendedprice * l_discount) FROM lineitem
+        WHERE l_shipdate >= date '1994-01-01'
+          AND l_shipdate < date '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24
+
+    Returns the scalar revenue.
+    """
+    pred = (
+        (col("l_shipdate") >= lit(np.int32(_D_1994_01_01)))
+        & (col("l_shipdate") < lit(np.int32(_D_1995_01_01)))
+        & (col("l_discount") >= lit(0.05))
+        & (col("l_discount") <= lit(0.07))
+        & (col("l_quantity") < lit(24.0))
+    ).evaluate(lineitem)
+    t = copying.apply_boolean_mask(lineitem, pred)
+    revenue = (col("l_extendedprice") * col("l_discount")).evaluate(t)
+    ones = Table([revenue], ["revenue"])
+    # single-group aggregate: constant key
+    from ..columnar import Column
+    import jax.numpy as jnp
+
+    key = Table([Column(dt.INT8, data=jnp.zeros((t.num_rows,), jnp.int8))], ["g"])
+    out = groupby_aggregate(key, ones, [("revenue", "sum")])
+    if out.num_rows == 0:
+        return 0.0
+    from ..ops import bitutils
+
+    return float(np.asarray(bitutils.float_view(out.column("revenue_sum").data, dt.FLOAT64))[0])

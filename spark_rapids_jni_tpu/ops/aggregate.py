@@ -155,8 +155,8 @@ def _from_total_order(key: jnp.ndarray, d) -> jnp.ndarray:
         bits = jnp.where(neg, key ^ jnp.uint32(0xFFFFFFFF), key & ~jnp.uint32(1 << 31))
         return lax.bitcast_convert_type(bits, jnp.float32)
     if d.is_signed or d.np_dtype.kind == "i":
-        udt = key.dtype
-        return lax.bitcast_convert_type(key ^ (udt(1) << udt(8 * d.size_bytes - 1)), d.jnp_dtype)
+        sign_bit = jnp.asarray(1 << (8 * d.size_bytes - 1), dtype=key.dtype)
+        return lax.bitcast_convert_type(key ^ sign_bit, d.jnp_dtype)
     return key.astype(d.jnp_dtype)
 
 

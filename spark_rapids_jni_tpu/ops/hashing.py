@@ -20,7 +20,7 @@ from jax import lax
 from ..columnar import Column, Table
 from ..columnar.dtype import TypeId
 
-__all__ = ["murmur3_table", "hash_partition_map"]
+__all__ = ["murmur3_table", "murmur3_raw", "hash_partition_map"]
 
 _C1 = jnp.uint32(0xCC9E2D51)
 _C2 = jnp.uint32(0x1B873593)
@@ -133,6 +133,27 @@ def murmur3_table(table_or_cols, seed: int = 42) -> jnp.ndarray:
             nh = jnp.where(col.validity, nh, h)
         h = nh
     return h
+
+
+def murmur3_raw(data: jnp.ndarray, seed: int = 42) -> jnp.ndarray:
+    """[N] uint32 murmur3 over a raw integer array — identical result to
+    ``murmur3_table`` on a Column of the same width (4-byte values hash
+    as one block, 8-byte as two), for use inside shard_map where values
+    travel as bare arrays."""
+    n = data.shape[0]
+    h = jnp.full((n,), seed, jnp.uint32)
+    if data.dtype.itemsize == 8:
+        u = lax.bitcast_convert_type(data, jnp.uint32)  # [N, 2]
+        words = [u[:, 0], u[:, 1]]
+    elif data.dtype.itemsize <= 4:
+        signed = data.astype(jnp.int32) if jnp.issubdtype(data.dtype, jnp.signedinteger) else data
+        words = [lax.bitcast_convert_type(signed.astype(jnp.int32), jnp.uint32)]
+    else:
+        raise ValueError(f"cannot hash raw dtype {data.dtype}")
+    for w in words:
+        h = _mix_h(h, w.astype(jnp.uint32))
+    h = h ^ jnp.uint32(4 * len(words))
+    return _fmix(h)
 
 
 def hash_partition_map(table_or_cols, num_partitions: int, seed: int = 42) -> jnp.ndarray:

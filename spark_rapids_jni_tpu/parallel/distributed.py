@@ -26,22 +26,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..columnar import Column, Table
-from ..columnar import dtype as dt
-from ..ops.hashing import _fmix, _mix_h  # murmur building blocks
+from ..ops.hashing import murmur3_raw
 from .shuffle import _bucketize
 
 __all__ = ["shard_groupby_sum", "distributed_groupby_sum"]
 
 
 def _hash_dest(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
-    """Murmur3(int64 key) pmod n_parts — same dispersion as the
-    single-device partitioner, jit-safe on raw arrays."""
-    u = keys.astype(jnp.uint64)
-    h = jnp.full(keys.shape, 42, jnp.uint32)
-    h = _mix_h(h, (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
-    h = _mix_h(h, (u >> jnp.uint64(32)).astype(jnp.uint32))
-    h = _fmix(h ^ jnp.uint32(8))
+    """Murmur3(key) pmod n_parts — exact parity with the single-device
+    partitioner (hash_partition_map) for the same key width, jit-safe on
+    raw arrays inside shard_map."""
+    h = murmur3_raw(keys)
     signed = lax.bitcast_convert_type(h, jnp.int32)
     m = signed % jnp.int32(n_parts)
     return jnp.where(m < 0, m + n_parts, m)
@@ -56,14 +51,18 @@ def shard_groupby_sum(
     """Static-shape groupby-sum: returns (keys[capacity], sums[capacity],
     group_valid[capacity], overflow[]). Absent rows are excluded; group
     count beyond capacity flags overflow."""
-    big = jnp.iinfo(keys.dtype).max
-    k_eff = jnp.where(present, keys, big)  # padding sorts to the end
-    order = jnp.argsort(k_eff)
-    ks = k_eff[order]
+    # Sort by (absent-last, key): padding cannot collide with any real key
+    # value (even iinfo max) because occupancy is the primary sort key.
+    order = jnp.lexsort((keys, ~present))
+    ks = keys[order]
     vs = jnp.where(present, vals, 0)[order]
+    if jnp.issubdtype(vs.dtype, jnp.integer):
+        vs = vs.astype(jnp.int64)  # Spark integral-sum semantics, no wrap
     ps = present[order]
 
     n = keys.shape[0]
+    # present rows are contiguous at the front, so a segment starts at row 0
+    # or where the key changes; absent rows are masked out entirely
     new_seg = jnp.concatenate([jnp.ones((1,), bool), ks[1:] != ks[:-1]]) & ps
     seg = jnp.cumsum(new_seg).astype(jnp.int32) - 1  # -1 for leading absent rows
     num_groups = jnp.maximum(seg[-1] + 1, 0)

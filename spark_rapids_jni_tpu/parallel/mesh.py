@@ -54,8 +54,6 @@ def shard_table_rows(table, mesh: Mesh, axis: str = "data"):
     offsets/chars replicated (exchange of ragged payloads happens via
     the dictionary/byte-matrix paths).
     """
-    import jax
-
     from ..columnar import Column, Table
 
     sh = row_sharding(mesh, axis)

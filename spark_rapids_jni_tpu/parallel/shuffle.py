@@ -18,7 +18,6 @@ consuming kernel via the mask.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -27,10 +26,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..columnar import Column, Table
+from ..columnar import Table
 from ..columnar.dtype import TypeId
 from ..ops.hashing import hash_partition_map
-from ..ops.sort import sorted_order
 from ..ops.copying import gather
 
 __all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
@@ -62,21 +60,19 @@ def _bucketize(vals: jnp.ndarray, dest: jnp.ndarray, n_parts: int, capacity: int
     slot = idx - run_start[d_sorted]
     overflow = jnp.any(slot >= capacity)
     keep = slot < capacity
-    flat = d_sorted.astype(jnp.int32) * capacity + jnp.clip(slot, 0, capacity - 1)
+    # overflowing rows scatter out of range and are dropped (mode="drop"),
+    # never aliasing the legitimate occupant of the last slot
+    flat = jnp.where(keep, d_sorted.astype(jnp.int32) * capacity + slot, n_parts * capacity)
 
     shape = (n_parts * capacity,) + vals.shape[1:]
     buckets = jnp.zeros(shape, vals.dtype)
-    buckets = buckets.at[flat].set(jnp.where(_bmask(keep, vals.ndim), vals[order], 0))
-    mask = jnp.zeros((n_parts * capacity,), bool).at[flat].set(keep)
+    buckets = buckets.at[flat].set(vals[order], mode="drop")
+    mask = jnp.zeros((n_parts * capacity,), bool).at[flat].set(True, mode="drop")
     return (
         buckets.reshape((n_parts, capacity) + vals.shape[1:]),
         mask.reshape(n_parts, capacity),
         overflow,
     )
-
-
-def _bmask(m, ndim):
-    return m.reshape(m.shape + (1,) * (ndim - 1))
 
 
 def all_to_all_exchange(
@@ -131,8 +127,10 @@ def exchange_by_key(
 ):
     """Hash-repartition a row-sharded fixed-width Table over the mesh.
 
-    Returns (arrays_by_column, recv_mask, overflow); rows of one key all
-    land on the same shard (hash pmod, ops/hashing parity with the
+    Returns (pairs_by_column, recv_mask, overflow) where each pair is
+    (data, validity-or-None) — null masks travel with their column so
+    null rows stay null on the receiving shard. Rows of one key all land
+    on the same shard (hash pmod, ops/hashing parity with the
     single-device partitioner).
     """
     for c in table.columns:
@@ -142,5 +140,19 @@ def exchange_by_key(
                 "strings before the exchange"
             )
     dest = hash_partition_map([table.column(c) for c in key_cols], mesh.shape[axis])
-    arrays = [c.data for c in table.columns]
-    return all_to_all_exchange(arrays, dest.astype(jnp.int32), mesh, axis, capacity)
+    arrays: List[jnp.ndarray] = []
+    has_validity: List[bool] = []
+    for c in table.columns:
+        arrays.append(c.data)
+        has_validity.append(c.validity is not None)
+        if c.validity is not None:
+            arrays.append(c.validity)
+    received, recv_mask, overflow = all_to_all_exchange(
+        arrays, dest.astype(jnp.int32), mesh, axis, capacity
+    )
+    pairs = []
+    it = iter(received)
+    for nullable in has_validity:
+        data = next(it)
+        pairs.append((data, next(it) if nullable else None))
+    return pairs, recv_mask, overflow

@@ -1,0 +1,171 @@
+"""Models tier: datagen determinism + TPC-H q1/q6 and TPC-DS q3/q95
+against a pandas oracle (the reference-model-oracle pattern of
+ZOrderTest.java:31-67 — an independent reimplementation checks the
+pipeline end to end)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.models import datagen, tpcds, tpch
+from spark_rapids_jni_tpu.ops import bitutils
+
+
+def _f64(col):
+    return np.asarray(bitutils.float_view(col.data, dt.FLOAT64))
+
+
+def _lineitem_df(t):
+    return pd.DataFrame(
+        {
+            "qty": _f64(t.column("l_quantity")),
+            "price": _f64(t.column("l_extendedprice")),
+            "disc": _f64(t.column("l_discount")),
+            "tax": _f64(t.column("l_tax")),
+            "rf": np.asarray(t.column("l_returnflag").data),
+            "ls": np.asarray(t.column("l_linestatus").data),
+            "ship": np.asarray(t.column("l_shipdate").data),
+        }
+    )
+
+
+class TestDatagen:
+    def test_deterministic(self):
+        a = datagen.create_random_table([dt.INT32, dt.FLOAT64, dt.STRING], 100, seed=9)
+        b = datagen.create_random_table([dt.INT32, dt.FLOAT64, dt.STRING], 100, seed=9)
+        np.testing.assert_array_equal(np.asarray(a.column(0).data), np.asarray(b.column(0).data))
+        np.testing.assert_array_equal(np.asarray(a.column(2).chars), np.asarray(b.column(2).chars))
+        c = datagen.create_random_table([dt.INT32, dt.FLOAT64, dt.STRING], 100, seed=10)
+        assert not np.array_equal(np.asarray(a.column(0).data), np.asarray(c.column(0).data))
+
+    def test_nulls_and_ranges(self):
+        p = {0: datagen.Profile(lower=5, upper=9, null_probability=0.3)}
+        t = datagen.create_random_table([dt.INT32], 1000, seed=1, profiles=p)
+        vals = np.asarray(t.column(0).data)
+        assert vals.min() >= 5 and vals.max() <= 9
+        nulls = 1000 - int(np.asarray(t.column(0).validity).sum())
+        assert 200 < nulls < 400
+
+    def test_cycle_dtypes(self):
+        out = datagen.cycle_dtypes([dt.INT8, dt.INT64], 5)
+        assert [d.id for d in out] == [dt.INT8.id, dt.INT64.id, dt.INT8.id, dt.INT64.id, dt.INT8.id]
+
+    def test_distributions(self):
+        for dist in datagen.Distribution:
+            t = datagen.create_random_table(
+                [dt.FLOAT64], 500, seed=3, profiles={0: datagen.Profile(distribution=dist)}
+            )
+            v = _f64(t.column(0))
+            assert np.isfinite(v).all()
+
+
+class TestTpch:
+    def test_q1_matches_pandas(self):
+        li = tpch.gen_lineitem(20_000, seed=5)
+        out = tpch.q1(li)
+        df = _lineitem_df(li)
+        df = df[df.ship <= 2526 - 90]
+        df["disc_price"] = df.price * (1 - df.disc)
+        df["charge"] = df.price * (1 - df.disc) * (1 + df.tax)
+        g = df.groupby(["rf", "ls"]).agg(
+            qty_sum=("qty", "sum"),
+            price_sum=("price", "sum"),
+            disc_price_sum=("disc_price", "sum"),
+            charge_sum=("charge", "sum"),
+            qty_mean=("qty", "mean"),
+            price_mean=("price", "mean"),
+            disc_mean=("disc", "mean"),
+            n=("qty", "size"),
+        ).reset_index().sort_values(["rf", "ls"])
+
+        assert out.num_rows == len(g)
+        np.testing.assert_array_equal(np.asarray(out.column("l_returnflag").data), g.rf.values)
+        np.testing.assert_array_equal(np.asarray(out.column("l_linestatus").data), g.ls.values)
+        np.testing.assert_allclose(_f64(out.column("qty_sum")), g.qty_sum.values, rtol=1e-9)
+        np.testing.assert_allclose(_f64(out.column("charge_sum")), g.charge_sum.values, rtol=1e-9)
+        np.testing.assert_allclose(_f64(out.column("disc_mean")), g.disc_mean.values, rtol=1e-9)
+        np.testing.assert_array_equal(np.asarray(out.column("qty_count_all").data), g.n.values)
+
+    def test_q6_matches_pandas(self):
+        li = tpch.gen_lineitem(20_000, seed=6)
+        got = tpch.q6(li)
+        df = _lineitem_df(li)
+        m = (
+            (df.ship >= 731)
+            & (df.ship < 1096)
+            & (df.disc >= 0.05)
+            & (df.disc <= 0.07)
+            & (df.qty < 24)
+        )
+        want = float((df.price[m] * df.disc[m]).sum())
+        assert got == pytest.approx(want, rel=1e-9)
+
+    def test_q6_empty_selection(self):
+        li = tpch.gen_lineitem(100, seed=7)
+        # discount range outside generated values -> empty result
+        df = _lineitem_df(li)
+        got = tpch.q6(li)
+        assert np.isfinite(got)
+
+
+class TestTpcds:
+    def test_q3_matches_pandas(self):
+        tabs = tpcds.gen_store(30_000, seed=11)
+        out = tpcds.q3(tabs, manufact_id=128, month=11)
+
+        ss = pd.DataFrame(
+            {
+                "date_sk": np.asarray(tabs["store_sales"].column("ss_sold_date_sk").data),
+                "item_sk": np.asarray(tabs["store_sales"].column("ss_item_sk").data),
+                "price": _f64(tabs["store_sales"].column("ss_ext_sales_price")),
+            }
+        )
+        dd = pd.DataFrame(
+            {
+                "date_sk": np.asarray(tabs["date_dim"].column("d_date_sk").data),
+                "year": np.asarray(tabs["date_dim"].column("d_year").data),
+                "moy": np.asarray(tabs["date_dim"].column("d_moy").data),
+            }
+        )
+        it = pd.DataFrame(
+            {
+                "item_sk": np.asarray(tabs["item"].column("i_item_sk").data),
+                "manu": np.asarray(tabs["item"].column("i_manufact_id").data),
+                "brand": np.asarray(tabs["item"].column("i_brand_id").data),
+            }
+        )
+        j = ss.merge(dd[dd.moy == 11], on="date_sk").merge(it[it.manu == 128], on="item_sk")
+        g = (
+            j.groupby(["year", "brand"])["price"].sum().reset_index()
+            .sort_values(["year", "price", "brand"], ascending=[True, False, True])
+        )
+        assert out.num_rows == len(g)
+        np.testing.assert_array_equal(np.asarray(out.column("d_year").data), g.year.values)
+        np.testing.assert_array_equal(np.asarray(out.column("i_brand_id").data), g.brand.values)
+        np.testing.assert_allclose(
+            _f64(out.column("ss_ext_sales_price_sum")), g.price.values, rtol=1e-9
+        )
+
+    def test_q95_matches_pandas(self):
+        tabs = tpcds.gen_web(5_000, seed=13)
+        got = tpcds.q95(tabs, ship_lo=400, ship_hi=460)
+
+        ws = pd.DataFrame(
+            {
+                "o": np.asarray(tabs["web_sales"].column("ws_order_number").data),
+                "wh": np.asarray(tabs["web_sales"].column("ws_warehouse_sk").data),
+                "ship": np.asarray(tabs["web_sales"].column("ws_ship_date_sk").data),
+                "cost": _f64(tabs["web_sales"].column("ws_ext_ship_cost")),
+                "profit": _f64(tabs["web_sales"].column("ws_net_profit")),
+            }
+        )
+        wr = set(np.asarray(tabs["web_returns"].column("wr_order_number").data).tolist())
+        nwh = ws.groupby("o")["wh"].nunique()
+        multi = set(nwh[nwh > 1].index.tolist())
+        m = ws.ship.between(400, 460) & ws.o.isin(multi) & ws.o.isin(wr)
+        sel = ws[m]
+        assert got["order_count"] == sel.o.nunique()
+        assert got["total_shipping_cost"] == pytest.approx(float(sel.cost.sum()), rel=1e-9)
+        assert got["total_net_profit"] == pytest.approx(float(sel.profit.sum()), rel=1e-9)

@@ -172,6 +172,19 @@ def test_groupby_sum_count_minmax(rng):
     np.testing.assert_allclose(out.column("v_max").to_pylist(), exp["max"], rtol=0)
 
 
+def test_groupby_int_minmax(rng):
+    # signed-int min/max goes through the total-order-key round trip
+    keys = [int(k) for k in rng.integers(0, 5, 100)]
+    vals = [int(v) for v in rng.integers(-1000, 1000, 100)]
+    t_keys = make_table(k=(keys, dt.INT32))
+    t_vals = make_table(v=(vals, dt.INT32))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "min"), ("v", "max")])
+    df = pd.DataFrame({"k": keys, "v": vals})
+    exp = df.groupby("k")["v"].agg(["min", "max"]).reset_index()
+    assert out.column("v_min").to_pylist() == exp["min"].tolist()
+    assert out.column("v_max").to_pylist() == exp["max"].tolist()
+
+
 def test_groupby_int64_sum_exact():
     t_keys = make_table(k=(["a", "b", "a", "b", "a"], dt.STRING))
     t_vals = make_table(v=([2**40, 1, 2**40, 2, 5], dt.INT64))

@@ -118,6 +118,78 @@ def test_distributed_groupby_sum_matches_pandas(mesh8, rng):
     assert got == exp.to_dict()
 
 
+def test_hash_dest_parity_with_partitioner(rng):
+    # the shard_map raw-array partitioner must route identically to the
+    # Column-level hash_partition_map for both 4- and 8-byte keys
+    from spark_rapids_jni_tpu.ops.hashing import hash_partition_map
+    from spark_rapids_jni_tpu.parallel.distributed import _hash_dest
+
+    for np_dt, d in ((np.int32, dt.INT32), (np.int64, dt.INT64)):
+        keys = rng.integers(-1000, 1000, 200).astype(np_dt)
+        want = np.asarray(hash_partition_map([Column(d, data=jnp.asarray(keys))], 8))
+        got = np.asarray(_hash_dest(jnp.asarray(keys), 8))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_shard_groupby_sum_max_key_sentinel():
+    # a real key equal to iinfo.max must not collide with exchange padding
+    big = np.iinfo(np.int64).max
+    keys = jnp.asarray([big, 3, big, 3], jnp.int64)
+    vals = jnp.asarray([1, 2, 4, 8], jnp.int64)
+    present = jnp.asarray([1, 1, 0, 1], bool)
+    k, s, valid, ovf = shard_groupby_sum(keys, vals, present, capacity=4)
+    k, s, valid = np.asarray(k), np.asarray(s), np.asarray(valid)
+    got = dict(zip(k[valid].tolist(), s[valid].tolist()))
+    assert got == {3: 10, big: 1}
+    assert not bool(ovf)
+
+
+def test_shard_groupby_sum_int32_no_wrap():
+    # integral sums accumulate in int64 (Spark semantics), not the input width
+    keys = jnp.zeros((4,), jnp.int32)
+    vals = jnp.full((4,), 2_000_000_000, jnp.int32)
+    present = jnp.ones((4,), bool)
+    k, s, valid, _ = shard_groupby_sum(keys, vals, present, capacity=2)
+    assert int(np.asarray(s)[0]) == 8_000_000_000
+
+
+def test_bucketize_overflow_drops_not_corrupts():
+    # overflow rows must be dropped, never alias the last slot's occupant
+    vals = jnp.asarray([10, 20, 30], jnp.int64)
+    dest = jnp.zeros((3,), jnp.int32)
+    buckets, mask, ovf = shuffle._bucketize(vals, dest, n_parts=2, capacity=2)
+    assert bool(ovf)
+    b, m = np.asarray(buckets), np.asarray(mask)
+    assert m[0].sum() == 2 and m[1].sum() == 0
+    assert sorted(b[0][m[0]].tolist()) == [10, 20]
+
+
+def test_exchange_by_key_carries_validity(mesh8):
+    n = 8 * 16
+    keys = np.arange(n, dtype=np.int64) % 13
+    vals = np.arange(n, dtype=np.int64)
+    validity = (np.arange(n) % 3 != 0)
+    t = Table(
+        [
+            Column(dt.INT64, data=jnp.asarray(keys)),
+            Column(dt.INT64, data=jnp.asarray(vals), validity=jnp.asarray(validity)),
+        ],
+        ["k", "v"],
+    )
+    t_s = mesh_mod.shard_table_rows(t, mesh8)
+    pairs, recv_mask, overflow = shuffle.exchange_by_key(t_s, ["k"], mesh8)
+    assert not bool(np.asarray(overflow).any())
+    (k_data, k_valid), (v_data, v_valid) = pairs
+    assert k_valid is None and v_valid is not None
+    m = np.asarray(recv_mask).reshape(-1)
+    got = sorted(
+        (int(v), bool(ok))
+        for v, ok in zip(np.asarray(v_data).reshape(-1)[m], np.asarray(v_valid).reshape(-1)[m])
+    )
+    want = sorted((int(v), bool(ok)) for v, ok in zip(vals, validity))
+    assert got == want
+
+
 def test_distributed_groupby_keys_disjoint_across_shards(mesh8, rng):
     # each key must be reduced on exactly one shard: totals already checked,
     # here check no key appears in two shard partials
