@@ -1,0 +1,150 @@
+// C ABI for the native runtime — the JNI-bridge analog (reference
+// src/main/cpp/src/*Jni.cpp): handle marshalling, exception translation
+// to error codes + a thread-local message (CATCH_STD pattern,
+// NativeParquetJni.cpp:574-633), explicit close() ownership. Consumed by
+// ctypes (spark_rapids_jni_tpu/runtime.py) and designed so a JVM JNI
+// shim is a thin veneer over the same exports.
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "handle_registry.h"
+#include "host_buffer.h"
+#include "parquet_footer.h"
+
+#define SRJT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error;
+
+srjt::HandleRegistry<srjt::ParquetFooter>& footers() {
+  static srjt::HandleRegistry<srjt::ParquetFooter> r;
+  return r;
+}
+
+srjt::HandleRegistry<srjt::HostBuffer>& buffers() {
+  static srjt::HandleRegistry<srjt::HostBuffer> r;
+  return r;
+}
+
+// serialize cache so size query + copy parse once
+srjt::HandleRegistry<std::string>& blobs() {
+  static srjt::HandleRegistry<std::string> r;
+  return r;
+}
+
+template <typename F>
+auto guarded(F&& f, decltype(f()) error_value) -> decltype(f()) {
+  try {
+    return f();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return error_value;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return error_value;
+  }
+}
+
+}  // namespace
+
+SRJT_EXPORT const char* srjt_last_error() { return g_last_error.c_str(); }
+
+SRJT_EXPORT int64_t srjt_live_handles() {
+  return footers().live_count() + buffers().live_count() + blobs().live_count();
+}
+
+// -- parquet footer service --------------------------------------------------
+
+SRJT_EXPORT int64_t srjt_footer_read_and_filter(
+    const uint8_t* buf, int64_t len, int64_t part_offset, int64_t part_length,
+    const char* const* names, const int32_t* num_children, const int32_t* tags,
+    int32_t n_elems, int32_t parent_num_children, int32_t ignore_case) {
+  return guarded(
+      [&]() -> int64_t {
+        std::vector<std::string> names_v;
+        std::vector<int32_t> nc_v(num_children, num_children + n_elems);
+        std::vector<int32_t> tags_v(tags, tags + n_elems);
+        names_v.reserve(n_elems);
+        for (int32_t k = 0; k < n_elems; ++k) names_v.emplace_back(names[k]);
+        auto footer = srjt::read_and_filter(buf, len, part_offset, part_length, names_v, nc_v,
+                                            tags_v, parent_num_children, ignore_case != 0);
+        return footers().put(std::move(footer));
+      },
+      0);
+}
+
+SRJT_EXPORT int64_t srjt_footer_num_rows(int64_t h) {
+  return guarded(
+      [&]() -> int64_t {
+        srjt::ParquetFooter* f = footers().get(h);
+        if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+        return f->num_rows();
+      },
+      -1);
+}
+
+SRJT_EXPORT int32_t srjt_footer_num_columns(int64_t h) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        srjt::ParquetFooter* f = footers().get(h);
+        if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+        return f->num_columns();
+      },
+      -1));
+}
+
+// Two-phase serialize: returns a blob handle + writes size; then copy + free.
+SRJT_EXPORT int64_t srjt_footer_serialize(int64_t h, int64_t* out_size) {
+  return guarded(
+      [&]() -> int64_t {
+        srjt::ParquetFooter* f = footers().get(h);
+        if (f == nullptr) throw std::invalid_argument("invalid footer handle");
+        auto blob = std::make_unique<std::string>(f->serialize_thrift_file());
+        *out_size = static_cast<int64_t>(blob->size());
+        return blobs().put(std::move(blob));
+      },
+      0);
+}
+
+SRJT_EXPORT int32_t srjt_blob_copy(int64_t blob_h, uint8_t* out, int64_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        std::string* b = blobs().get(blob_h);
+        if (b == nullptr) throw std::invalid_argument("invalid blob handle");
+        if (capacity < static_cast<int64_t>(b->size()))
+          throw std::invalid_argument("blob copy buffer too small");
+        std::memcpy(out, b->data(), b->size());
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT void srjt_blob_free(int64_t blob_h) { blobs().release(blob_h); }
+
+SRJT_EXPORT void srjt_footer_close(int64_t h) { footers().release(h); }
+
+// -- host buffer arena -------------------------------------------------------
+
+SRJT_EXPORT int64_t srjt_host_alloc(int64_t size, int64_t alignment) {
+  return guarded(
+      [&]() -> int64_t {
+        return buffers().put(std::make_unique<srjt::HostBuffer>(size, alignment));
+      },
+      0);
+}
+
+SRJT_EXPORT uint8_t* srjt_host_ptr(int64_t h) {
+  srjt::HostBuffer* b = buffers().get(h);
+  return b == nullptr ? nullptr : b->data();
+}
+
+SRJT_EXPORT int64_t srjt_host_size(int64_t h) {
+  srjt::HostBuffer* b = buffers().get(h);
+  return b == nullptr ? -1 : b->size();
+}
+
+SRJT_EXPORT void srjt_host_free(int64_t h) { buffers().release(h); }
+
+SRJT_EXPORT int64_t srjt_host_bytes_in_use() { return srjt::HostBuffer::bytes_in_use(); }

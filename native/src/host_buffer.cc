@@ -1,0 +1,34 @@
+#include "host_buffer.h"
+
+#include <new>
+#include <stdexcept>
+
+namespace srjt {
+
+namespace {
+std::atomic<int64_t> g_bytes_in_use{0};
+}
+
+HostBuffer::HostBuffer(int64_t size, int64_t alignment) {
+  if (size < 0) throw std::invalid_argument("negative buffer size");
+  if (alignment <= 0 || (alignment & (alignment - 1)) != 0) {
+    throw std::invalid_argument("alignment must be a positive power of two");
+  }
+  size_ = size;
+  if (size > 0) {
+    // round size up to the alignment (aligned_alloc requirement)
+    size_t alloc = (static_cast<size_t>(size) + alignment - 1) & ~static_cast<size_t>(alignment - 1);
+    data_ = static_cast<uint8_t*>(std::aligned_alloc(static_cast<size_t>(alignment), alloc));
+    if (data_ == nullptr) throw std::bad_alloc();
+  }
+  g_bytes_in_use.fetch_add(size_, std::memory_order_relaxed);
+}
+
+HostBuffer::~HostBuffer() {
+  std::free(data_);
+  g_bytes_in_use.fetch_sub(size_, std::memory_order_relaxed);
+}
+
+int64_t HostBuffer::bytes_in_use() { return g_bytes_in_use.load(std::memory_order_relaxed); }
+
+}  // namespace srjt

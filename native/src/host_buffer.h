@@ -1,0 +1,33 @@
+// Aligned host staging buffers: the ai.rapids.cudf.HostMemoryBuffer
+// analog (the handle type ParquetFooter.readAndFilter receives,
+// ParquetFooter.java:200) with bytes-in-use accounting standing in for
+// RMM's host-side tracking. Buffers are the staging ground between file
+// IO and device transfer; 64-byte default alignment keeps them friendly
+// to DMA and vectorized host code.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+
+namespace srjt {
+
+class HostBuffer {
+ public:
+  HostBuffer(int64_t size, int64_t alignment);
+  ~HostBuffer();
+
+  HostBuffer(const HostBuffer&) = delete;
+  HostBuffer& operator=(const HostBuffer&) = delete;
+
+  uint8_t* data() const { return data_; }
+  int64_t size() const { return size_; }
+
+  static int64_t bytes_in_use();
+
+ private:
+  uint8_t* data_ = nullptr;
+  int64_t size_ = 0;
+};
+
+}  // namespace srjt

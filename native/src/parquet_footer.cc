@@ -1,0 +1,489 @@
+#include "parquet_footer.h"
+
+#include <cstring>
+#include <cwctype>
+#include <map>
+
+namespace srjt {
+
+namespace {
+
+// FileMetaData field ids (parquet.thrift)
+constexpr int32_t FMD_SCHEMA = 2;
+constexpr int32_t FMD_NUM_ROWS = 3;
+constexpr int32_t FMD_ROW_GROUPS = 4;
+constexpr int32_t FMD_COLUMN_ORDERS = 7;
+// SchemaElement
+constexpr int32_t SE_TYPE = 1;
+constexpr int32_t SE_REPETITION = 3;
+constexpr int32_t SE_NAME = 4;
+constexpr int32_t SE_NUM_CHILDREN = 5;
+constexpr int32_t SE_CONVERTED_TYPE = 6;
+// RowGroup
+constexpr int32_t RG_COLUMNS = 1;
+constexpr int32_t RG_NUM_ROWS = 3;
+constexpr int32_t RG_FILE_OFFSET = 5;
+constexpr int32_t RG_TOTAL_COMPRESSED_SIZE = 6;
+// ColumnChunk
+constexpr int32_t CC_META_DATA = 3;
+// ColumnMetaData
+constexpr int32_t CMD_TOTAL_COMPRESSED_SIZE = 7;
+constexpr int32_t CMD_DATA_PAGE_OFFSET = 9;
+constexpr int32_t CMD_DICT_PAGE_OFFSET = 11;
+
+constexpr int64_t REPETITION_REPEATED = 2;
+constexpr int64_t CONVERTED_MAP = 1;
+constexpr int64_t CONVERTED_MAP_KEY_VALUE = 2;
+constexpr int64_t CONVERTED_LIST = 3;
+
+// -- pruner tree (column_pruner, NativeParquetJni.cpp:394-439) --------------
+
+struct Pruner {
+  int32_t tag = TAG_STRUCT;
+  std::map<std::string, Pruner> children;
+};
+
+Pruner build_pruner(const std::vector<std::string>& names,
+                    const std::vector<int32_t>& num_children,
+                    const std::vector<int32_t>& tags, int32_t parent_num_children) {
+  Pruner root;
+  size_t pos = 0;
+  // depth-first reconstruction, iterative with an explicit stack of
+  // (parent, remaining-children) to match the recursive flattening order
+  struct Frame {
+    Pruner* node;
+    int32_t remaining;
+  };
+  std::vector<Frame> stack{{&root, parent_num_children}};
+  while (!stack.empty()) {
+    Frame& top = stack.back();
+    if (top.remaining == 0) {
+      stack.pop_back();
+      continue;
+    }
+    --top.remaining;
+    if (pos >= names.size()) throw FooterError("flattened schema truncated");
+    Pruner& child = top.node->children[names[pos]];
+    child.tag = tags[pos];
+    int32_t cnt = num_children[pos];
+    ++pos;
+    if (cnt > 0) stack.push_back({&child, cnt});
+  }
+  return root;
+}
+
+// -- schema walk -------------------------------------------------------------
+
+struct SchemaWalk {
+  std::vector<TValue>* schema;  // list<SchemaElement>
+  bool ignore_case;
+  size_t i = 0;       // current input schema index
+  int64_t chunk = 0;  // next input chunk index
+  std::vector<size_t> schema_map;
+  std::vector<int32_t> schema_num_children;
+  std::vector<int64_t> chunk_map;
+
+  const TStruct& elem() const {
+    if (i >= schema->size()) throw FooterError("schema walk out of range");
+    return *(*schema)[i].st;
+  }
+
+  std::string name(const TStruct& e) const {
+    const TValue* v = e.get(SE_NAME);
+    std::string n = v == nullptr ? std::string() : v->bin;
+    return ignore_case ? utf8_to_lower(n) : n;
+  }
+
+  static bool is_leaf(const TStruct& e) { return e.has(SE_TYPE); }
+  static int64_t n_children(const TStruct& e) { return e.get_int(SE_NUM_CHILDREN, 0); }
+
+  // skip the current element and its subtree, counting leaves passed
+  void skip() {
+    int64_t to_skip = 1;
+    while (to_skip > 0 && i < schema->size()) {
+      const TStruct& e = *(*schema)[i].st;
+      if (is_leaf(e)) ++chunk;
+      to_skip += n_children(e);
+      --to_skip;
+      ++i;
+    }
+  }
+};
+
+void filter_schema(const Pruner& p, SchemaWalk& w);
+
+void filter_value(SchemaWalk& w) {
+  const TStruct& e = w.elem();
+  if (!SchemaWalk::is_leaf(e))
+    throw FooterError("found a non-leaf entry when reading a leaf value");
+  if (SchemaWalk::n_children(e) != 0)
+    throw FooterError("found an entry with children when reading a leaf value");
+  w.schema_map.push_back(w.i);
+  w.schema_num_children.push_back(0);
+  ++w.i;
+  w.chunk_map.push_back(w.chunk);
+  ++w.chunk;
+}
+
+void filter_struct(const Pruner& p, SchemaWalk& w) {
+  const TStruct& e = w.elem();
+  if (SchemaWalk::is_leaf(e))
+    throw FooterError("Found a leaf node, but expected to find a struct");
+  int64_t n = SchemaWalk::n_children(e);
+  w.schema_map.push_back(w.i);
+  size_t my_count_idx = w.schema_num_children.size();
+  w.schema_num_children.push_back(0);
+  ++w.i;
+  for (int64_t k = 0; k < n; ++k) {
+    if (w.i >= w.schema->size()) break;
+    const TStruct& child = w.elem();
+    auto it = p.children.find(w.name(child));
+    if (it != p.children.end()) {
+      ++w.schema_num_children[my_count_idx];
+      filter_schema(it->second, w);
+    } else {
+      w.skip();
+    }
+  }
+}
+
+void filter_list(const Pruner& p, SchemaWalk& w) {
+  auto found = p.children.find("element");
+  if (found == p.children.end()) throw FooterError("list pruner missing element child");
+  const TStruct& e = w.elem();
+  const TValue* nv = e.get(SE_NAME);
+  std::string list_name = nv == nullptr ? std::string() : nv->bin;
+  if (SchemaWalk::is_leaf(e)) {
+    if (e.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
+      throw FooterError("expected list item to be repeating");
+    filter_value(w);
+    return;
+  }
+  if (e.get_int(SE_CONVERTED_TYPE, -1) != CONVERTED_LIST)
+    throw FooterError("expected a list type, but it was not found.");
+  if (SchemaWalk::n_children(e) != 1)
+    throw FooterError("the structure of the outer list group is not standard");
+  w.schema_map.push_back(w.i);
+  w.schema_num_children.push_back(1);
+  ++w.i;
+
+  const TStruct& rep = w.elem();
+  if (rep.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
+    throw FooterError("the structure of the list's child is not standard (non repeating)");
+  bool rep_is_group = !SchemaWalk::is_leaf(rep);
+  int64_t rep_n = SchemaWalk::n_children(rep);
+  const TValue* rn = rep.get(SE_NAME);
+  std::string rep_name = rn == nullptr ? std::string() : rn->bin;
+  if (rep_is_group && rep_n == 1 && rep_name != "array" && rep_name != list_name + "_tuple") {
+    // standard 3-level list
+    w.schema_map.push_back(w.i);
+    w.schema_num_children.push_back(1);
+    ++w.i;
+    filter_schema(found->second, w);
+  } else {
+    // legacy 2-level list
+    filter_schema(found->second, w);
+  }
+}
+
+void filter_map(const Pruner& p, SchemaWalk& w) {
+  auto key_found = p.children.find("key");
+  auto value_found = p.children.find("value");
+  if (key_found == p.children.end() || value_found == p.children.end())
+    throw FooterError("map pruner missing key/value children");
+  const TStruct& e = w.elem();
+  if (SchemaWalk::is_leaf(e))
+    throw FooterError("expected a map item, but found a single value");
+  int64_t ct = e.get_int(SE_CONVERTED_TYPE, -1);
+  if (ct != CONVERTED_MAP && ct != CONVERTED_MAP_KEY_VALUE)
+    throw FooterError("expected a map type, but it was not found.");
+  if (SchemaWalk::n_children(e) != 1)
+    throw FooterError("the structure of the outer map group is not standard");
+  w.schema_map.push_back(w.i);
+  w.schema_num_children.push_back(1);
+  ++w.i;
+
+  const TStruct& rep = w.elem();
+  if (rep.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
+    throw FooterError("found non repeating map child");
+  int64_t rep_n = SchemaWalk::n_children(rep);
+  if (rep_n != 1 && rep_n != 2) throw FooterError("found map with wrong number of children");
+  w.schema_map.push_back(w.i);
+  w.schema_num_children.push_back(static_cast<int32_t>(rep_n));
+  ++w.i;
+
+  filter_schema(key_found->second, w);
+  if (rep_n == 2) filter_schema(value_found->second, w);
+}
+
+void filter_schema(const Pruner& p, SchemaWalk& w) {
+  switch (p.tag) {
+    case TAG_STRUCT:
+      filter_struct(p, w);
+      return;
+    case TAG_VALUE:
+      filter_value(w);
+      return;
+    case TAG_LIST:
+      filter_list(p, w);
+      return;
+    case TAG_MAP:
+      filter_map(p, w);
+      return;
+    default:
+      throw FooterError("unexpected tag " + std::to_string(p.tag));
+  }
+}
+
+// -- row-group selection (filter_groups, NativeParquetJni.cpp:473-525) ------
+
+int64_t chunk_offset(const TStruct& cc) {
+  const TValue* mdv = cc.get(CC_META_DATA);
+  if (mdv == nullptr || !mdv->st) return 0;
+  const TStruct& md = *mdv->st;
+  int64_t off = md.get_int(CMD_DATA_PAGE_OFFSET, 0);
+  const TValue* dict = md.get(CMD_DICT_PAGE_OFFSET);
+  if (dict != nullptr && off > dict->i) off = dict->i;
+  return off;
+}
+
+bool invalid_file_offset(int64_t start, int64_t pre_start, int64_t pre_size) {
+  if (pre_start == 0 && start != 4) return true;  // PARQUET-2078 workaround
+  return start < pre_start + pre_size;
+}
+
+void filter_groups(TStruct& meta, int64_t part_offset, int64_t part_length) {
+  const TValue* rgsv = meta.get(FMD_ROW_GROUPS);
+  if (rgsv == nullptr || !rgsv->list) return;
+  std::vector<TValue>& groups = rgsv->list->values;
+  int64_t pre_start = 0;
+  int64_t pre_size = 0;
+  bool first_has_md = false;
+  if (!groups.empty()) {
+    const TValue* cols = groups[0].st->get(RG_COLUMNS);
+    if (cols != nullptr && cols->list && !cols->list->values.empty()) {
+      first_has_md = cols->list->values[0].st->has(CC_META_DATA);
+    }
+  }
+
+  std::vector<TValue> kept;
+  for (TValue& rgv : groups) {
+    TStruct& rg = *rgv.st;
+    const TValue* colsv = rg.get(RG_COLUMNS);
+    if (colsv == nullptr || !colsv->list) continue;
+    const std::vector<TValue>& cols = colsv->list->values;
+    int64_t start;
+    if (first_has_md) {
+      start = cols.empty() ? 0 : chunk_offset(*cols[0].st);
+    } else {
+      start = rg.get_int(RG_FILE_OFFSET, 0);
+      if (invalid_file_offset(start, pre_start, pre_size)) {
+        start = pre_start == 0 ? 4 : pre_start + pre_size;
+      }
+      pre_start = start;
+      pre_size = rg.get_int(RG_TOTAL_COMPRESSED_SIZE, 0);
+    }
+    int64_t total;
+    if (rg.has(RG_TOTAL_COMPRESSED_SIZE)) {
+      total = rg.get_int(RG_TOTAL_COMPRESSED_SIZE);
+    } else {
+      total = 0;
+      for (const TValue& c : cols) {
+        const TValue* md = c.st->get(CC_META_DATA);
+        if (md != nullptr && md->st) total += md->st->get_int(CMD_TOTAL_COMPRESSED_SIZE, 0);
+      }
+    }
+    int64_t mid = start + total / 2;
+    if (part_offset <= mid && mid < part_offset + part_length) {
+      kept.push_back(std::move(rgv));
+    }
+  }
+  rgsv->list->values = std::move(kept);
+}
+
+const uint8_t* extract_footer(const uint8_t* buf, int64_t len, int64_t* out_len) {
+  // accept raw thrift bytes or a file/tail slice ending in <len>PAR1
+  if (len >= 8 && std::memcmp(buf + len - 4, "PAR1", 4) == 0) {
+    uint32_t flen;
+    std::memcpy(&flen, buf + len - 8, 4);  // little-endian on all targets here
+    if (static_cast<int64_t>(flen) + 8 <= len) {
+      *out_len = flen;
+      return buf + len - 8 - flen;
+    }
+  }
+  *out_len = len;
+  return buf;
+}
+
+}  // namespace
+
+std::string utf8_to_lower(const std::string& s) {
+  // decode UTF-8 -> towlower per codepoint -> re-encode (the reference
+  // widens to wchar and uses towlower, NativeParquetJni.cpp:45-77)
+  std::string out;
+  out.reserve(s.size());
+  size_t i = 0;
+  while (i < s.size()) {
+    uint32_t cp = 0;
+    int extra = 0;
+    uint8_t c = static_cast<uint8_t>(s[i]);
+    if (c < 0x80) {
+      cp = c;
+    } else if ((c >> 5) == 0x6) {
+      cp = c & 0x1F;
+      extra = 1;
+    } else if ((c >> 4) == 0xE) {
+      cp = c & 0x0F;
+      extra = 2;
+    } else if ((c >> 3) == 0x1E) {
+      cp = c & 0x07;
+      extra = 3;
+    } else {
+      out.push_back(static_cast<char>(c));  // invalid byte: pass through
+      ++i;
+      continue;
+    }
+    if (i + extra >= s.size()) {
+      // truncated sequence: pass through verbatim
+      out.append(s, i, std::string::npos);
+      break;
+    }
+    bool ok = true;
+    for (int k = 1; k <= extra; ++k) {
+      uint8_t cc = static_cast<uint8_t>(s[i + k]);
+      if ((cc >> 6) != 0x2) {
+        ok = false;
+        break;
+      }
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (!ok) {
+      out.push_back(static_cast<char>(c));
+      ++i;
+      continue;
+    }
+    i += extra + 1;
+    cp = static_cast<uint32_t>(std::towlower(static_cast<wint_t>(cp)));
+    // re-encode
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+  return out;
+}
+
+int64_t ParquetFooter::num_rows() const {
+  const TValue* rgs = meta_.get(FMD_ROW_GROUPS);
+  if (rgs == nullptr || !rgs->list) return 0;
+  int64_t total = 0;
+  for (const TValue& rg : rgs->list->values) total += rg.st->get_int(RG_NUM_ROWS, 0);
+  return total;
+}
+
+int32_t ParquetFooter::num_columns() const {
+  const TValue* schema = meta_.get(FMD_SCHEMA);
+  if (schema == nullptr || !schema->list || schema->list->values.empty()) return 0;
+  return static_cast<int32_t>(schema->list->values[0].st->get_int(SE_NUM_CHILDREN, 0));
+}
+
+std::string ParquetFooter::serialize_thrift_file() const {
+  std::string body = write_struct(meta_);
+  std::string out;
+  out.reserve(body.size() + 12);
+  out.append("PAR1");
+  out.append(body);
+  uint32_t n = static_cast<uint32_t>(body.size());
+  out.append(reinterpret_cast<const char*>(&n), 4);
+  out.append("PAR1");
+  return out;
+}
+
+std::unique_ptr<ParquetFooter> read_and_filter(
+    const uint8_t* buf, int64_t len, int64_t part_offset, int64_t part_length,
+    const std::vector<std::string>& names, const std::vector<int32_t>& num_children,
+    const std::vector<int32_t>& tags, int32_t parent_num_children, bool ignore_case) {
+  int64_t body_len = 0;
+  const uint8_t* body = extract_footer(buf, len, &body_len);
+  TStruct meta = read_struct(body, body_len);
+
+  Pruner pruner = build_pruner(names, num_children, tags, parent_num_children);
+
+  TValue* schema_list = nullptr;
+  {
+    auto it = meta.fields.find(FMD_SCHEMA);
+    if (it == meta.fields.end() || !it->second.list)
+      throw FooterError("footer has no schema");
+    schema_list = &it->second;
+  }
+  SchemaWalk walk;
+  walk.schema = &schema_list->list->values;
+  walk.ignore_case = ignore_case;
+  filter_schema(pruner, walk);
+
+  // gather new schema, patching num_children (NativeParquetJni.cpp:601-611)
+  std::vector<TValue> new_schema;
+  new_schema.reserve(walk.schema_map.size());
+  for (size_t k = 0; k < walk.schema_map.size(); ++k) {
+    TValue e = (*walk.schema)[walk.schema_map[k]];  // shallow copy
+    auto st = std::make_shared<TStruct>(*e.st);     // own our field map
+    int32_t n_kids = walk.schema_num_children[k];
+    if (n_kids > 0 || st->has(SE_NUM_CHILDREN)) {
+      st->set(SE_NUM_CHILDREN, TValue::of_int(WT_I32, n_kids));
+    }
+    if (n_kids == 0) st->erase(SE_NUM_CHILDREN);
+    e.st = std::move(st);
+    new_schema.push_back(std::move(e));
+  }
+  schema_list->list->values = std::move(new_schema);
+
+  // column_orders gathered by chunk_map (:612-619)
+  if (const TValue* orders = meta.get(FMD_COLUMN_ORDERS); orders != nullptr && orders->list) {
+    std::vector<TValue> kept;
+    kept.reserve(walk.chunk_map.size());
+    for (int64_t idx : walk.chunk_map) {
+      if (idx < 0 || static_cast<size_t>(idx) >= orders->list->values.size())
+        throw FooterError("column_orders shorter than chunk map");
+      kept.push_back(orders->list->values[static_cast<size_t>(idx)]);
+    }
+    meta.fields.find(FMD_COLUMN_ORDERS)->second.list->values = std::move(kept);
+  }
+
+  // row-group split selection (:621-624)
+  if (part_length >= 0) filter_groups(meta, part_offset, part_length);
+
+  // prune each row group's chunks (:558-567)
+  if (const TValue* rgs = meta.get(FMD_ROW_GROUPS); rgs != nullptr && rgs->list) {
+    for (TValue& rgv : rgs->list->values) {
+      auto rg = std::make_shared<TStruct>(*rgv.st);
+      auto it = rg->fields.find(RG_COLUMNS);
+      if (it == rg->fields.end() || !it->second.list) continue;
+      auto cols = std::make_shared<TList>(*it->second.list);
+      std::vector<TValue> kept;
+      kept.reserve(walk.chunk_map.size());
+      for (int64_t idx : walk.chunk_map) {
+        if (idx < 0 || static_cast<size_t>(idx) >= cols->values.size())
+          throw FooterError("row group has fewer chunks than schema leaves");
+        kept.push_back(cols->values[static_cast<size_t>(idx)]);
+      }
+      cols->values = std::move(kept);
+      it->second.list = std::move(cols);
+      rgv.st = std::move(rg);
+    }
+  }
+
+  return std::make_unique<ParquetFooter>(std::move(meta));
+}
+
+}  // namespace srjt

@@ -1,0 +1,56 @@
+// Parquet footer service: parse, prune, row-group filter, re-serialize.
+//
+// Native sibling of spark_rapids_jni_tpu/io/parquet_footer.py, behavioral
+// parity with the reference's pure-CPU footer path (NativeParquetJni.cpp:
+// column_pruner :119-439, filter_groups :473-525, serialize :672-706).
+// This is the production path a JVM executor calls before device decode.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "thrift_compact.h"
+
+namespace srjt {
+
+struct FooterError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+enum Tag : int32_t {
+  TAG_VALUE = 0,
+  TAG_STRUCT = 1,
+  TAG_LIST = 2,
+  TAG_MAP = 3,
+};
+
+class ParquetFooter {
+ public:
+  explicit ParquetFooter(TStruct meta) : meta_(std::move(meta)) {}
+
+  int64_t num_rows() const;
+  int32_t num_columns() const;
+  // PAR1 + thrift body + LE u32 length + PAR1
+  std::string serialize_thrift_file() const;
+
+  TStruct& meta() { return meta_; }
+
+ private:
+  TStruct meta_;
+};
+
+// Parse (raw thrift bytes or a file tail ending in <len>PAR1), prune to the
+// flattened schema triple, select row groups whose midpoint lies in
+// [part_offset, part_offset + part_length). part_length < 0 skips group
+// selection. Throws FooterError / ThriftError.
+std::unique_ptr<ParquetFooter> read_and_filter(
+    const uint8_t* buf, int64_t len, int64_t part_offset, int64_t part_length,
+    const std::vector<std::string>& names, const std::vector<int32_t>& num_children,
+    const std::vector<int32_t>& tags, int32_t parent_num_children, bool ignore_case);
+
+// UTF-8 aware lowercase (reference unicode_to_lower, NativeParquetJni.cpp:45-77).
+std::string utf8_to_lower(const std::string& s);
+
+}  // namespace srjt

@@ -1,0 +1,309 @@
+#include "thrift_compact.h"
+
+#include <cstring>
+
+namespace srjt {
+
+TValue TValue::of_bool(bool v) {
+  TValue t;
+  t.wire_type = v ? WT_TRUE : WT_FALSE;
+  t.b = v;
+  return t;
+}
+TValue TValue::of_int(uint8_t wt, int64_t v) {
+  TValue t;
+  t.wire_type = wt;
+  t.i = v;
+  return t;
+}
+TValue TValue::of_binary(std::string v) {
+  TValue t;
+  t.wire_type = WT_BINARY;
+  t.bin = std::move(v);
+  return t;
+}
+TValue TValue::of_struct(std::shared_ptr<TStruct> v) {
+  TValue t;
+  t.wire_type = WT_STRUCT;
+  t.st = std::move(v);
+  return t;
+}
+TValue TValue::of_list(std::shared_ptr<TList> v) {
+  TValue t;
+  t.wire_type = WT_LIST;
+  t.list = std::move(v);
+  return t;
+}
+
+namespace {
+
+class Reader {
+ public:
+  Reader(const uint8_t* buf, int64_t len) : buf_(buf), end_(len) {}
+
+  uint8_t byte() {
+    if (pos_ >= end_) throw ThriftError("thrift: truncated input");
+    return buf_[pos_++];
+  }
+
+  uint64_t varint() {
+    uint64_t result = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = byte();
+      result |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if ((b & 0x80) == 0) return result;
+      shift += 7;
+      if (shift > 70) throw ThriftError("thrift: varint too long");
+    }
+  }
+
+  int64_t zigzag() {
+    uint64_t v = varint();
+    return static_cast<int64_t>(v >> 1) ^ -static_cast<int64_t>(v & 1);
+  }
+
+  std::string read_bytes(int64_t n) {
+    if (n < 0 || pos_ + n > end_) throw ThriftError("thrift: truncated binary");
+    std::string out(reinterpret_cast<const char*>(buf_ + pos_), static_cast<size_t>(n));
+    pos_ += n;
+    return out;
+  }
+
+ private:
+  const uint8_t* buf_;
+  int64_t pos_ = 0;
+  int64_t end_;
+};
+
+TStruct read_struct_body(Reader& r, int depth);
+
+TValue read_value(Reader& r, uint8_t wire_type, int depth) {
+  if (depth > 64) throw ThriftError("thrift: nesting too deep");
+  TValue v;
+  v.wire_type = wire_type;
+  switch (wire_type) {
+    case WT_TRUE:
+      v.b = true;
+      return v;
+    case WT_FALSE:
+      v.b = false;
+      return v;
+    case WT_BYTE: {
+      uint8_t b = r.byte();
+      v.i = (b >= 128) ? static_cast<int64_t>(b) - 256 : b;
+      return v;
+    }
+    case WT_I16:
+    case WT_I32:
+    case WT_I64:
+      v.i = r.zigzag();
+      return v;
+    case WT_DOUBLE: {
+      std::string raw = r.read_bytes(8);  // little-endian IEEE754
+      std::memcpy(&v.d, raw.data(), 8);
+      return v;
+    }
+    case WT_BINARY: {
+      uint64_t n = r.varint();
+      if (n > static_cast<uint64_t>(kMaxString))
+        throw ThriftError("thrift: string size limit exceeded");
+      v.bin = r.read_bytes(static_cast<int64_t>(n));
+      return v;
+    }
+    case WT_LIST:
+    case WT_SET: {
+      uint8_t head = r.byte();
+      uint64_t size = head >> 4;
+      uint8_t elem_type = head & 0x0F;
+      if (size == 15) size = r.varint();
+      if (size > static_cast<uint64_t>(kMaxContainer))
+        throw ThriftError("thrift: container size limit exceeded");
+      auto list = std::make_shared<TList>();
+      list->elem_type = elem_type;
+      list->is_set = (wire_type == WT_SET);
+      list->values.reserve(size);
+      for (uint64_t k = 0; k < size; ++k) {
+        if (elem_type == WT_TRUE || elem_type == WT_FALSE) {
+          list->values.push_back(TValue::of_bool(r.byte() == WT_TRUE));
+        } else {
+          list->values.push_back(read_value(r, elem_type, depth + 1));
+        }
+      }
+      v.list = std::move(list);
+      return v;
+    }
+    case WT_MAP: {
+      uint64_t size = r.varint();
+      if (size > static_cast<uint64_t>(kMaxContainer))
+        throw ThriftError("thrift: container size limit exceeded");
+      auto map = std::make_shared<TMap>();
+      if (size > 0) {
+        uint8_t kv = r.byte();
+        map->key_type = kv >> 4;
+        map->val_type = kv & 0x0F;
+        map->items.reserve(size);
+        auto read_elem = [&](uint8_t et) {
+          if (et == WT_TRUE || et == WT_FALSE) return TValue::of_bool(r.byte() == WT_TRUE);
+          return read_value(r, et, depth + 1);
+        };
+        for (uint64_t k = 0; k < size; ++k) {
+          TValue key = read_elem(map->key_type);
+          TValue val = read_elem(map->val_type);
+          map->items.emplace_back(std::move(key), std::move(val));
+        }
+      }
+      v.map = std::move(map);
+      return v;
+    }
+    case WT_STRUCT: {
+      v.st = std::make_shared<TStruct>(read_struct_body(r, depth + 1));
+      return v;
+    }
+    default:
+      throw ThriftError("thrift: unknown wire type " + std::to_string(wire_type));
+  }
+}
+
+TStruct read_struct_body(Reader& r, int depth) {
+  if (depth > 64) throw ThriftError("thrift: nesting too deep");
+  TStruct s;
+  int32_t last_fid = 0;
+  while (true) {
+    uint8_t head = r.byte();
+    if (head == WT_STOP) return s;
+    uint8_t delta = head >> 4;
+    uint8_t wire_type = head & 0x0F;
+    int32_t fid = delta != 0 ? last_fid + delta : static_cast<int32_t>(r.zigzag());
+    last_fid = fid;
+    s.set(fid, read_value(r, wire_type, depth));
+  }
+}
+
+class Writer {
+ public:
+  void byte(uint8_t b) { out_.push_back(static_cast<char>(b)); }
+
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      out_.push_back(static_cast<char>((v & 0x7F) | 0x80));
+      v >>= 7;
+    }
+    out_.push_back(static_cast<char>(v));
+  }
+
+  void raw(const void* p, size_t n) { out_.append(static_cast<const char*>(p), n); }
+
+  std::string take() { return std::move(out_); }
+
+ private:
+  std::string out_;
+};
+
+uint64_t zigzag_encode(int64_t v) {
+  return (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+}
+
+void write_struct_body(Writer& w, const TStruct& s);
+
+void write_value(Writer& w, uint8_t wire_type, const TValue& v) {
+  switch (wire_type) {
+    case WT_TRUE:
+    case WT_FALSE:
+      return;  // encoded in the field header
+    case WT_BYTE:
+      w.byte(static_cast<uint8_t>(v.i & 0xFF));
+      return;
+    case WT_I16:
+    case WT_I32:
+    case WT_I64:
+      w.varint(zigzag_encode(v.i));
+      return;
+    case WT_DOUBLE:
+      w.raw(&v.d, 8);
+      return;
+    case WT_BINARY:
+      w.varint(v.bin.size());
+      w.raw(v.bin.data(), v.bin.size());
+      return;
+    case WT_LIST:
+    case WT_SET: {
+      const TList& list = *v.list;
+      size_t n = list.values.size();
+      if (n < 15) {
+        w.byte(static_cast<uint8_t>((n << 4) | list.elem_type));
+      } else {
+        w.byte(0xF0 | list.elem_type);
+        w.varint(n);
+      }
+      for (const TValue& e : list.values) {
+        if (list.elem_type == WT_TRUE || list.elem_type == WT_FALSE) {
+          w.byte(e.b ? WT_TRUE : WT_FALSE);
+        } else {
+          write_value(w, list.elem_type, e);
+        }
+      }
+      return;
+    }
+    case WT_MAP: {
+      const TMap& map = *v.map;
+      size_t n = map.items.size();
+      w.varint(n);
+      if (n != 0) {
+        w.byte(static_cast<uint8_t>((map.key_type << 4) | map.val_type));
+        auto write_elem = [&](uint8_t et, const TValue& e) {
+          if (et == WT_TRUE || et == WT_FALSE) {
+            w.byte(e.b ? WT_TRUE : WT_FALSE);
+          } else {
+            write_value(w, et, e);
+          }
+        };
+        for (const auto& kv : map.items) {
+          write_elem(map.key_type, kv.first);
+          write_elem(map.val_type, kv.second);
+        }
+      }
+      return;
+    }
+    case WT_STRUCT:
+      write_struct_body(w, *v.st);
+      return;
+    default:
+      throw ThriftError("thrift: cannot write wire type " + std::to_string(wire_type));
+  }
+}
+
+void write_struct_body(Writer& w, const TStruct& s) {
+  int32_t last_fid = 0;
+  for (const auto& [fid, value] : s.fields) {  // std::map: ascending fid
+    uint8_t wire_type = value.wire_type;
+    if (wire_type == WT_TRUE || wire_type == WT_FALSE) {
+      wire_type = value.b ? WT_TRUE : WT_FALSE;
+    }
+    int32_t delta = fid - last_fid;
+    if (delta > 0 && delta <= 15) {
+      w.byte(static_cast<uint8_t>((delta << 4) | wire_type));
+    } else {
+      w.byte(wire_type);
+      w.varint(zigzag_encode(fid));
+    }
+    write_value(w, wire_type, value);
+    last_fid = fid;
+  }
+  w.byte(WT_STOP);
+}
+
+}  // namespace
+
+TStruct read_struct(const uint8_t* buf, int64_t len) {
+  Reader r(buf, len);
+  return read_struct_body(r, 0);
+}
+
+std::string write_struct(const TStruct& s) {
+  Writer w;
+  write_struct_body(w, s);
+  return w.take();
+}
+
+}  // namespace srjt

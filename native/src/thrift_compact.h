@@ -1,0 +1,101 @@
+// Thrift TCompactProtocol codec over a generic field-id-keyed value tree.
+//
+// Native sibling of spark_rapids_jni_tpu/io/thrift_compact.py (same design:
+// generic tree so unknown fields round-trip byte-faithfully; the reference,
+// NativeParquetJni.cpp:527-556, instead parses into generated parquet::format
+// classes via linked apache-thrift). Size-bomb guards match the reference's
+// string/container limits. The writer emits fields in ascending field-id
+// order, making output byte-identical to the Python codec's.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace srjt {
+
+constexpr int64_t kMaxString = 100LL * 1000 * 1000;
+constexpr int64_t kMaxContainer = 1000LL * 1000;
+
+enum WireType : uint8_t {
+  WT_STOP = 0x0,
+  WT_TRUE = 0x1,
+  WT_FALSE = 0x2,
+  WT_BYTE = 0x3,
+  WT_I16 = 0x4,
+  WT_I32 = 0x5,
+  WT_I64 = 0x6,
+  WT_DOUBLE = 0x7,
+  WT_BINARY = 0x8,
+  WT_LIST = 0x9,
+  WT_SET = 0xA,
+  WT_MAP = 0xB,
+  WT_STRUCT = 0xC,
+};
+
+struct TStruct;
+struct TList;
+struct TMap;
+
+struct TValue {
+  uint8_t wire_type = WT_STOP;
+  bool b = false;
+  int64_t i = 0;  // BYTE/I16/I32/I64
+  double d = 0.0;
+  std::string bin;
+  std::shared_ptr<TStruct> st;
+  std::shared_ptr<TList> list;
+  std::shared_ptr<TMap> map;
+
+  static TValue of_bool(bool v);
+  static TValue of_int(uint8_t wt, int64_t v);
+  static TValue of_binary(std::string v);
+  static TValue of_struct(std::shared_ptr<TStruct> v);
+  static TValue of_list(std::shared_ptr<TList> v);
+};
+
+struct TStruct {
+  // ordered: ascending fid, the writer's emission order
+  std::map<int32_t, TValue> fields;
+
+  bool has(int32_t fid) const { return fields.count(fid) != 0; }
+  const TValue* get(int32_t fid) const {
+    auto it = fields.find(fid);
+    return it == fields.end() ? nullptr : &it->second;
+  }
+  int64_t get_int(int32_t fid, int64_t def = 0) const {
+    const TValue* v = get(fid);
+    return v == nullptr ? def : v->i;
+  }
+  void set(int32_t fid, TValue v) { fields[fid] = std::move(v); }
+  void erase(int32_t fid) { fields.erase(fid); }
+};
+
+struct TList {
+  uint8_t elem_type = 0;
+  bool is_set = false;
+  std::vector<TValue> values;
+};
+
+struct TMap {
+  uint8_t key_type = 0;
+  uint8_t val_type = 0;
+  std::vector<std::pair<TValue, TValue>> items;
+};
+
+struct ThriftError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Parse one struct starting at buf[0]; throws ThriftError on malformed or
+// size-bomb input.
+TStruct read_struct(const uint8_t* buf, int64_t len);
+
+// Serialize a struct body (no framing).
+std::string write_struct(const TStruct& s);
+
+}  // namespace srjt

@@ -68,6 +68,8 @@ _DEFAULT_RANGE = {
     TypeId.TIMESTAMP_DAYS: (0, 20_000),
     TypeId.DECIMAL32: (-(10**8), 10**8),
     TypeId.DECIMAL64: (-(10**15), 10**15),
+    # float64 draw limits precision; stay within exactly-representable ints
+    TypeId.DECIMAL128: (-(2**52), 2**52),
 }
 
 

@@ -47,7 +47,7 @@ def gen_lineitem(num_rows: int, seed: int = 42) -> Table:
 
 
 # TPC-H dates as days since 1992-01-01 (the generator's epoch)
-_D_1998_09_02 = 2436  # 1998-12-01 minus 90 days
+D_1998_12_01 = 2526
 _D_1994_01_01 = 731
 _D_1995_01_01 = 1096
 
@@ -61,7 +61,7 @@ def q1(lineitem: Table, delta_days: int = 90) -> Table:
         FROM lineitem WHERE l_shipdate <= date '1998-12-01' - delta days
         GROUP BY l_returnflag, l_linestatus ORDER BY 1, 2
     """
-    cutoff = 2526 - delta_days  # 1998-12-01 in generator-epoch days
+    cutoff = D_1998_12_01 - delta_days
     pred = (col("l_shipdate") <= lit(np.int32(cutoff))).evaluate(lineitem)
     t = copying.apply_boolean_mask(lineitem, pred)
 

@@ -66,7 +66,7 @@ class TestTpch:
         li = tpch.gen_lineitem(20_000, seed=5)
         out = tpch.q1(li)
         df = _lineitem_df(li)
-        df = df[df.ship <= 2526 - 90]
+        df = df[df.ship <= tpch.D_1998_12_01 - 90]
         df["disc_price"] = df.price * (1 - df.disc)
         df["charge"] = df.price * (1 - df.disc) * (1 + df.tax)
         g = df.groupby(["rf", "ls"]).agg(
@@ -103,11 +103,21 @@ class TestTpch:
         assert got == pytest.approx(want, rel=1e-9)
 
     def test_q6_empty_selection(self):
+        # force every discount outside q6's [0.05, 0.07] band -> no rows pass
         li = tpch.gen_lineitem(100, seed=7)
-        # discount range outside generated values -> empty result
-        df = _lineitem_df(li)
-        got = tpch.q6(li)
-        assert np.isfinite(got)
+        from spark_rapids_jni_tpu.models.datagen import Profile, create_random_column
+
+        idx = li.names.index("l_discount")
+        rng = np.random.default_rng(0)
+        disc = create_random_column(
+            li.dtypes()[idx], 100, rng, Profile(lower=0.2, upper=0.3)
+        )
+        cols = list(li.columns)
+        cols[idx] = disc
+        from spark_rapids_jni_tpu.columnar import Table
+
+        got = tpch.q6(Table(cols, li.names))
+        assert got == 0.0
 
 
 class TestTpcds:
