@@ -1,0 +1,177 @@
+"""Microbenchmark harness — the nvbench tier (SURVEY §2.6).
+
+Reproduces the reference's benchmark axes on whatever device jax sees:
+
+- ``row_conversion_fixed``: 212 columns cycled over 9 int types ×
+  {1M, 4M} rows, both directions (reference
+  benchmarks/row_conversion.cpp:27-67, 140-143),
+- ``row_conversion_mixed``: 155 columns ± STRING (reference :69-138;
+  string case >1M rows skipped there for memory — same guard here),
+- ``cast_string``: string->int and string->decimal thread-per-row
+  kernels (reference cast kernels, cast_string.cu:654-655),
+- ``groupby``: the hash-agg tier on the 1M-row stepping stone.
+
+Protocol (matches the nvbench discipline): deterministic seeded input
+(models/datagen), compile/warmup excluded, median of N timed reps,
+reports rows/s and achieved GB/s (bytes read, the reference's
+global-memory counter, row_conversion.cpp:65-66).
+
+Usage::
+
+    python benchmarks/microbench.py                  # all, small sizes
+    python benchmarks/microbench.py --bench row_conversion_fixed \
+        --rows 4194304 --reps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, List
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.models.datagen import Profile, create_random_table, cycle_dtypes
+
+# the reference cycles 9 integral types (row_conversion.cpp:31-40)
+_NINE_INT_TYPES = [
+    dt.INT8, dt.INT16, dt.INT32, dt.INT64,
+    dt.UINT8, dt.UINT16, dt.UINT32, dt.UINT64,
+    dt.BOOL8,
+]
+
+
+def _sync(out) -> None:
+    # block on ONE leaf: device execution is ordered, and syncing every
+    # output array costs a tunnel round-trip each under remote backends,
+    # which would swamp the kernel time for many-column results
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        jax.block_until_ready(leaves[-1])
+
+
+def _time(fn: Callable[[], object], reps: int) -> float:
+    _sync(fn())  # warmup + compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _table_bytes(t: Table) -> int:
+    total = 0
+    for c in t.columns:
+        for buf in (c.data, c.validity, c.offsets, c.chars):
+            if buf is not None:
+                total += buf.size * buf.dtype.itemsize
+    return total
+
+
+def _report(name: str, rows: int, cols: int, secs: float, nbytes: int) -> None:
+    print(
+        json.dumps(
+            {
+                "bench": name,
+                "rows": rows,
+                "cols": cols,
+                "secs": round(secs, 6),
+                "mrows_per_s": round(rows / secs / 1e6, 2),
+                "gb_per_s": round(nbytes / secs / 1e9, 3),
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_row_conversion_fixed(rows: int, reps: int, cols: int = 212) -> None:
+    from spark_rapids_jni_tpu.ops import row_conversion as rc
+
+    table = create_random_table(cycle_dtypes(_NINE_INT_TYPES, cols), rows, seed=42)
+    nbytes = _table_bytes(table)
+
+    secs = _time(lambda: rc.convert_to_rows(table), reps)
+    _report("row_conversion_fixed_to_rows", rows, cols, secs, nbytes)
+
+    row_cols = rc.convert_to_rows(table)
+    dtypes = table.dtypes()
+    secs = _time(lambda: rc.convert_from_rows(row_cols[0], dtypes), reps)
+    _report("row_conversion_fixed_from_rows", rows, cols, secs, nbytes)
+
+
+def bench_row_conversion_mixed(rows: int, reps: int, cols: int = 155, strings: bool = True) -> None:
+    from spark_rapids_jni_tpu.ops import row_conversion as rc
+
+    base = [dt.INT32, dt.FLOAT64, dt.INT64, dt.INT16]
+    dtypes = cycle_dtypes(base, cols)
+    profiles = {}
+    if strings:
+        if rows > (1 << 20):
+            print(json.dumps({"bench": "row_conversion_mixed_strings", "skipped": "rows>1M"}))
+            return
+        for i in range(0, cols, 10):  # sprinkle string columns
+            dtypes[i] = dt.STRING
+            profiles[i] = Profile(min_length=1, max_length=32)
+    table = create_random_table(dtypes, rows, seed=42, profiles=profiles)
+    nbytes = _table_bytes(table)
+    secs = _time(lambda: rc.convert_to_rows(table), reps)
+    name = "row_conversion_mixed" + ("_strings" if strings else "")
+    _report(name + "_to_rows", rows, cols, secs, nbytes)
+
+
+def bench_cast_string(rows: int, reps: int) -> None:
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_integer
+
+    rng = np.random.default_rng(42)
+    vals = [str(int(v)) for v in rng.integers(-(10**8), 10**8, rows)]
+    col = Column.from_pylist(vals, dt.STRING)
+    nbytes = int(col.chars.size)
+    secs = _time(lambda: string_to_integer(col, False, dt.INT64), reps)
+    _report("cast_string_to_int64", rows, 1, secs, nbytes)
+
+
+def bench_groupby(rows: int, reps: int) -> None:
+    from spark_rapids_jni_tpu.parallel.distributed import shard_groupby_sum
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    keys = jnp.asarray(rng.integers(0, 4096, rows), jnp.int64)
+    vals = jnp.asarray(rng.standard_normal(rows), jnp.float32)
+    present = jnp.ones((rows,), bool)
+    fn = jax.jit(shard_groupby_sum, static_argnums=(3,))
+    secs = _time(lambda: fn(keys, vals, present, 8192), reps)
+    _report("groupby_sum", rows, 2, secs, rows * 12)
+
+
+_BENCHES = {
+    "row_conversion_fixed": bench_row_conversion_fixed,
+    "row_conversion_mixed": bench_row_conversion_mixed,
+    "cast_string": bench_cast_string,
+    "groupby": bench_groupby,
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--bench", choices=sorted(_BENCHES) + ["all"], default="all")
+    p.add_argument("--rows", type=int, default=1 << 17)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+    names: List[str] = sorted(_BENCHES) if args.bench == "all" else [args.bench]
+    for name in names:
+        _BENCHES[name](args.rows, args.reps)
+
+
+if __name__ == "__main__":
+    main()

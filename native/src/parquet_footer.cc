@@ -36,6 +36,14 @@ constexpr int64_t CONVERTED_MAP = 1;
 constexpr int64_t CONVERTED_MAP_KEY_VALUE = 2;
 constexpr int64_t CONVERTED_LIST = 3;
 
+// malformed footers may encode list elements as non-structs; every
+// dereference must go through this check or risk a null-deref that
+// bypasses the C ABI's exception translation
+const TStruct& as_struct(const TValue& v) {
+  if (!v.st) throw FooterError("footer element is not a struct");
+  return *v.st;
+}
+
 // -- pruner tree (column_pruner, NativeParquetJni.cpp:394-439) --------------
 
 struct Pruner {
@@ -85,7 +93,7 @@ struct SchemaWalk {
 
   const TStruct& elem() const {
     if (i >= schema->size()) throw FooterError("schema walk out of range");
-    return *(*schema)[i].st;
+    return as_struct((*schema)[i]);
   }
 
   std::string name(const TStruct& e) const {
@@ -101,7 +109,7 @@ struct SchemaWalk {
   void skip() {
     int64_t to_skip = 1;
     while (to_skip > 0 && i < schema->size()) {
-      const TStruct& e = *(*schema)[i].st;
+      const TStruct& e = as_struct((*schema)[i]);
       if (is_leaf(e)) ++chunk;
       to_skip += n_children(e);
       --to_skip;
@@ -260,21 +268,21 @@ void filter_groups(TStruct& meta, int64_t part_offset, int64_t part_length) {
   int64_t pre_size = 0;
   bool first_has_md = false;
   if (!groups.empty()) {
-    const TValue* cols = groups[0].st->get(RG_COLUMNS);
+    const TValue* cols = as_struct(groups[0]).get(RG_COLUMNS);
     if (cols != nullptr && cols->list && !cols->list->values.empty()) {
-      first_has_md = cols->list->values[0].st->has(CC_META_DATA);
+      first_has_md = as_struct(cols->list->values[0]).has(CC_META_DATA);
     }
   }
 
   std::vector<TValue> kept;
   for (TValue& rgv : groups) {
-    TStruct& rg = *rgv.st;
+    TStruct& rg = const_cast<TStruct&>(as_struct(rgv));
     const TValue* colsv = rg.get(RG_COLUMNS);
     if (colsv == nullptr || !colsv->list) continue;
     const std::vector<TValue>& cols = colsv->list->values;
     int64_t start;
     if (first_has_md) {
-      start = cols.empty() ? 0 : chunk_offset(*cols[0].st);
+      start = cols.empty() ? 0 : chunk_offset(as_struct(cols[0]));
     } else {
       start = rg.get_int(RG_FILE_OFFSET, 0);
       if (invalid_file_offset(start, pre_start, pre_size)) {
@@ -289,7 +297,7 @@ void filter_groups(TStruct& meta, int64_t part_offset, int64_t part_length) {
     } else {
       total = 0;
       for (const TValue& c : cols) {
-        const TValue* md = c.st->get(CC_META_DATA);
+        const TValue* md = as_struct(c).get(CC_META_DATA);
         if (md != nullptr && md->st) total += md->st->get_int(CMD_TOTAL_COMPRESSED_SIZE, 0);
       }
     }
@@ -388,14 +396,14 @@ int64_t ParquetFooter::num_rows() const {
   const TValue* rgs = meta_.get(FMD_ROW_GROUPS);
   if (rgs == nullptr || !rgs->list) return 0;
   int64_t total = 0;
-  for (const TValue& rg : rgs->list->values) total += rg.st->get_int(RG_NUM_ROWS, 0);
+  for (const TValue& rg : rgs->list->values) total += as_struct(rg).get_int(RG_NUM_ROWS, 0);
   return total;
 }
 
 int32_t ParquetFooter::num_columns() const {
   const TValue* schema = meta_.get(FMD_SCHEMA);
   if (schema == nullptr || !schema->list || schema->list->values.empty()) return 0;
-  return static_cast<int32_t>(schema->list->values[0].st->get_int(SE_NUM_CHILDREN, 0));
+  return static_cast<int32_t>(as_struct(schema->list->values[0]).get_int(SE_NUM_CHILDREN, 0));
 }
 
 std::string ParquetFooter::serialize_thrift_file() const {
@@ -437,7 +445,7 @@ std::unique_ptr<ParquetFooter> read_and_filter(
   new_schema.reserve(walk.schema_map.size());
   for (size_t k = 0; k < walk.schema_map.size(); ++k) {
     TValue e = (*walk.schema)[walk.schema_map[k]];  // shallow copy
-    auto st = std::make_shared<TStruct>(*e.st);     // own our field map
+    auto st = std::make_shared<TStruct>(as_struct(e));  // own our field map
     int32_t n_kids = walk.schema_num_children[k];
     if (n_kids > 0 || st->has(SE_NUM_CHILDREN)) {
       st->set(SE_NUM_CHILDREN, TValue::of_int(WT_I32, n_kids));
@@ -466,7 +474,7 @@ std::unique_ptr<ParquetFooter> read_and_filter(
   // prune each row group's chunks (:558-567)
   if (const TValue* rgs = meta.get(FMD_ROW_GROUPS); rgs != nullptr && rgs->list) {
     for (TValue& rgv : rgs->list->values) {
-      auto rg = std::make_shared<TStruct>(*rgv.st);
+      auto rg = std::make_shared<TStruct>(as_struct(rgv));
       auto it = rg->fields.find(RG_COLUMNS);
       if (it == rg->fields.end() || !it->second.list) continue;
       auto cols = std::make_shared<TList>(*it->second.list);

@@ -54,7 +54,9 @@ class Reader {
       result |= static_cast<uint64_t>(b & 0x7F) << shift;
       if ((b & 0x80) == 0) return result;
       shift += 7;
-      if (shift > 70) throw ThriftError("thrift: varint too long");
+      // next shift must stay < 64 (10 bytes max for a 64-bit varint);
+      // a larger shift is malformed input AND undefined behavior
+      if (shift > 63) throw ThriftError("thrift: varint too long");
     }
   }
 

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..columnar import Column, Table
 from ..columnar import dtype as dt
+from ..utils.dispatch import op_boundary
 from . import thrift_compact as tc
 
 __all__ = ["read_table", "ParquetReadError"]
@@ -357,6 +358,7 @@ def _to_column(name: str, elem: tc.ThriftStruct, values, defs, max_def: int) -> 
     return Column.from_numpy(full_arr, col_dt, validity=None if validity is None else validity)
 
 
+@op_boundary("read_table")
 def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
     """Read a flat-schema parquet file into a device Table."""
     if file_bytes[:4] != b"PAR1" or file_bytes[-4:] != b"PAR1":

@@ -28,6 +28,7 @@ import numpy as np
 from ..columnar import Column, Table
 from ..columnar import dtype as dt
 from ..columnar.dtype import TypeId
+from ..utils.dispatch import op_boundary
 from . import bitutils
 from .copying import gather
 from .sort import sorted_order
@@ -160,6 +161,7 @@ def _from_total_order(key: jnp.ndarray, d) -> jnp.ndarray:
     return key.astype(d.jnp_dtype)
 
 
+@op_boundary("groupby_aggregate")
 def groupby_aggregate(
     keys: Table, values: Table, aggs: Sequence[Tuple[str, str]]
 ) -> Table:

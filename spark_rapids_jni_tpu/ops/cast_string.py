@@ -35,6 +35,7 @@ from jax import lax
 
 from ..columnar import Column
 from ..columnar.dtype import DType, TypeId
+from ..utils.dispatch import op_boundary
 
 __all__ = ["CastError", "string_to_integer", "string_to_decimal"]
 
@@ -166,6 +167,7 @@ def _parse_integer(
     return acc, negative, valid
 
 
+@op_boundary("string_to_integer")
 def string_to_integer(col: Column, ansi_mode: bool, out_dtype: DType) -> Column:
     """String column -> integral column. Parity: cast_string.cu string_to_integer :763."""
     if col.dtype.id != TypeId.STRING:
@@ -217,6 +219,7 @@ def _validate_ansi(valid: jnp.ndarray, source: Column) -> None:
 # public surface matches CastStrings.java (toInteger/toDecimal).
 
 
+@op_boundary("string_to_decimal")
 def string_to_decimal(col: Column, ansi_mode: bool, precision: int, scale: int) -> Column:
     from . import cast_decimal
 

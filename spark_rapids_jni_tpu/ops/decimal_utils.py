@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from ..columnar import Column, Table
 from ..columnar.dtype import TypeId, decimal128
 from ..columnar import dtype as dt
+from ..utils.dispatch import op_boundary
 from . import limbs as L
 
 __all__ = ["multiply128", "divide128"]
@@ -119,6 +120,7 @@ def _multiply_kernel(a2c, b2c, a_scale: int, b_scale: int, prod_scale: int):
     return result, overflow
 
 
+@op_boundary("multiply128")
 def multiply128(a: Column, b: Column, product_scale: int) -> Table:
     """Parity: DecimalUtils.multiply128 (DecimalUtils.java:40) ->
     cudf::jni::multiply_decimal128 (decimal_utils.cu:690-711)."""
@@ -183,6 +185,7 @@ def _divide_kernel(a2c, b2c, a_scale: int, b_scale: int, quot_scale: int):
     return quotient, overflow
 
 
+@op_boundary("divide128")
 def divide128(a: Column, b: Column, quotient_scale: int) -> Table:
     """Parity: DecimalUtils.divide128 (DecimalUtils.java:55) ->
     cudf::jni::divide_decimal128 (decimal_utils.cu:713-733)."""

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..columnar import Column, Table
 from ..columnar.dtype import TypeId
+from ..utils.dispatch import op_boundary
 from .aggregate import _segment_ids
 from .copying import concatenate, gather, gather_column
 from .sort import sorted_order
@@ -110,11 +111,13 @@ def _joined_table(
     return Table(cols, names)
 
 
+@op_boundary("inner_join")
 def inner_join(left: Table, right: Table, on: Sequence[str]) -> Table:
     lmap, rmap = join_gather_maps(left.select(on), right.select(on), "inner")
     return _joined_table(left, right, lmap, rmap, list(on), keep_right_on=False)
 
 
+@op_boundary("left_join")
 def left_join(left: Table, right: Table, on: Sequence[str]) -> Table:
     lmap, rmap = join_gather_maps(left.select(on), right.select(on), "left")
     return _joined_table(left, right, lmap, rmap, list(on), keep_right_on=False)

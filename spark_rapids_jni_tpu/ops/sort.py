@@ -23,6 +23,7 @@ import numpy as np
 
 from ..columnar import Column, Table
 from ..columnar.dtype import TypeId
+from ..utils.dispatch import op_boundary
 from . import bitutils
 from .copying import gather
 
@@ -90,6 +91,7 @@ def sorted_order(
     return jnp.lexsort(tuple(reversed(lanes))).astype(jnp.int32)
 
 
+@op_boundary("sort_by_key")
 def sort_by_key(values: Table, keys: Table, ascending=None, nulls_first=None) -> Table:
     order = sorted_order(keys, ascending, nulls_first)
     return gather(values, order)

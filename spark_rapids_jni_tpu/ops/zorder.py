@@ -28,6 +28,7 @@ from jax import lax
 from ..columnar import Column, Table
 from ..columnar import dtype as dt
 from ..columnar.dtype import TypeId
+from ..utils.dispatch import op_boundary
 
 __all__ = ["interleave_bits"]
 
@@ -75,6 +76,7 @@ def _column_as_bit_limbs(col: Column) -> jnp.ndarray:
     return limbs
 
 
+@op_boundary("interleave_bits")
 def interleave_bits(num_rows: int, *columns: Column) -> Column:
     """Parity: ZOrder.interleaveBits (ZOrder.java:41) ->
     spark_rapids_jni::interleave_bits (zorder.cu:32).

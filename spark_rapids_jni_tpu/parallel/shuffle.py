@@ -30,10 +30,12 @@ from ..columnar import Table
 from ..columnar.dtype import TypeId
 from ..ops.hashing import hash_partition_map
 from ..ops.copying import gather
+from ..utils.dispatch import op_boundary
 
 __all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
 
 
+@op_boundary("hash_partition")
 def hash_partition(table: Table, num_partitions: int, key_cols: Sequence[str]) -> Tuple[Table, List[int]]:
     """Single-device cudf-style hash_partition: rows reordered so each
     partition is contiguous; returns (table, partition start offsets)."""
@@ -75,6 +77,7 @@ def _bucketize(vals: jnp.ndarray, dest: jnp.ndarray, n_parts: int, capacity: int
     )
 
 
+@op_boundary("all_to_all_exchange")
 def all_to_all_exchange(
     arrays: Sequence[jnp.ndarray],
     dest: jnp.ndarray,
@@ -118,6 +121,7 @@ def all_to_all_exchange(
     return received, recv_mask, overflow
 
 
+@op_boundary("exchange_by_key")
 def exchange_by_key(
     table: Table,
     key_cols: Sequence[str],

@@ -216,7 +216,7 @@ class NativeHostBuffer:
         ctypes.memmove(self.address + offset, data, len(data))
 
     def read(self, length: int, offset: int = 0) -> bytes:
-        if offset < 0 or offset + length > self.size:
+        if length < 0 or offset < 0 or offset + length > self.size:
             raise ValueError("read out of bounds")
         return ctypes.string_at(self.address + offset, length)
 

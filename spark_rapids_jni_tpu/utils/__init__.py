@@ -1,0 +1,4 @@
+"""Aux subsystems (SURVEY §5): op-boundary dispatch instrumentation,
+fault injection, tracing/profiling hooks, error classification."""
+
+from . import dispatch, errors, faultinj, tracing  # noqa: F401

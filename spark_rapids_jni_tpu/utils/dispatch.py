@@ -1,0 +1,51 @@
+"""Op-boundary dispatch wrapper: the JNI-entry-point analog.
+
+Every reference JNI export runs the same preamble — device binding,
+exception translation, NVTX range (RowConversionJni.cpp:42-57 pattern,
+SURVEY §2.2). ``op_boundary`` is that preamble for the TPU build: fault
+injection hook, tracing scope, and backend-error classification
+(fatal vs retryable) in one decorator applied to public ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from . import faultinj, tracing
+from .errors import DeviceError, classify
+
+__all__ = ["op_boundary"]
+
+
+def op_boundary(name: str):
+    """Wrap a public op with the dispatch preamble.
+
+    - ``faultinj.maybe_inject(name)`` fires configured faults first
+      (the CUPTI-callback interception point),
+    - ``tracing.func_range(name)`` scopes the body for XProf,
+    - backend exceptions are classified into Fatal/Retryable
+      (CATCH_STD analog); host-side ValueError/TypeError/KeyError/
+      IndexError pass through unchanged.
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            faultinj.maybe_inject(name)
+            with tracing.func_range(name):
+                try:
+                    return fn(*args, **kwargs)
+                except DeviceError:
+                    raise
+                except (ValueError, TypeError, KeyError, IndexError):
+                    raise
+                except Exception as e:  # backend / runtime failures
+                    if type(e).__module__.startswith("spark_rapids_jni_tpu"):
+                        # the op's own documented API errors (CastError,
+                        # ParquetReadError, ...) are results, not failures
+                        raise
+                    raise classify(e) from e
+
+        return wrapper
+
+    return deco

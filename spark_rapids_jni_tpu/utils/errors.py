@@ -1,0 +1,66 @@
+"""Error classification: fatal vs retryable (SURVEY §5 failure
+detection).
+
+The reference's fault-injection tool exists to verify that the upper
+framework classifies CUDA errors as fatal-context-poisoning vs
+retryable (faultinj/README.md:5-16), with `CudaFatalTest` isolated in
+its own JVM fork (pom.xml:523-532). The TPU analog: a wedged chip /
+poisoned PJRT client is `FatalDeviceError` (executor must be replaced),
+anything transient is `RetryableError` (Spark task retry semantics
+re-run the batch).
+"""
+
+from __future__ import annotations
+
+__all__ = ["DeviceError", "FatalDeviceError", "RetryableError", "classify"]
+
+
+class DeviceError(RuntimeError):
+    """Base for device-side failures crossing the runtime boundary."""
+
+
+class FatalDeviceError(DeviceError):
+    """The device/client is unusable; the executor must be torn down."""
+
+
+class RetryableError(DeviceError):
+    """Transient failure; the same batch may be retried on this device."""
+
+
+# Substrings in backend error text that indicate a dead device/client.
+_FATAL_MARKERS = (
+    "DEAD",
+    "device is in an invalid state",
+    "client has been shut down",
+    "deadlock",
+    "halted",
+    "INTERNAL: Accelerator",
+)
+
+_RETRYABLE_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "Socket closed",
+    "transient",
+)
+
+
+def classify(exc: BaseException) -> DeviceError:
+    """Map an arbitrary backend exception onto the fatal/retryable
+    taxonomy (conservative: unknown errors are fatal, like the
+    reference's CudaFatalTest treats unknown CUDA states)."""
+    if isinstance(exc, DeviceError):
+        return exc
+    text = str(exc)
+    for m in _RETRYABLE_MARKERS:
+        if m in text:
+            return RetryableError(text)
+    for m in _FATAL_MARKERS:
+        if m in text:
+            return FatalDeviceError(text)
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError)):
+        # host-side programming/input errors are not device failures;
+        # re-raise unchanged by convention (caller checks type)
+        raise exc
+    return FatalDeviceError(text)

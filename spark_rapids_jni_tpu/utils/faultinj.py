@@ -1,0 +1,149 @@
+"""Deterministic fault injection at the op-dispatch boundary.
+
+TPU-native analog of the reference's CUPTI injector (faultinj.cu, SURVEY
+§2.4/§3.5): instead of hooking the CUDA driver, faults fire inside the
+``op_boundary`` dispatch wrapper (utils/dispatch.py) — the same choke
+point every public op crosses, which is where a PJRT-level hook would
+sit. Feature parity:
+
+- JSON config (reference: FAULT_INJECTOR_CONFIG_PATH, :80, :346-408),
+  env var ``SRJT_FAULTINJ_CONFIG`` or programmatic ``configure()``,
+- match by exact op name or ``"*"`` wildcard (:142-152),
+- injection types: ``fatal`` (FatalDeviceError — the trap/assert
+  analog, :135-140), ``retryable`` (RetryableError), ``exception``
+  (plain RuntimeError — the FI_RETURN_VALUE analog),
+- ``percent`` probability + ``interceptionCount`` budget (:255-315),
+- deterministic via ``seed`` (:369-392),
+- hot reload: config file mtime is polled on each dispatch (the
+  inotify-thread analog, :429-480) when loaded from a path.
+
+Config schema (faultinj/README.md:61-141 shape)::
+
+    {
+      "seed": 12345,
+      "faults": {
+        "convert_to_rows": {"type": "retryable", "percent": 50,
+                             "interceptionCount": 2},
+        "*": {"type": "fatal", "percent": 1}
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+from .errors import FatalDeviceError, RetryableError
+
+__all__ = ["configure", "configure_from_file", "disable", "maybe_inject", "is_enabled"]
+
+
+class _Rule:
+    __slots__ = ("kind", "percent", "budget")
+
+    def __init__(self, kind: str, percent: float, budget: Optional[int]):
+        self.kind = kind
+        self.percent = percent
+        self.budget = budget  # None == unlimited
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rules: Dict[str, _Rule] = {}
+        self.rng = random.Random()
+        self.path: Optional[str] = None
+        self.mtime: float = 0.0
+        self.enabled = False
+
+
+_state = _State()
+
+
+def _parse(cfg: dict) -> None:
+    _state.rules = {}
+    for name, spec in (cfg.get("faults") or {}).items():
+        kind = spec.get("type", "retryable")
+        if kind not in ("fatal", "retryable", "exception"):
+            raise ValueError(f"faultinj: unknown fault type {kind!r}")
+        percent = float(spec.get("percent", 100))
+        budget = spec.get("interceptionCount")
+        _state.rules[name] = _Rule(kind, percent, None if budget is None else int(budget))
+    _state.rng = random.Random(cfg.get("seed"))
+
+
+def configure(cfg: dict) -> None:
+    """Install a config programmatically (tests / in-process chaos)."""
+    with _state.lock:
+        _state.path = None
+        _parse(cfg)
+        _state.enabled = bool(_state.rules)
+
+
+def configure_from_file(path: str) -> None:
+    with _state.lock:
+        with open(path) as f:
+            _parse(json.load(f))
+        _state.path = path
+        _state.mtime = os.stat(path).st_mtime
+        # file-backed configs stay active even when currently empty, so
+        # the hot-reload poll keeps running (inotify-thread analog)
+        _state.enabled = True
+
+
+def disable() -> None:
+    with _state.lock:
+        _state.rules = {}
+        _state.enabled = False
+        _state.path = None
+
+
+def is_enabled() -> bool:
+    return _state.enabled
+
+
+def _reload_if_changed() -> None:
+    if _state.path is None:
+        return
+    try:
+        m = os.stat(_state.path).st_mtime
+    except OSError:
+        return
+    if m != _state.mtime:
+        with open(_state.path) as f:
+            _parse(json.load(f))
+        _state.mtime = m
+
+
+def maybe_inject(op_name: str) -> None:
+    """Called by op_boundary before dispatch; raises the configured
+    fault or returns. Cheap when disabled (one attribute read)."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        _reload_if_changed()
+        rule = _state.rules.get(op_name) or _state.rules.get("*")
+        if rule is None:
+            return
+        if rule.budget is not None and rule.budget <= 0:
+            return
+        if _state.rng.uniform(0, 100) >= rule.percent:
+            return
+        if rule.budget is not None:
+            rule.budget -= 1
+        kind = rule.kind
+    if kind == "fatal":
+        raise FatalDeviceError(f"injected fatal fault in {op_name}")
+    if kind == "retryable":
+        raise RetryableError(f"injected retryable fault in {op_name}")
+    raise RuntimeError(f"injected exception in {op_name}")
+
+
+# env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH
+_env_cfg = os.environ.get("SRJT_FAULTINJ_CONFIG")
+if _env_cfg:
+    configure_from_file(_env_cfg)

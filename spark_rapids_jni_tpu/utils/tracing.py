@@ -1,0 +1,56 @@
+"""Tracing/profiling hooks: the NVTX-range analog (SURVEY §5).
+
+The reference wraps CPU-side hot functions in ``CUDF_FUNC_RANGE()``
+(NativeParquetJni.cpp:136 et al) and toggles NVTX via a system property.
+Here: ``func_range`` emits a ``jax.named_scope`` (visible in XLA HLO and
+XProf timelines) plus an optional ``jax.profiler.TraceAnnotation`` for
+host-side spans, toggled by ``SRJT_TRACE_ENABLED`` or ``set_enabled``.
+``profile_to`` wraps jax.profiler start/stop for Perfetto/XProf dumps —
+the nsight-systems replacement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import jax
+
+__all__ = ["set_enabled", "is_enabled", "func_range", "profile_to"]
+
+_enabled = os.environ.get("SRJT_TRACE_ENABLED", "0") == "1"
+_lock = threading.Lock()
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    with _lock:
+        _enabled = bool(on)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def func_range(name: str):
+    """Named scope over an op: no-op when tracing is off (same contract
+    as NVTX ranges — safe to leave in hot paths)."""
+    if not _enabled:
+        yield
+        return
+    with jax.named_scope(name):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    """Capture a device+host profile into ``log_dir`` (XProf/TensorBoard
+    format; the nsys-profile analog for a region)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

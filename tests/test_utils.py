@@ -1,0 +1,149 @@
+"""Aux tier tests: fault injection (deterministic, budgeted, hot-reload),
+error classification, tracing scopes, and the op_boundary preamble —
+the chaos tier the reference drives via libcufaultinj.so + JSON configs
+(SURVEY §2.4), here exercised hermetically in-process."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.aggregate import groupby_aggregate
+from spark_rapids_jni_tpu.utils import dispatch, errors, faultinj, tracing
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faultinj.disable()
+    yield
+    faultinj.disable()
+
+
+def _small_table():
+    k = Table([Column.from_pylist([1, 1, 2], dt.INT32)], ["k"])
+    v = Table([Column.from_pylist([1, 2, 3], dt.INT64)], ["v"])
+    return k, v
+
+
+class TestFaultInj:
+    def test_disabled_by_default(self):
+        assert not faultinj.is_enabled()
+        k, v = _small_table()
+        groupby_aggregate(k, v, [("v", "sum")])  # no fault
+
+    def test_named_fault_fires(self):
+        faultinj.configure(
+            {"seed": 1, "faults": {"groupby_aggregate": {"type": "retryable", "percent": 100}}}
+        )
+        k, v = _small_table()
+        with pytest.raises(errors.RetryableError, match="injected"):
+            groupby_aggregate(k, v, [("v", "sum")])
+
+    def test_wildcard_and_fatal(self):
+        faultinj.configure({"seed": 1, "faults": {"*": {"type": "fatal", "percent": 100}}})
+        k, v = _small_table()
+        with pytest.raises(errors.FatalDeviceError):
+            groupby_aggregate(k, v, [("v", "sum")])
+
+    def test_interception_budget(self):
+        faultinj.configure(
+            {
+                "seed": 1,
+                "faults": {
+                    "groupby_aggregate": {
+                        "type": "exception",
+                        "percent": 100,
+                        "interceptionCount": 2,
+                    }
+                },
+            }
+        )
+        k, v = _small_table()
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                groupby_aggregate(k, v, [("v", "sum")])
+        out = groupby_aggregate(k, v, [("v", "sum")])  # budget exhausted
+        assert out.num_rows == 2
+
+    def test_deterministic_seed(self):
+        hits = []
+        for _ in range(2):
+            faultinj.configure(
+                {"seed": 777, "faults": {"groupby_aggregate": {"type": "exception", "percent": 40}}}
+            )
+            k, v = _small_table()
+            pattern = []
+            for _ in range(20):
+                try:
+                    groupby_aggregate(k, v, [("v", "sum")])
+                    pattern.append(0)
+                except RuntimeError:
+                    pattern.append(1)
+            hits.append(pattern)
+        assert hits[0] == hits[1]  # same seed -> same interception sequence
+        assert sum(hits[0]) > 0
+
+    def test_hot_reload(self, tmp_path):
+        cfg = tmp_path / "faults.json"
+        cfg.write_text(json.dumps({"faults": {}}))
+        faultinj.configure_from_file(str(cfg))
+        k, v = _small_table()
+        groupby_aggregate(k, v, [("v", "sum")])  # no faults configured
+
+        new = {"faults": {"groupby_aggregate": {"type": "retryable", "percent": 100}}}
+        cfg.write_text(json.dumps(new))
+        os.utime(cfg, (0, 0))  # force mtime change even on coarse clocks
+        with pytest.raises(errors.RetryableError):
+            groupby_aggregate(k, v, [("v", "sum")])
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault type"):
+            faultinj.configure({"faults": {"x": {"type": "nonsense"}}})
+
+
+class TestErrors:
+    def test_classify_retryable(self):
+        e = errors.classify(RuntimeError("RESOURCE_EXHAUSTED: hbm oom"))
+        assert isinstance(e, errors.RetryableError)
+
+    def test_classify_fatal_unknown(self):
+        e = errors.classify(RuntimeError("backend exploded in a new way"))
+        assert isinstance(e, errors.FatalDeviceError)
+
+    def test_host_errors_pass_through(self):
+        with pytest.raises(ValueError):
+            errors.classify(ValueError("bad argument"))
+
+    def test_op_boundary_classifies(self):
+        @dispatch.op_boundary("boom_op")
+        def boom():
+            raise RuntimeError("UNAVAILABLE: link down")
+
+        with pytest.raises(errors.RetryableError):
+            boom()
+
+    def test_op_boundary_host_error_unwrapped(self):
+        @dispatch.op_boundary("val_op")
+        def bad():
+            raise ValueError("plain host error")
+
+        with pytest.raises(ValueError):
+            bad()
+
+
+class TestTracing:
+    def test_func_range_off_and_on(self):
+        assert not tracing.is_enabled()
+        with tracing.func_range("x"):
+            pass
+        tracing.set_enabled(True)
+        try:
+            k, v = _small_table()
+            out = groupby_aggregate(k, v, [("v", "sum")])  # runs under named_scope
+            assert out.num_rows == 2
+        finally:
+            tracing.set_enabled(False)

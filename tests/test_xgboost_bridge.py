@@ -1,0 +1,89 @@
+"""ETL -> DMatrix bridge tests (BASELINE configs[4]): dense feature
+assembly with null -> NaN, device quantile sketch vs numpy oracle, and
+hist-style binning vs searchsorted oracle."""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.models import xgboost_bridge as xb
+from spark_rapids_jni_tpu.models.datagen import Profile, create_random_table
+
+
+def _table(rng, n=500):
+    t = create_random_table(
+        [dt.FLOAT64, dt.INT32, dt.FLOAT32, dt.FLOAT64],
+        n,
+        seed=3,
+        profiles={1: Profile(null_probability=0.2)},
+        names=["f0", "f1", "f2", "label"],
+    )
+    return t
+
+
+def test_dense_assembly_and_nulls(rng):
+    t = _table(rng)
+    dm = xb.to_dmatrix(t, ["f0", "f1", "f2"], label_col="label")
+    assert dm.num_rows == 500 and dm.num_features == 3
+    assert dm.features.dtype == jnp.float32
+    f1 = np.asarray(dm.features[:, 1])
+    validity = np.asarray(t.column("f1").validity)
+    assert np.isnan(f1[~validity]).all()
+    assert not np.isnan(f1[validity]).any()
+    assert dm.labels is not None and dm.labels.shape == (500,)
+
+
+def test_string_features_rejected(rng):
+    t = Table([Column.from_pylist(["a", "b"], dt.STRING)], ["s"])
+    with pytest.raises(ValueError, match="encode string"):
+        xb.to_dmatrix(t, ["s"])
+
+
+def test_quantile_cuts_match_numpy(rng):
+    x = rng.standard_normal((1000, 3)).astype(np.float32)
+    cuts = np.asarray(xb.quantile_cuts(jnp.asarray(x), max_bins=16))
+    assert cuts.shape == (3, 15)
+    for f in range(3):
+        want = np.quantile(x[:, f], np.linspace(0, 1, 17)[1:-1], method="linear")
+        np.testing.assert_allclose(cuts[f], want, rtol=1e-5)
+        assert (np.diff(cuts[f]) >= 0).all()  # monotone
+
+
+def test_quantize_matches_searchsorted(rng):
+    x = rng.standard_normal((400, 2)).astype(np.float32)
+    x[::7, 0] = np.nan  # missing values
+    xj = jnp.asarray(x)
+    cuts = xb.quantile_cuts(xj, max_bins=8)
+    binned = np.asarray(xb.quantize(xj, cuts))
+    cuts_np = np.asarray(cuts)
+    for f in range(2):
+        col = x[:, f]
+        miss = np.isnan(col)
+        want = np.searchsorted(cuts_np[f], col[~miss], side="left")
+        np.testing.assert_array_equal(binned[~miss, f], want)
+    assert (binned[np.isnan(x[:, 0]), 0] == cuts_np.shape[1] + 1).all()
+
+
+def test_fused_build(rng):
+    t = _table(rng)
+    dm = xb.to_dmatrix(t, ["f0", "f2"], label_col="label", max_bins=32)
+    assert dm.cuts.shape == (2, 31)
+    assert dm.binned.shape == (500, 2)
+    assert int(jnp.max(dm.binned)) <= 32
+
+
+def test_all_nan_feature():
+    n = 16
+    col = Column(
+        dt.FLOAT32,
+        data=jnp.full((n,), jnp.nan, jnp.float32),
+    )
+    other = Column(dt.FLOAT32, data=jnp.arange(n, dtype=jnp.float32))
+    t = Table([col, other], ["dead", "live"])
+    dm = xb.to_dmatrix(t, ["dead", "live"], max_bins=4)
+    binned = np.asarray(dm.binned)
+    assert (binned[:, 0] == np.asarray(dm.cuts).shape[1] + 1).all()  # all missing
+    assert np.isfinite(np.asarray(dm.cuts)[1]).all()
