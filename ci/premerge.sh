@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Premerge gate (reference ci/premerge-build.sh analog): native build,
+# hermetic test suite on the virtual CPU mesh, driver entry compile
+# check, and a bench smoke. Run from the repo root.
+set -euo pipefail
+
+cmake -S native -B native/build -G Ninja
+ninja -C native/build
+
+python -m pytest tests/ -q
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python __graft_entry__.py
+
+python benchmarks/microbench.py --bench groupby --rows 65536 --reps 3

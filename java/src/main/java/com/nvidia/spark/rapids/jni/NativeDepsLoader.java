@@ -1,0 +1,27 @@
+/*
+ * NativeDepsLoader analog (reference loads .so resources from the jar,
+ * pom.xml:443-474, ${os.arch}/${os.name} layout). Here: load libsrjt
+ * from java.library.path or the SRJT_NATIVE_LIB env override.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+
+public final class NativeDepsLoader {
+  private static volatile boolean loaded = false;
+
+  public static synchronized void loadNativeDeps() {
+    if (loaded) {
+      return;
+    }
+    String override = System.getenv("SRJT_NATIVE_LIB");
+    if (override != null && new File(override).exists()) {
+      System.load(override);
+    } else {
+      System.loadLibrary("srjt");
+    }
+    loaded = true;
+  }
+
+  private NativeDepsLoader() {}
+}

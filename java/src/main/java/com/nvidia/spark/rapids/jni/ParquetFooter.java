@@ -1,0 +1,211 @@
+/*
+ * Java API contract for the TPU-native runtime (L4 tier, SURVEY §2.1).
+ *
+ * Mirrors the reference ParquetFooter.java surface (readAndFilter :200,
+ * serializeThriftFile :106, getNumRows :113, getNumColumns :120,
+ * close :124; schema DSL :35-93; depth-first flattening :136-185) over
+ * the srjt C ABI (native/src/c_api.cc) instead of cudf JNI. The native
+ * methods bind through native/src/jni/srjt_jni.cc, built when a JDK is
+ * on the toolchain (-DSRJT_BUILD_JNI=ON).
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.List;
+
+public class ParquetFooter implements AutoCloseable {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Schema element tags, matching srjt::Tag (native/src/parquet_footer.h). */
+  public abstract static class SchemaElement {
+    abstract void flatten(List<String> names, List<Integer> numChildren, List<Integer> tags);
+
+    abstract int childCount();
+
+    abstract int tag();
+  }
+
+  public static class ValueElement extends SchemaElement {
+    @Override
+    void flatten(List<String> names, List<Integer> numChildren, List<Integer> tags) {}
+
+    @Override
+    int childCount() {
+      return 0;
+    }
+
+    @Override
+    int tag() {
+      return 0;
+    }
+  }
+
+  public static class ListElement extends SchemaElement {
+    private final SchemaElement item;
+
+    public ListElement(SchemaElement item) {
+      this.item = item;
+    }
+
+    @Override
+    void flatten(List<String> names, List<Integer> numChildren, List<Integer> tags) {
+      names.add("element");
+      numChildren.add(item.childCount());
+      tags.add(item.tag());
+      item.flatten(names, numChildren, tags);
+    }
+
+    @Override
+    int childCount() {
+      return 1;
+    }
+
+    @Override
+    int tag() {
+      return 2;
+    }
+  }
+
+  public static class MapElement extends SchemaElement {
+    private final SchemaElement key;
+    private final SchemaElement value;
+
+    public MapElement(SchemaElement key, SchemaElement value) {
+      this.key = key;
+      this.value = value;
+    }
+
+    @Override
+    void flatten(List<String> names, List<Integer> numChildren, List<Integer> tags) {
+      names.add("key");
+      numChildren.add(key.childCount());
+      tags.add(key.tag());
+      key.flatten(names, numChildren, tags);
+      names.add("value");
+      numChildren.add(value.childCount());
+      tags.add(value.tag());
+      value.flatten(names, numChildren, tags);
+    }
+
+    @Override
+    int childCount() {
+      return 2;
+    }
+
+    @Override
+    int tag() {
+      return 3;
+    }
+  }
+
+  public static class StructElement extends SchemaElement {
+    private final List<String> childNames = new ArrayList<>();
+    private final List<SchemaElement> children = new ArrayList<>();
+
+    public StructElement addChild(String name, SchemaElement child) {
+      childNames.add(name);
+      children.add(child);
+      return this;
+    }
+
+    @Override
+    void flatten(List<String> names, List<Integer> numChildren, List<Integer> tags) {
+      for (int i = 0; i < children.size(); i++) {
+        SchemaElement c = children.get(i);
+        names.add(childNames.get(i));
+        numChildren.add(c.childCount());
+        tags.add(c.tag());
+        c.flatten(names, numChildren, tags);
+      }
+    }
+
+    @Override
+    int childCount() {
+      return children.size();
+    }
+
+    @Override
+    int tag() {
+      return 1;
+    }
+  }
+
+  private long nativeHandle;
+
+  private ParquetFooter(long handle) {
+    this.nativeHandle = handle;
+  }
+
+  /**
+   * Parse + prune a footer held in host memory (address/length pair, the
+   * HostMemoryBuffer contract of the reference).
+   */
+  public static ParquetFooter readAndFilter(
+      long address,
+      long length,
+      long partOffset,
+      long partLength,
+      StructElement schema,
+      boolean ignoreCase) {
+    List<String> names = new ArrayList<>();
+    List<Integer> numChildren = new ArrayList<>();
+    List<Integer> tags = new ArrayList<>();
+    schema.flatten(names, numChildren, tags);
+    int n = names.size();
+    String[] nameArr = names.toArray(new String[0]);
+    int[] childArr = new int[n];
+    int[] tagArr = new int[n];
+    for (int i = 0; i < n; i++) {
+      childArr[i] = numChildren.get(i);
+      tagArr[i] = tags.get(i);
+    }
+    long handle =
+        readAndFilterNative(
+            address, length, partOffset, partLength, nameArr, childArr, tagArr,
+            schema.childCount(), ignoreCase);
+    return new ParquetFooter(handle);
+  }
+
+  public long getNumRows() {
+    return getNumRowsNative(nativeHandle);
+  }
+
+  public int getNumColumns() {
+    return getNumColumnsNative(nativeHandle);
+  }
+
+  /** Serialized PAR1-framed footer bytes (data-less parquet file). */
+  public byte[] serializeThriftFile() {
+    return serializeThriftFileNative(nativeHandle);
+  }
+
+  @Override
+  public void close() {
+    if (nativeHandle != 0) {
+      closeNative(nativeHandle);
+      nativeHandle = 0;
+    }
+  }
+
+  private static native long readAndFilterNative(
+      long address,
+      long length,
+      long partOffset,
+      long partLength,
+      String[] names,
+      int[] numChildren,
+      int[] tags,
+      int parentNumChildren,
+      boolean ignoreCase);
+
+  private static native long getNumRowsNative(long handle);
+
+  private static native int getNumColumnsNative(long handle);
+
+  private static native byte[] serializeThriftFileNative(long handle);
+
+  private static native void closeNative(long handle);
+}

@@ -1,0 +1,105 @@
+// JNI bridge (L3 tier, SURVEY §2.2): the thin veneer between the Java
+// API contract (java/src/main/java/...) and the srjt C++ runtime —
+// the role the reference's *Jni.cpp files play (arg marshalling,
+// exception translation, handle casts; NativeParquetJni.cpp:574-706).
+//
+// Built only with -DSRJT_BUILD_JNI=ON (requires a JDK's jni.h). The
+// Python ctypes path (spark_rapids_jni_tpu/runtime.py) exercises the
+// identical underlying runtime, so this TU stays a marshalling shim.
+#include <jni.h>
+
+#include <string>
+#include <vector>
+
+#include "../parquet_footer.h"
+
+namespace {
+
+void throw_java(JNIEnv* env, const char* cls, const std::string& msg) {
+  jclass ex = env->FindClass(cls);
+  if (ex != nullptr) {
+    env->ThrowNew(ex, msg.c_str());
+  }
+}
+
+srjt::ParquetFooter* as_footer(jlong handle) {
+  return reinterpret_cast<srjt::ParquetFooter*>(handle);
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_readAndFilterNative(
+    JNIEnv* env, jclass, jlong address, jlong length, jlong part_offset, jlong part_length,
+    jobjectArray names, jintArray num_children, jintArray tags, jint parent_num_children,
+    jboolean ignore_case) {
+  try {
+    jsize n = env->GetArrayLength(names);
+    std::vector<std::string> names_v;
+    names_v.reserve(n);
+    for (jsize i = 0; i < n; ++i) {
+      auto jstr = static_cast<jstring>(env->GetObjectArrayElement(names, i));
+      const char* chars = env->GetStringUTFChars(jstr, nullptr);
+      names_v.emplace_back(chars);
+      env->ReleaseStringUTFChars(jstr, chars);
+      env->DeleteLocalRef(jstr);
+    }
+    std::vector<int32_t> nc_v(n), tag_v(n);
+    env->GetIntArrayRegion(num_children, 0, n, nc_v.data());
+    env->GetIntArrayRegion(tags, 0, n, tag_v.data());
+
+    auto footer = srjt::read_and_filter(
+        reinterpret_cast<const uint8_t*>(address), length, part_offset, part_length, names_v,
+        nc_v, tag_v, parent_num_children, ignore_case != JNI_FALSE);
+    return reinterpret_cast<jlong>(footer.release());
+  } catch (const std::exception& e) {
+    throw_java(env, "java/lang/RuntimeException", e.what());
+    return 0;
+  }
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumRowsNative(
+    JNIEnv* env, jclass, jlong handle) {
+  try {
+    return as_footer(handle)->num_rows();
+  } catch (const std::exception& e) {
+    throw_java(env, "java/lang/RuntimeException", e.what());
+    return 0;
+  }
+}
+
+JNIEXPORT jint JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumColumnsNative(
+    JNIEnv* env, jclass, jlong handle) {
+  try {
+    return as_footer(handle)->num_columns();
+  } catch (const std::exception& e) {
+    throw_java(env, "java/lang/RuntimeException", e.what());
+    return 0;
+  }
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeThriftFileNative(
+    JNIEnv* env, jclass, jlong handle) {
+  try {
+    std::string blob = as_footer(handle)->serialize_thrift_file();
+    jbyteArray out = env->NewByteArray(static_cast<jsize>(blob.size()));
+    if (out != nullptr) {
+      env->SetByteArrayRegion(out, 0, static_cast<jsize>(blob.size()),
+                              reinterpret_cast<const jbyte*>(blob.data()));
+    }
+    return out;
+  } catch (const std::exception& e) {
+    throw_java(env, "java/lang/RuntimeException", e.what());
+    return nullptr;
+  }
+}
+
+JNIEXPORT void JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative(
+    JNIEnv*, jclass, jlong handle) {
+  delete as_footer(handle);
+}
+
+}  // extern "C"

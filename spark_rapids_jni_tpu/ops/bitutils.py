@@ -33,7 +33,25 @@ __all__ = [
     "float_store",
     "total_order_key",
     "backend_has_f64",
+    "ragged_positions",
 ]
+
+
+def ragged_positions(lens):
+    """Shared ragged-compaction index math: [N] int32 lengths ->
+    (offsets [N+1] i32, row_of [total] i32, pos_in_row [total] i32,
+    total). One host sync for `total` (the output-allocation sync every
+    engine pays). Used by the string compactions in ops/strings and the
+    JCUDF string decode."""
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    total = int(offs[-1])  # host sync: chars allocation size
+    if total == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return offs, z, z, 0
+    j = jnp.arange(total, dtype=jnp.int32)
+    row_of = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
+    pos = j - offs[row_of]
+    return offs, row_of, pos, total
 
 
 def backend_has_f64() -> bool:

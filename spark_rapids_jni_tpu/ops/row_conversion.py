@@ -449,16 +449,11 @@ def _assemble_from_rows(dtypes, col_datas, valid_cols, blob, starts, n) -> Table
             in_off, ln32 = col_datas[i]
             in_off = in_off.astype(jnp.int64)
             ln = ln32.astype(jnp.int32)
-            out_offs = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(ln, dtype=jnp.int32)]
-            )
-            total = int(out_offs[-1])  # host sync: chars allocation size
+            out_offs, row_of, pos, total = bitutils.ragged_positions(ln)
             if total == 0:
                 chars = jnp.zeros((0,), jnp.uint8)
             else:
-                j = jnp.arange(total, dtype=jnp.int32)
-                row_of = jnp.searchsorted(out_offs, j, side="right").astype(jnp.int32) - 1
-                src = starts[row_of] + in_off[row_of] + (j - out_offs[row_of]).astype(jnp.int64)
+                src = starts[row_of] + in_off[row_of] + pos.astype(jnp.int64)
                 chars = blob[src]
             out_cols.append(Column(d, validity=vmask, offsets=out_offs, chars=chars))
         else:
@@ -495,24 +490,52 @@ def _decode_fixed_groups(layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jn
 
     # NOTE on shapes: everything stays 2-D. A tempting "lane view"
     # (reshape [N, P/w, w] + bitcast) OOMs on TPU — XLA tile-pads the
-    # tiny minor dim (w -> 128), a 32x memory blow-up for w=4.
+    # tiny minor dim (w -> 128), a 32x memory blow-up for w=4. Instead,
+    # wide lanes are built ARITHMETICALLY from strided byte slices
+    # (fixed[:, b::4]), which are large-minor 2-D ops, and every group
+    # read is a take of lane indices — w× fewer gather elements than
+    # byte addressing.
+    pad_w = _round_up(fixed.shape[1], 8)
+    fixed_p = (
+        jnp.pad(fixed, ((0, 0), (0, pad_w - fixed.shape[1])))
+        if pad_w != fixed.shape[1]
+        else fixed
+    )
+    widths = {_entry_width(k) for k in groups}
+    lane16 = lane32 = None
+    if 2 in widths:
+        b = [fixed_p[:, i::2].astype(jnp.uint16) for i in range(2)]
+        lane16 = b[0] | (b[1] << jnp.uint16(8))  # [N, P/2]
+    if 4 in widths or 8 in widths:
+        b = [fixed_p[:, i::4].astype(jnp.uint32) for i in range(4)]
+        lane32 = b[0] | (b[1] << jnp.uint32(8)) | (b[2] << jnp.uint32(16)) | (
+            b[3] << jnp.uint32(24)
+        )  # [N, P/4]
+
     group_arrays: dict = {}
     for key, count in groups.items():
         w = _entry_width(key)
-        perm = np.zeros((count * w,), np.int32)
-        # row-byte source for each entry's bytes, in group slot order
+        lane_idx = np.zeros((count,), np.int32)
         for col_entries in entries:
             for k2, idx, row_byte in col_entries:
                 if k2 == key:
-                    perm[idx * w : (idx + 1) * w] = np.arange(row_byte, row_byte + w)
-        grp_bytes = jnp.take(fixed, jnp.asarray(perm), axis=1)  # [N, k*w]
+                    lane_idx[idx] = row_byte // (4 if w == 8 else w)
+        idxs = jnp.asarray(lane_idx)
+        if w == 1:
+            lanes = jnp.take(fixed_p, idxs, axis=1)  # [N, k] u8
+        elif w == 2:
+            lanes = jnp.take(lane16, idxs, axis=1)
+        elif w == 4:
+            lanes = jnp.take(lane32, idxs, axis=1)
+        else:  # w == 8: two u32 lanes -> one u64
+            lo = jnp.take(lane32, idxs, axis=1).astype(jnp.uint64)
+            hi = jnp.take(lane32, idxs + 1, axis=1).astype(jnp.uint64)
+            lanes = lo | (hi << jnp.uint64(32))
         if key == "u4":
-            typed = lax.bitcast_convert_type(grp_bytes.reshape(n, count, 4), jnp.uint32)
-        elif w == 1:
-            typed = grp_bytes.reshape(n, count)
+            typed = lanes
         else:
-            dt_name = key[key.index("_") + 1 :]
-            typed = lax.bitcast_convert_type(grp_bytes.reshape(n, count, w), jnp.dtype(dt_name))
+            target = jnp.dtype(key[key.index("_") + 1 :])
+            typed = lanes if lanes.dtype == target else lax.bitcast_convert_type(lanes, target)
         # materialize the group ONCE: without the barrier XLA happily
         # rematerializes the gather inside every per-column consumer
         # fusion, turning O(bytes) work into O(bytes * columns)

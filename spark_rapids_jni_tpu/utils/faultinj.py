@@ -143,7 +143,14 @@ def maybe_inject(op_name: str) -> None:
     raise RuntimeError(f"injected exception in {op_name}")
 
 
-# env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH
+# env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH.
+# A bad/missing config degrades the injector, never the host process
+# (the reference's injector has the same stance).
 _env_cfg = os.environ.get("SRJT_FAULTINJ_CONFIG")
 if _env_cfg:
-    configure_from_file(_env_cfg)
+    try:
+        configure_from_file(_env_cfg)
+    except (OSError, ValueError) as e:  # ValueError covers JSONDecodeError
+        import warnings
+
+        warnings.warn(f"faultinj: ignoring SRJT_FAULTINJ_CONFIG ({e})", stacklevel=1)

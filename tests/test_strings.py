@@ -1,0 +1,117 @@
+"""String operator tier tests, Python str methods as the oracle."""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops import strings as ss
+
+SAMPLES = ["hello", "", "World", "MiXeD Case 123", "  padded  ", "a", "xyzzy plugh", None, "Zz"]
+
+
+def col(vals=SAMPLES):
+    return Column.from_pylist(vals, dt.STRING)
+
+
+def got_strings(c):
+    out = []
+    offs = np.asarray(c.offsets)
+    chars = np.asarray(c.chars).tobytes()
+    valid = None if c.validity is None else np.asarray(c.validity)
+    for i in range(len(offs) - 1):
+        if valid is not None and not valid[i]:
+            out.append(None)
+        else:
+            out.append(chars[offs[i] : offs[i + 1]].decode())
+    return out
+
+
+def oracle(fn):
+    return [None if s is None else fn(s) for s in SAMPLES]
+
+
+def test_length():
+    out = ss.length(col())
+    want = [None if s is None else len(s) for s in SAMPLES]
+    data = np.asarray(out.data)
+    for i, w in enumerate(want):
+        if w is not None:
+            assert data[i] == w
+
+
+def test_upper_lower():
+    assert got_strings(ss.upper(col())) == oracle(str.upper)
+    assert got_strings(ss.lower(col())) == oracle(str.lower)
+
+
+@pytest.mark.parametrize(
+    "start,slen",
+    [(1, 3), (2, None), (0, 2), (-3, 2), (-100, None), (5, 100), (100, 5), (-10, 3), (-6, 3)],
+)
+def test_substring(start, slen):
+    out = ss.substring(col(), start, slen)
+
+    def py_sub(s):
+        # Spark UTF8String.substringSQL: window computed pre-clamp, so a
+        # negative start spends its length budget before the string
+        if start > 0:
+            b0 = start - 1
+        elif start == 0:
+            b0 = 0
+        else:
+            b0 = len(s) + start
+        e0 = len(s) if slen is None else b0 + max(slen, 0)
+        b = min(max(b0, 0), len(s))
+        e = min(max(e0, 0), len(s))
+        return s[b:e] if e > b else ""
+
+    assert got_strings(out) == oracle(py_sub)
+
+
+def test_concat_with_separator():
+    a = Column.from_pylist(["x", "hello", "", None], dt.STRING)
+    b = Column.from_pylist(["y", "world", "z", "q"], dt.STRING)
+    out = ss.concat([a, b], b"--")
+    assert got_strings(out) == ["x--y", "hello--world", "--z", None]
+
+
+def test_concat_no_separator():
+    a = Column.from_pylist(["ab", ""], dt.STRING)
+    b = Column.from_pylist(["cd", "ef"], dt.STRING)
+    assert got_strings(ss.concat([a, b])) == ["abcd", "ef"]
+
+
+@pytest.mark.parametrize("pat", [b"l", b"Case", b"", b"zz", b"notthere", b"xyzzy plugh!"])
+def test_contains(pat):
+    out = ss.contains(col(), pat)
+    want = oracle(lambda s: pat.decode() in s)
+    data = np.asarray(out.data).astype(bool)
+    for i, w in enumerate(want):
+        if w is not None:
+            assert bool(data[i]) == w, (i, pat)
+
+
+@pytest.mark.parametrize("pat", [b"he", b"", b"World", b"  "])
+def test_startswith_endswith(pat):
+    sw = np.asarray(ss.startswith(col(), pat).data).astype(bool)
+    ew = np.asarray(ss.endswith(col(), pat).data).astype(bool)
+    want_s = oracle(lambda s: s.startswith(pat.decode()))
+    want_e = oracle(lambda s: s.endswith(pat.decode()))
+    for i in range(len(SAMPLES)):
+        if want_s[i] is not None:
+            assert bool(sw[i]) == want_s[i]
+            assert bool(ew[i]) == want_e[i]
+
+
+def test_strip():
+    vals = ["  hi  ", "nospace", "   ", "", " x", "y ", None]
+    out = ss.strip(Column.from_pylist(vals, dt.STRING))
+    assert got_strings(out) == [None if v is None else v.strip(" ") for v in vals]
+
+
+def test_empty_column():
+    c = Column.from_pylist([], dt.STRING)
+    assert got_strings(ss.upper(c)) == []
+    assert got_strings(ss.substring(c, 1, 2)) == []
