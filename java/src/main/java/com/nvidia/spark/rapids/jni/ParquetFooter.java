@@ -155,6 +155,13 @@ public class ParquetFooter implements AutoCloseable {
     List<Integer> tags = new ArrayList<>();
     schema.flatten(names, numChildren, tags);
     int n = names.size();
+    if (ignoreCase) {
+      // requested names fold API-side (reference ParquetFooter.java:207);
+      // the native walk folds only the file-side schema names
+      for (int i = 0; i < n; i++) {
+        names.set(i, names.get(i).toLowerCase());
+      }
+    }
     String[] nameArr = names.toArray(new String[0]);
     int[] childArr = new int[n];
     int[] tagArr = new int[n];

@@ -11,6 +11,7 @@
 #include "handle_registry.h"
 #include "host_buffer.h"
 #include "parquet_footer.h"
+#include "snappy.h"
 
 #define SRJT_EXPORT extern "C" __attribute__((visibility("default")))
 
@@ -148,3 +149,20 @@ SRJT_EXPORT int64_t srjt_host_size(int64_t h) {
 SRJT_EXPORT void srjt_host_free(int64_t h) { buffers().release(h); }
 
 SRJT_EXPORT int64_t srjt_host_bytes_in_use() { return srjt::HostBuffer::bytes_in_use(); }
+
+// -- compression codecs ------------------------------------------------------
+
+SRJT_EXPORT int64_t srjt_snappy_uncompressed_length(const uint8_t* src, int64_t src_len) {
+  return guarded([&]() -> int64_t { return srjt::snappy_uncompressed_length(src, src_len); },
+                 -1);
+}
+
+SRJT_EXPORT int32_t srjt_snappy_uncompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                                           int64_t dst_len) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        srjt::snappy_uncompress(src, src_len, dst, dst_len);
+        return 0;
+      },
+      -1));
+}

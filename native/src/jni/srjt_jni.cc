@@ -1,29 +1,44 @@
 // JNI bridge (L3 tier, SURVEY §2.2): the thin veneer between the Java
-// API contract (java/src/main/java/...) and the srjt C++ runtime —
-// the role the reference's *Jni.cpp files play (arg marshalling,
-// exception translation, handle casts; NativeParquetJni.cpp:574-706).
+// API contract (java/src/main/java/...) and the srjt runtime — the role
+// the reference's *Jni.cpp files play (arg marshalling, exception
+// translation, handle casts; NativeParquetJni.cpp:574-706).
 //
-// Built only with -DSRJT_BUILD_JNI=ON (requires a JDK's jni.h). The
-// Python ctypes path (spark_rapids_jni_tpu/runtime.py) exercises the
-// identical underlying runtime, so this TU stays a marshalling shim.
+// All calls route through the SAME C ABI the ctypes path uses
+// (c_api.cc): handles come from the validated registry, so a
+// use-after-close raises a Java RuntimeException instead of
+// dereferencing a dangling pointer, and srjt_live_handles leak
+// accounting sees JNI-created footers too.
+//
+// Built only with -DSRJT_BUILD_JNI=ON (requires a JDK's jni.h).
 #include <jni.h>
 
+#include <cstdint>
 #include <string>
 #include <vector>
 
-#include "../parquet_footer.h"
+extern "C" {
+// C ABI (c_api.cc, same shared library)
+const char* srjt_last_error();
+int64_t srjt_footer_read_and_filter(const uint8_t* buf, int64_t len, int64_t part_offset,
+                                    int64_t part_length, const char* const* names,
+                                    const int32_t* num_children, const int32_t* tags,
+                                    int32_t n_elems, int32_t parent_num_children,
+                                    int32_t ignore_case);
+int64_t srjt_footer_num_rows(int64_t h);
+int32_t srjt_footer_num_columns(int64_t h);
+int64_t srjt_footer_serialize(int64_t h, int64_t* out_size);
+int32_t srjt_blob_copy(int64_t blob_h, uint8_t* out, int64_t capacity);
+void srjt_blob_free(int64_t blob_h);
+void srjt_footer_close(int64_t h);
+}
 
 namespace {
 
-void throw_java(JNIEnv* env, const char* cls, const std::string& msg) {
-  jclass ex = env->FindClass(cls);
+void throw_last_error(JNIEnv* env) {
+  jclass ex = env->FindClass("java/lang/RuntimeException");
   if (ex != nullptr) {
-    env->ThrowNew(ex, msg.c_str());
+    env->ThrowNew(ex, srjt_last_error());
   }
-}
-
-srjt::ParquetFooter* as_footer(jlong handle) {
-  return reinterpret_cast<srjt::ParquetFooter*>(handle);
 }
 
 }  // namespace
@@ -35,71 +50,78 @@ Java_com_nvidia_spark_rapids_jni_ParquetFooter_readAndFilterNative(
     JNIEnv* env, jclass, jlong address, jlong length, jlong part_offset, jlong part_length,
     jobjectArray names, jintArray num_children, jintArray tags, jint parent_num_children,
     jboolean ignore_case) {
-  try {
-    jsize n = env->GetArrayLength(names);
-    std::vector<std::string> names_v;
-    names_v.reserve(n);
-    for (jsize i = 0; i < n; ++i) {
-      auto jstr = static_cast<jstring>(env->GetObjectArrayElement(names, i));
-      const char* chars = env->GetStringUTFChars(jstr, nullptr);
-      names_v.emplace_back(chars);
-      env->ReleaseStringUTFChars(jstr, chars);
-      env->DeleteLocalRef(jstr);
-    }
-    std::vector<int32_t> nc_v(n), tag_v(n);
-    env->GetIntArrayRegion(num_children, 0, n, nc_v.data());
-    env->GetIntArrayRegion(tags, 0, n, tag_v.data());
-
-    auto footer = srjt::read_and_filter(
-        reinterpret_cast<const uint8_t*>(address), length, part_offset, part_length, names_v,
-        nc_v, tag_v, parent_num_children, ignore_case != JNI_FALSE);
-    return reinterpret_cast<jlong>(footer.release());
-  } catch (const std::exception& e) {
-    throw_java(env, "java/lang/RuntimeException", e.what());
-    return 0;
+  jsize n = env->GetArrayLength(names);
+  std::vector<std::string> names_v;
+  std::vector<const char*> name_ptrs;
+  names_v.reserve(n);
+  name_ptrs.reserve(n);
+  for (jsize i = 0; i < n; ++i) {
+    auto jstr = static_cast<jstring>(env->GetObjectArrayElement(names, i));
+    const char* chars = env->GetStringUTFChars(jstr, nullptr);
+    names_v.emplace_back(chars);
+    env->ReleaseStringUTFChars(jstr, chars);
+    env->DeleteLocalRef(jstr);
   }
+  for (const std::string& s : names_v) name_ptrs.push_back(s.c_str());
+  std::vector<int32_t> nc_v(n), tag_v(n);
+  env->GetIntArrayRegion(num_children, 0, n, nc_v.data());
+  env->GetIntArrayRegion(tags, 0, n, tag_v.data());
+
+  int64_t handle = srjt_footer_read_and_filter(
+      reinterpret_cast<const uint8_t*>(address), length, part_offset, part_length,
+      name_ptrs.data(), nc_v.data(), tag_v.data(), n, parent_num_children,
+      ignore_case != JNI_FALSE ? 1 : 0);
+  if (handle == 0) {
+    throw_last_error(env);
+  }
+  return handle;
 }
 
 JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumRowsNative(
     JNIEnv* env, jclass, jlong handle) {
-  try {
-    return as_footer(handle)->num_rows();
-  } catch (const std::exception& e) {
-    throw_java(env, "java/lang/RuntimeException", e.what());
-    return 0;
+  int64_t v = srjt_footer_num_rows(handle);
+  if (v < 0) {
+    throw_last_error(env);
   }
+  return v;
 }
 
 JNIEXPORT jint JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumColumnsNative(
     JNIEnv* env, jclass, jlong handle) {
-  try {
-    return as_footer(handle)->num_columns();
-  } catch (const std::exception& e) {
-    throw_java(env, "java/lang/RuntimeException", e.what());
-    return 0;
+  int32_t v = srjt_footer_num_columns(handle);
+  if (v < 0) {
+    throw_last_error(env);
   }
+  return v;
 }
 
 JNIEXPORT jbyteArray JNICALL
 Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeThriftFileNative(
     JNIEnv* env, jclass, jlong handle) {
-  try {
-    std::string blob = as_footer(handle)->serialize_thrift_file();
-    jbyteArray out = env->NewByteArray(static_cast<jsize>(blob.size()));
-    if (out != nullptr) {
-      env->SetByteArrayRegion(out, 0, static_cast<jsize>(blob.size()),
-                              reinterpret_cast<const jbyte*>(blob.data()));
-    }
-    return out;
-  } catch (const std::exception& e) {
-    throw_java(env, "java/lang/RuntimeException", e.what());
+  int64_t size = 0;
+  int64_t blob = srjt_footer_serialize(handle, &size);
+  if (blob == 0) {
+    throw_last_error(env);
     return nullptr;
   }
+  std::vector<uint8_t> tmp(static_cast<size_t>(size));
+  if (srjt_blob_copy(blob, tmp.data(), size) != 0) {
+    srjt_blob_free(blob);
+    throw_last_error(env);
+    return nullptr;
+  }
+  srjt_blob_free(blob);
+  jbyteArray out = env->NewByteArray(static_cast<jsize>(size));
+  if (out != nullptr) {
+    env->SetByteArrayRegion(out, 0, static_cast<jsize>(size),
+                            reinterpret_cast<const jbyte*>(tmp.data()));
+  }
+  return out;
 }
 
 JNIEXPORT void JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative(
     JNIEnv*, jclass, jlong handle) {
-  delete as_footer(handle);
+  srjt_footer_close(handle);
 }
 
 }  // extern "C"

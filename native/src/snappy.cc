@@ -1,0 +1,102 @@
+#include "snappy.h"
+
+#include <cstring>
+
+namespace srjt {
+
+namespace {
+
+// little-endian varint32; returns bytes consumed, writes value
+int read_varint(const uint8_t* src, int64_t len, uint32_t* value) {
+  uint32_t result = 0;
+  int shift = 0;
+  for (int i = 0; i < 5; ++i) {
+    if (i >= len) throw SnappyError("snappy: truncated preamble");
+    uint8_t b = src[i];
+    result |= static_cast<uint32_t>(b & 0x7F) << shift;
+    if ((b & 0x80) == 0) {
+      *value = result;
+      return i + 1;
+    }
+    shift += 7;
+  }
+  throw SnappyError("snappy: preamble varint too long");
+}
+
+}  // namespace
+
+int64_t snappy_uncompressed_length(const uint8_t* src, int64_t src_len) {
+  uint32_t n = 0;
+  read_varint(src, src_len, &n);
+  return n;
+}
+
+void snappy_uncompress(const uint8_t* src, int64_t src_len, uint8_t* dst, int64_t dst_len) {
+  uint32_t expect = 0;
+  int64_t ip = read_varint(src, src_len, &expect);
+  if (static_cast<int64_t>(expect) != dst_len) {
+    throw SnappyError("snappy: output buffer size != preamble length");
+  }
+  int64_t op = 0;
+
+  auto need_src = [&](int64_t n) {
+    if (ip + n > src_len) throw SnappyError("snappy: truncated input");
+  };
+  auto need_dst = [&](int64_t n) {
+    if (op + n > dst_len) throw SnappyError("snappy: output overrun");
+  };
+
+  while (ip < src_len) {
+    uint8_t tag = src[ip++];
+    uint32_t kind = tag & 0x3;
+    if (kind == 0) {  // literal
+      int64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        int extra = static_cast<int>(len - 60);  // 1..4 length bytes
+        need_src(extra);
+        uint32_t l = 0;
+        for (int k = 0; k < extra; ++k) l |= static_cast<uint32_t>(src[ip + k]) << (8 * k);
+        ip += extra;
+        len = static_cast<int64_t>(l) + 1;
+      }
+      need_src(len);
+      need_dst(len);
+      std::memcpy(dst + op, src + ip, static_cast<size_t>(len));
+      ip += len;
+      op += len;
+      continue;
+    }
+
+    int64_t len;
+    int64_t offset;
+    if (kind == 1) {  // copy, 1-byte offset
+      need_src(1);
+      len = ((tag >> 2) & 0x7) + 4;
+      offset = (static_cast<int64_t>(tag & 0xE0) << 3) | src[ip];
+      ip += 1;
+    } else if (kind == 2) {  // copy, 2-byte offset
+      need_src(2);
+      len = (tag >> 2) + 1;
+      offset = src[ip] | (static_cast<int64_t>(src[ip + 1]) << 8);
+      ip += 2;
+    } else {  // copy, 4-byte offset
+      need_src(4);
+      len = (tag >> 2) + 1;
+      offset = src[ip] | (static_cast<int64_t>(src[ip + 1]) << 8) |
+               (static_cast<int64_t>(src[ip + 2]) << 16) |
+               (static_cast<int64_t>(src[ip + 3]) << 24);
+      ip += 4;
+    }
+    if (offset == 0 || offset > op) throw SnappyError("snappy: invalid copy offset");
+    need_dst(len);
+    // overlapping copies are legal (offset < len repeats a pattern);
+    // byte loop preserves that semantic
+    for (int64_t k = 0; k < len; ++k) {
+      dst[op + k] = dst[op - offset + k];
+    }
+    op += len;
+  }
+  if (op != dst_len) throw SnappyError("snappy: short output");
+}
+
+}  // namespace srjt

@@ -1,0 +1,25 @@
+// Snappy block-format decompressor — the compression tier of the native
+// runtime (the reference ships nvcomp in its jar for GPU decompression,
+// pom.xml:464-469; parquet pages are snappy-compressed by default).
+// Implemented from the public snappy format description; no third-party
+// code.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace srjt {
+
+struct SnappyError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Returns the uncompressed length encoded in the stream preamble.
+int64_t snappy_uncompressed_length(const uint8_t* src, int64_t src_len);
+
+// Decompress src into dst (dst_len must equal the preamble length).
+// Throws SnappyError on malformed input.
+void snappy_uncompress(const uint8_t* src, int64_t src_len, uint8_t* dst, int64_t dst_len);
+
+}  // namespace srjt

@@ -104,6 +104,12 @@ _CMD_TOTAL_UNCOMPRESSED = 6
 def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> bytes:
     if codec is None:
         return data
+    if codec == "snappy":
+        # native codec tier first (nvcomp analog, native/src/snappy.cc)
+        from .. import runtime
+
+        if runtime.native_available():
+            return runtime.snappy_uncompress(data, uncompressed_size)
     import pyarrow as pa
 
     return pa.Codec(codec).decompress(data, decompressed_size=uncompressed_size).to_pybytes()

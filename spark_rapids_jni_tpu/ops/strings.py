@@ -207,8 +207,6 @@ def contains(col: Column, pattern: bytes) -> Column:
     pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     pos = jnp.broadcast_to(pos, (n, L))
     hit = jnp.any(_match_at(padded, lens, pattern, pos), axis=1)
-    if len(pattern) == 0:
-        hit = jnp.ones((n,), bool)
     return _bool_col(hit, col.validity)
 
 

@@ -1,0 +1,142 @@
+"""Distributed hash join over the mesh (the exchange-heavy TPC-DS q95
+shape, BASELINE.json configs[3]).
+
+Plan shape = Spark's shuffled hash join on the RAPIDS plugin: both
+sides hash-partition by key onto the same shard (two all_to_all
+exchanges over ICI), then each shard joins its buckets locally — all
+one compiled program under ``shard_map``.
+
+The local join is static-shape (XLA discipline): sort the received
+right side by key, locate each left row's match run with two
+searchsorted probes, expand runs into (left, right) index pairs bounded
+by ``out_capacity`` with an occupancy mask; run overflow is *detected*
+(flag) like the shuffle's bucket overflow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.dispatch import op_boundary
+from .distributed import _hash_dest
+from .shuffle import _bucketize
+
+__all__ = ["shard_join_pairs", "distributed_inner_join"]
+
+
+def shard_join_pairs(
+    lk: jnp.ndarray,  # [nl] left keys
+    lp: jnp.ndarray,  # [nl] left present mask
+    rk: jnp.ndarray,  # [nr] right keys
+    rp: jnp.ndarray,  # [nr] right present mask
+    out_capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Static-shape inner-join pair expansion.
+
+    Returns (left_idx[out_capacity], right_idx[out_capacity],
+    pair_valid[out_capacity], overflow[]). Indices refer to the input
+    arrays; absent rows never match.
+    """
+    nr = rk.shape[0]
+    # sort right by (absent-last, key); absent rows can't collide with
+    # any real key because occupancy is the primary sort key
+    rorder = jnp.lexsort((rk, ~rp))
+    rks = rk[rorder]
+    rps = rp[rorder]
+    n_right_valid = jnp.sum(rps.astype(jnp.int32))
+
+    # padding rows sit after the valid prefix but carry arbitrary key
+    # values; give them the max key so the PROBE array stays monotone.
+    # A real max-valued key's run can then extend into padding — the
+    # clamp to n_right_valid below cuts it back to real rows only.
+    rks_probe = jnp.where(rps, rks, jnp.iinfo(rks.dtype).max)
+
+    # match runs, bounded to the valid prefix
+    lo = jnp.searchsorted(rks_probe, lk, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rks_probe, lk, side="right").astype(jnp.int32)
+    lo = jnp.minimum(lo, n_right_valid)
+    hi = jnp.minimum(hi, n_right_valid)
+    cnt = jnp.where(lp, hi - lo, 0)
+
+    starts = jnp.cumsum(cnt) - cnt  # exclusive scan
+    total = starts[-1] + cnt[-1] if cnt.shape[0] else jnp.zeros((), cnt.dtype)
+    overflow = total > out_capacity
+
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    # left row owning output slot j = first row whose cumulative END
+    # exceeds j; empty runs (cnt 0) have end == start <= j and are
+    # skipped by the 'right' search, so they never claim a slot
+    ends = starts + cnt
+    left_row = jnp.clip(
+        jnp.searchsorted(ends, j, side="right"), 0, max(lk.shape[0] - 1, 0)
+    ).astype(jnp.int32)
+    within = j - starts[left_row]
+    pair_valid = (j < total) & (within >= 0) & (within < cnt[left_row])
+    right_sorted_idx = jnp.clip(lo[left_row] + within, 0, max(nr - 1, 0))
+    right_row = rorder[right_sorted_idx].astype(jnp.int32)
+    return left_row, right_row, pair_valid, overflow
+
+
+@op_boundary("distributed_inner_join")
+def distributed_inner_join(
+    left_key: jnp.ndarray,  # [NL_global] row-sharded
+    left_val: jnp.ndarray,  # [NL_global]
+    right_key: jnp.ndarray,  # [NR_global] row-sharded
+    right_val: jnp.ndarray,  # [NR_global]
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+):
+    """Inner join on integer keys across the mesh; returns host arrays
+    (lk, lv, rv) of matched rows plus an overflow flag.
+
+    One program: pmod partition of both sides -> two all_to_alls ->
+    per-shard sorted-run join. ``capacity`` bounds per-destination
+    bucket rows; ``out_capacity`` bounds per-shard output pairs.
+    """
+    n_parts = mesh.shape[axis]
+    per_l = left_key.shape[0] // n_parts
+    per_r = right_key.shape[0] // n_parts
+    if capacity is None:
+        capacity = max(per_l, per_r)
+    if out_capacity is None:
+        out_capacity = capacity * n_parts * 2
+    cap_out = int(out_capacity)
+
+    def body(lk, lv, rk, rv):
+        ld = _hash_dest(lk, n_parts)
+        rd = _hash_dest(rk, n_parts)
+        lkb, lmask, o1 = _bucketize(lk, ld, n_parts, capacity)
+        lvb, _, _ = _bucketize(lv, ld, n_parts, capacity)
+        rkb, rmask, o2 = _bucketize(rk, rd, n_parts, capacity)
+        rvb, _, _ = _bucketize(rv, rd, n_parts, capacity)
+        a2a = lambda x: lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+        lkr, lvr, lmr = a2a(lkb).reshape(-1), a2a(lvb).reshape(-1), a2a(lmask).reshape(-1)
+        rkr, rvr, rmr = a2a(rkb).reshape(-1), a2a(rvb).reshape(-1), a2a(rmask).reshape(-1)
+
+        li, ri, pv, o3 = shard_join_pairs(lkr, lmr, rkr, rmr, cap_out)
+        out_k = jnp.where(pv, lkr[li], 0)
+        out_lv = jnp.where(pv, lvr[li], 0)
+        out_rv = jnp.where(pv, rvr[ri], 0)
+        ovf = (o1 | o2 | o3)[None]
+        return out_k[None], out_lv[None], out_rv[None], pv[None], ovf
+
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+    )
+    k, lv, rv, pv, ovf = f(left_key, left_val, right_key, right_val)
+    k_h = np.asarray(k).reshape(-1)
+    lv_h = np.asarray(lv).reshape(-1)
+    rv_h = np.asarray(rv).reshape(-1)
+    pv_h = np.asarray(pv).reshape(-1)
+    return k_h[pv_h], lv_h[pv_h], rv_h[pv_h], bool(np.asarray(ovf).any())

@@ -22,9 +22,28 @@ __all__ = [
     "native_available",
     "native_lib",
     "live_handles",
+    "snappy_uncompress",
     "NativeParquetFooter",
     "NativeHostBuffer",
 ]
+
+
+def snappy_uncompress(data: bytes, expected_size: Optional[int] = None) -> bytes:
+    """Decompress a snappy block via the native codec tier (nvcomp
+    analog). Raises RuntimeError if the native library is missing or the
+    stream is malformed."""
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    n = lib.srjt_snappy_uncompressed_length(data, len(data))
+    if n < 0:
+        _raise_last(lib)
+    if expected_size is not None and n != expected_size:
+        raise RuntimeError(f"snappy: preamble size {n} != expected {expected_size}")
+    out = ctypes.create_string_buffer(int(n))
+    if lib.srjt_snappy_uncompress(data, len(data), out, n) != 0:
+        _raise_last(lib)
+    return out.raw
 
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -77,6 +96,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_host_size.argtypes = [ctypes.c_int64]
     lib.srjt_host_free.argtypes = [ctypes.c_int64]
     lib.srjt_host_bytes_in_use.restype = ctypes.c_int64
+    lib.srjt_snappy_uncompressed_length.restype = ctypes.c_int64
+    lib.srjt_snappy_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.srjt_snappy_uncompress.restype = ctypes.c_int32
+    lib.srjt_snappy_uncompress.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
     return lib
 
 
@@ -91,7 +119,10 @@ def native_lib() -> Optional[ctypes.CDLL]:
                 try:
                     _LIB = _bind(ctypes.CDLL(path))
                     break
-                except OSError:
+                except (OSError, AttributeError):
+                    # unloadable, or a stale build missing newer symbols:
+                    # fall through to the next candidate / pure-Python path
+                    _LIB = None
                     continue
         return _LIB
 

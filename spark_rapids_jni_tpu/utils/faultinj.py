@@ -150,7 +150,7 @@ _env_cfg = os.environ.get("SRJT_FAULTINJ_CONFIG")
 if _env_cfg:
     try:
         configure_from_file(_env_cfg)
-    except (OSError, ValueError) as e:  # ValueError covers JSONDecodeError
+    except Exception as e:  # any malformed config: degrade, never crash
         import warnings
 
         warnings.warn(f"faultinj: ignoring SRJT_FAULTINJ_CONFIG ({e})", stacklevel=1)

@@ -1,0 +1,87 @@
+"""Distributed hash join tests on the 8-device CPU mesh; pandas merge
+is the oracle."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.parallel import mesh as mesh_mod
+from spark_rapids_jni_tpu.parallel.join_distributed import (
+    distributed_inner_join,
+    shard_join_pairs,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8
+    return mesh_mod.make_mesh({"data": 8})
+
+
+def test_shard_join_pairs_basic():
+    lk = jnp.asarray([1, 2, 3, 2], jnp.int64)
+    lp = jnp.asarray([1, 1, 1, 1], bool)
+    rk = jnp.asarray([2, 9, 2, 1], jnp.int64)
+    rp = jnp.asarray([1, 1, 1, 1], bool)
+    li, ri, pv, ovf = shard_join_pairs(lk, lp, rk, rp, out_capacity=16)
+    li, ri, pv = np.asarray(li), np.asarray(ri), np.asarray(pv)
+    got = sorted((int(lk[a]), int(rk[b])) for a, b in zip(li[pv], ri[pv]))
+    # 1 matches once; each left 2 matches right rows {0, 2}; 3 matches none
+    assert got == [(1, 1), (2, 2), (2, 2), (2, 2), (2, 2)]
+    assert not bool(ovf)
+
+
+def test_shard_join_pairs_absent_and_empty_runs():
+    lk = jnp.asarray([5, 5, 7], jnp.int64)
+    lp = jnp.asarray([1, 0, 1], bool)  # middle row is exchange padding
+    rk = jnp.asarray([5, 7, 7], jnp.int64)
+    rp = jnp.asarray([1, 1, 0], bool)  # last right row padding
+    li, ri, pv, ovf = shard_join_pairs(lk, lp, rk, rp, out_capacity=8)
+    li, ri, pv = np.asarray(li), np.asarray(ri), np.asarray(pv)
+    got = sorted((int(lk[a]), int(rk[b])) for a, b in zip(li[pv], ri[pv]))
+    assert got == [(5, 5), (7, 7)]
+    assert not bool(ovf)
+
+
+def test_shard_join_pairs_overflow_flag():
+    lk = jnp.zeros((4,), jnp.int64)
+    rk = jnp.zeros((4,), jnp.int64)
+    ones = jnp.ones((4,), bool)
+    _, _, pv, ovf = shard_join_pairs(lk, ones, rk, ones, out_capacity=8)
+    assert bool(ovf)  # 16 pairs > 8
+    assert int(np.asarray(pv).sum()) == 8  # capped, flagged
+
+
+def test_distributed_join_matches_pandas(mesh8, rng):
+    n = 8 * 128
+    lk = rng.integers(0, 50, n).astype(np.int64)
+    lv = rng.integers(0, 1000, n).astype(np.int64)
+    rk = rng.integers(0, 50, n).astype(np.int64)
+    rv = rng.integers(0, 1000, n).astype(np.int64)
+    sh = mesh_mod.row_sharding(mesh8)
+    put = lambda a: jax.device_put(jnp.asarray(a), sh)
+
+    k, lvo, rvo, ovf = distributed_inner_join(
+        put(lk), put(lv), put(rk), put(rv), mesh8, capacity=n, out_capacity=64 * n // 8
+    )
+    assert not ovf
+
+    want = pd.DataFrame({"k": lk, "lv": lv}).merge(pd.DataFrame({"k": rk, "rv": rv}), on="k")
+    got = sorted(zip(k.tolist(), lvo.tolist(), rvo.tolist()))
+    expect = sorted(zip(want.k.tolist(), want.lv.tolist(), want.rv.tolist()))
+    assert got == expect
+
+
+def test_distributed_join_disjoint_keys(mesh8, rng):
+    n = 8 * 32
+    lk = np.arange(n, dtype=np.int64)
+    rk = np.arange(n, 2 * n, dtype=np.int64)  # no overlap
+    v = np.ones(n, np.int64)
+    sh = mesh_mod.row_sharding(mesh8)
+    put = lambda a: jax.device_put(jnp.asarray(a), sh)
+    k, lvo, rvo, ovf = distributed_inner_join(put(lk), put(v), put(rk), put(v), mesh8)
+    assert len(k) == 0 and not ovf
