@@ -12,6 +12,7 @@ package com.nvidia.spark.rapids.jni;
 
 import java.util.ArrayList;
 import java.util.List;
+import java.util.Locale;
 
 public class ParquetFooter implements AutoCloseable {
 
@@ -159,7 +160,7 @@ public class ParquetFooter implements AutoCloseable {
       // requested names fold API-side (reference ParquetFooter.java:207);
       // the native walk folds only the file-side schema names
       for (int i = 0; i < n; i++) {
-        names.set(i, names.get(i).toLowerCase());
+        names.set(i, names.get(i).toLowerCase(Locale.ROOT));
       }
     }
     String[] nameArr = names.toArray(new String[0]);

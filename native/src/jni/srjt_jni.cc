@@ -104,18 +104,21 @@ Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeThriftFileNative(
     throw_last_error(env);
     return nullptr;
   }
-  std::vector<uint8_t> tmp(static_cast<size_t>(size));
-  if (srjt_blob_copy(blob, tmp.data(), size) != 0) {
-    srjt_blob_free(blob);
-    throw_last_error(env);
-    return nullptr;
-  }
-  srjt_blob_free(blob);
   jbyteArray out = env->NewByteArray(static_cast<jsize>(size));
   if (out != nullptr) {
-    env->SetByteArrayRegion(out, 0, static_cast<jsize>(size),
-                            reinterpret_cast<const jbyte*>(tmp.data()));
+    // one copy: blob -> pinned Java array storage
+    void* dst = env->GetPrimitiveArrayCritical(out, nullptr);
+    if (dst != nullptr) {
+      int32_t rc = srjt_blob_copy(blob, static_cast<uint8_t*>(dst), size);
+      env->ReleasePrimitiveArrayCritical(out, dst, 0);
+      if (rc != 0) {
+        srjt_blob_free(blob);
+        throw_last_error(env);
+        return nullptr;
+      }
+    }
   }
+  srjt_blob_free(blob);
   return out;
 }
 

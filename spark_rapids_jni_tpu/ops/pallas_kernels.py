@@ -1,0 +1,148 @@
+"""Pallas TPU kernels for hot ops.
+
+First resident: the shuffle partitioner — murmur3(key) pmod P fused in
+one VMEM pass. XLA already fuses the jnp formulation well; the Pallas
+version exists to (a) pin the fused single-pass HBM->VMEM->HBM shape so
+no pipeline rematerializes the hash, and (b) carry the kernel
+infrastructure (tiling, padding, interpret-mode testing) that later
+byte-movement kernels build on.
+
+Bit-exact with ops/hashing.murmur3_raw / hash_partition_map for int32
+and int64 keys (tests cross-check in interpret mode on CPU).
+
+Layout: [N] keys are split host-side into u32 lane planes and padded to
+(8, 128)-aligned 2-D tiles (the VPU shape); the kernel is gridded over
+row blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu import fails on builds without the TPU plugin; interpret mode still works
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["pallas_partition_map", "pallas_available"]
+
+_LANES = 128
+_BLOCK_ROWS = 512  # 512x128 u32 block = 256KB/input plane in VMEM
+
+
+def pallas_available() -> bool:
+    return _VMEM is not None
+
+
+def _mix_k(k):
+    k = k * jnp.uint32(0xCC9E2D51)
+    k = (k << jnp.uint32(15)) | (k >> jnp.uint32(17))
+    return k * jnp.uint32(0x1B873593)
+
+
+def _mix_h(h, k):
+    h = h ^ _mix_k(k)
+    h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h):
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> jnp.uint32(16))
+
+
+def _partition_kernel_2word(lo_ref, hi_ref, out_ref, *, num_partitions: int):
+    h = jnp.full(lo_ref.shape, 42, jnp.uint32)
+    h = _mix_h(h, lo_ref[:])
+    h = _mix_h(h, hi_ref[:])
+    h = _fmix(h ^ jnp.uint32(8))
+    signed = h.astype(jnp.int32)
+    m = signed % jnp.int32(num_partitions)
+    out_ref[:] = jnp.where(m < 0, m + num_partitions, m)
+
+
+def _partition_kernel_1word(w_ref, out_ref, *, num_partitions: int):
+    h = jnp.full(w_ref.shape, 42, jnp.uint32)
+    h = _mix_h(h, w_ref[:])
+    h = _fmix(h ^ jnp.uint32(4))
+    signed = h.astype(jnp.int32)
+    m = signed % jnp.int32(num_partitions)
+    out_ref[:] = jnp.where(m < 0, m + num_partitions, m)
+
+
+def _pad_to_tiles(plane: jnp.ndarray) -> jnp.ndarray:
+    """[N] u32 -> [rows, 128] u32 with rows a multiple of _BLOCK_ROWS."""
+    n = plane.shape[0]
+    rows = max((n + _LANES - 1) // _LANES, 1)
+    rows = (rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS * _BLOCK_ROWS
+    padded = jnp.zeros((rows * _LANES,), jnp.uint32).at[:n].set(plane)
+    return padded.reshape(rows, _LANES)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _run(planes, num_partitions: int, interpret: bool):
+    two = len(planes) == 2
+    rows = planes[0].shape[0]
+    grid = (rows // _BLOCK_ROWS,)
+    # index map returns must be uniformly i32: with jax_enable_x64 the
+    # bare literal 0 traces as i64 and Mosaic fails to legalize the
+    # mixed-width return
+    spec = pl.BlockSpec(
+        (_BLOCK_ROWS, _LANES),
+        lambda i: (i, jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    kernel = (
+        functools.partial(_partition_kernel_2word, num_partitions=num_partitions)
+        if two
+        else functools.partial(_partition_kernel_1word, num_partitions=num_partitions)
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        grid=grid,
+        in_specs=[spec] * len(planes),
+        out_specs=spec,
+        interpret=interpret,
+    )(*planes)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _partition_map_impl(keys, num_partitions: int, interpret: bool):
+    from jax import lax
+
+    n = keys.shape[0]
+    if keys.dtype.itemsize == 8:
+        u = lax.bitcast_convert_type(keys, jnp.uint32)  # [N, 2]
+        planes = (_pad_to_tiles(u[:, 0]), _pad_to_tiles(u[:, 1]))
+    else:
+        signed = keys.astype(jnp.int32)
+        planes = (_pad_to_tiles(lax.bitcast_convert_type(signed, jnp.uint32)),)
+    out = _run(planes, num_partitions, interpret)
+    return out.reshape(-1)[:n]
+
+
+def pallas_partition_map(
+    keys: jnp.ndarray, num_partitions: int, interpret: bool = False
+) -> jnp.ndarray:
+    """[N] int32/int64 keys -> [N] int32 partition ids, bit-exact with
+    hash_partition_map on a single int column.
+
+    interpret=True runs the kernel in the Pallas interpreter (hermetic
+    CPU testing); on TPU leave it False for the compiled kernel. The
+    whole path (lane split, tile pad, kernel, unpad) is one compiled
+    program — eager prep dispatches would dominate on remote backends.
+    """
+    if keys.dtype.itemsize not in (4, 8):
+        raise ValueError(f"pallas_partition_map supports 4/8-byte keys, got {keys.dtype}")
+    return _partition_map_impl(keys, int(num_partitions), bool(interpret))

@@ -62,13 +62,15 @@ def shard_join_pairs(
     hi = jnp.searchsorted(rks_probe, lk, side="right").astype(jnp.int32)
     lo = jnp.minimum(lo, n_right_valid)
     hi = jnp.minimum(hi, n_right_valid)
-    cnt = jnp.where(lp, hi - lo, 0)
+    cnt = jnp.where(lp, hi - lo, 0).astype(jnp.int64)  # int64: a skewed
+    # shard can exceed 2^31 candidate pairs; int32 would wrap the scan
+    # and silently defeat the overflow flag
 
     starts = jnp.cumsum(cnt) - cnt  # exclusive scan
     total = starts[-1] + cnt[-1] if cnt.shape[0] else jnp.zeros((), cnt.dtype)
     overflow = total > out_capacity
 
-    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
     # left row owning output slot j = first row whose cumulative END
     # exceeds j; empty runs (cnt 0) have end == start <= j and are
     # skipped by the 'right' search, so they never claim a slot

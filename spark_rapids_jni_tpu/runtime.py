@@ -40,6 +40,10 @@ def snappy_uncompress(data: bytes, expected_size: Optional[int] = None) -> bytes
         _raise_last(lib)
     if expected_size is not None and n != expected_size:
         raise RuntimeError(f"snappy: preamble size {n} != expected {expected_size}")
+    if expected_size is None and n > max(len(data), 1) * 128:
+        # the format can't expand anywhere near this much: an attacker-
+        # controlled preamble must not drive a giant allocation
+        raise RuntimeError(f"snappy: implausible uncompressed size {n}")
     out = ctypes.create_string_buffer(int(n))
     if lib.srjt_snappy_uncompress(data, len(data), out, n) != 0:
         _raise_last(lib)

@@ -1,0 +1,33 @@
+"""Pallas kernel parity tests (interpret mode — hermetic on CPU)."""
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.hashing import hash_partition_map
+from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_partition_map
+
+
+@pytest.mark.parametrize("np_dt,col_dt", [(np.int64, dt.INT64), (np.int32, dt.INT32)])
+@pytest.mark.parametrize("n", [1, 127, 1024, 5000])
+def test_partition_map_parity(rng, np_dt, col_dt, n):
+    # draw the full dtype range so the int64 high-word lane is exercised
+    info = np.iinfo(np_dt)
+    keys = rng.integers(info.min, info.max, n, dtype=np_dt)
+    want = np.asarray(hash_partition_map([Column(col_dt, data=jnp.asarray(keys))], 16))
+    got = np.asarray(pallas_partition_map(jnp.asarray(keys), 16, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_partition_map_range(rng):
+    keys = rng.integers(0, 10**9, 2048).astype(np.int64)
+    p = np.asarray(pallas_partition_map(jnp.asarray(keys), 7, interpret=True))
+    assert p.min() >= 0 and p.max() < 7
+
+
+def test_rejects_narrow_keys():
+    with pytest.raises(ValueError, match="4/8-byte"):
+        pallas_partition_map(jnp.zeros((4,), jnp.int16), 4, interpret=True)
