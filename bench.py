@@ -1,56 +1,78 @@
 """Benchmark: the BASELINE.json stepping-stone config[0] — single-table
-GROUP BY SUM over 1M rows — on the live device (TPU chip under the
-driver; CPU if forced), compared against the config's stated reference
-("CPU ColumnarBatch ref"): a numpy columnar groupby on this host.
+GROUP BY SUM over 1M rows — on the live device, compared against the
+config's stated reference ("CPU ColumnarBatch ref"): a numpy columnar
+groupby on this host.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Protocol mirrors the reference's nvbench discipline (SURVEY.md §6):
-deterministic seeded input, warmup compile excluded, steady-state
-median over repeated timed runs, rows/s reported.
+Measurement protocol: the remote (axon) backend carries a large fixed
+RPC latency per host sync, so the kernel is timed as a CHAINED
+on-device loop (each iteration's keys depend on the previous sums, so
+XLA cannot parallelize or elide them) at two loop lengths; the
+difference isolates per-iteration device time with the round-trip
+latency cancelled. Deterministic seeded input, compile excluded, median
+of repeated measurements (nvbench discipline, SURVEY.md §6).
 """
 
 from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 import numpy as np
 
+import spark_rapids_jni_tpu  # noqa: F401  (enables x64 BEFORE arrays exist)
+from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 N_ROWS = 1 << 20  # 1M-row stepping stone
 N_KEYS = 4096  # distinct groups
-REPS = 20
+REPS = 7
+K_SHORT, K_LONG = 1, 17
 
 
-def _device_groupby(keys, vals, present, capacity):
-    from spark_rapids_jni_tpu.parallel.distributed import shard_groupby_sum
+@partial(jax.jit, static_argnums=(3, 4))
+def _chained_groupby(keys, vals, present, num_keys: int, iters: int):
+    del present  # bounded-domain path: occupancy handled by the domain
 
-    return shard_groupby_sum(keys, vals, present, capacity)
+    def body(_, carry):
+        k, acc = carry
+        sums, counts = groupby_sum_bounded(k, vals, num_keys)
+        # data dependency: next iteration's keys depend on these sums,
+        # so the chain cannot be overlapped or dead-code-eliminated
+        perturb = (sums[0] == 0.0).astype(k.dtype)
+        return k ^ perturb, acc + sums[0]
+
+    _, acc = lax.fori_loop(0, iters, body, (keys, jnp.float32(0)))
+    return acc
+
+
+def _timed(fn) -> float:
+    out = fn()  # warmup/compile
+    float(np.asarray(out))
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        float(np.asarray(fn()))  # host sync: full completion
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
 
 
 def bench_device() -> float:
     rng = np.random.default_rng(42)
-    keys_h = rng.integers(0, N_KEYS, N_ROWS).astype(np.int64)
-    vals_h = rng.standard_normal(N_ROWS).astype(np.float32)
-
-    keys = jnp.asarray(keys_h)
-    vals = jnp.asarray(vals_h)
+    keys = jnp.asarray(rng.integers(0, N_KEYS, N_ROWS), jnp.int64)
+    vals = jnp.asarray(rng.standard_normal(N_ROWS), jnp.float32)
     present = jnp.ones((N_ROWS,), bool)
+    cap = N_KEYS
 
-    fn = jax.jit(_device_groupby, static_argnums=(3,))
-    out = fn(keys, vals, present, N_KEYS * 2)  # warmup/compile
-    jax.block_until_ready(out)
-
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        out = fn(keys, vals, present, N_KEYS * 2)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    t_short = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_SHORT))
+    t_long = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_LONG))
+    per_iter = max((t_long - t_short) / (K_LONG - K_SHORT), 1e-9)
+    return per_iter
 
 
 def bench_cpu_ref() -> float:

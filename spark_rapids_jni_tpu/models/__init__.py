@@ -5,4 +5,4 @@ reference's surrounding stack runs; here they are first-class so the
 framework can be benchmarked standalone, without a Spark driver.
 """
 
-from . import datagen, tpch, tpcds, xgboost_bridge  # noqa: F401
+from . import compiled, datagen, tpch, tpcds, xgboost_bridge  # noqa: F401

@@ -1,0 +1,105 @@
+"""Fused (single-XLA-program) TPC pipelines.
+
+The operator-tier q1/q6 (models/tpch.py) compose public ops, each an
+independent dispatch — correct, but on a remote/TPU backend the per-op
+round-trips dominate. These variants trace the WHOLE query into one
+jitted program over the table's raw arrays: scan -> filter -> aggregate
+with no host sync except the final small result. This is the execution
+shape the plugin would use per ColumnarBatch (one compiled plan per
+schema), and the one the benchmarks measure.
+
+Numerical parity with the op-tier pipelines is pinned by tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Table
+from ..columnar import dtype as dt
+from ..ops import bitutils
+from .tpch import D_1998_12_01, _D_1994_01_01, _D_1995_01_01
+
+__all__ = ["q6_fused", "q1_fused"]
+
+
+def _f64(table: Table, name: str) -> jnp.ndarray:
+    return bitutils.float_view(table.column(name).data, dt.FLOAT64)
+
+
+@jax.jit
+def _q6_kernel(ship, disc, qty, price):
+    pred = (
+        (ship >= _D_1994_01_01)
+        & (ship < _D_1995_01_01)
+        & (disc >= 0.05)
+        & (disc <= 0.07)
+        & (qty < 24.0)
+    )
+    return jnp.sum(jnp.where(pred, price * disc, 0.0))
+
+
+def q6_fused(lineitem: Table) -> float:
+    """TPC-H q6 as one program: predicate + masked sum, no row
+    materialization at all (the filter never builds a filtered table)."""
+    revenue = _q6_kernel(
+        lineitem.column("l_shipdate").data,
+        _f64(lineitem, "l_discount"),
+        _f64(lineitem, "l_quantity"),
+        _f64(lineitem, "l_extendedprice"),
+    )
+    return float(np.asarray(revenue))
+
+
+@partial(jax.jit, static_argnums=(7,))
+def _q1_kernel(ship, rf, ls, qty, price, disc, tax, cutoff: int):
+    keep = ship <= cutoff
+    # 3 returnflags x 2 linestatus = 6 static groups: direct-indexed
+    # segment reductions, no sort needed (the group domain is tiny and
+    # known — the plugin's dictionary-coded flags make this exact)
+    gid = jnp.where(keep, rf.astype(jnp.int32) * 2 + ls.astype(jnp.int32), 6)
+    num = 7  # 6 real + 1 trash segment for filtered rows
+
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+    one = jnp.ones_like(qty)
+
+    def seg(v):
+        return jax.ops.segment_sum(v, gid, num_segments=num)[:6]
+
+    qty_s, price_s, dp_s, ch_s, disc_s, n = (
+        seg(qty), seg(price), seg(disc_price), seg(charge), seg(disc), seg(one),
+    )
+    cnt = jnp.maximum(n, 1.0)
+    return qty_s, price_s, dp_s, ch_s, qty_s / cnt, price_s / cnt, disc_s / cnt, n
+
+
+def q1_fused(lineitem: Table, delta_days: int = 90):
+    """TPC-H q1 as one program. Returns a dict of [6] arrays keyed like
+    the op-tier output (rows ordered by (returnflag, linestatus))."""
+    out = _q1_kernel(
+        lineitem.column("l_shipdate").data,
+        lineitem.column("l_returnflag").data,
+        lineitem.column("l_linestatus").data,
+        _f64(lineitem, "l_quantity"),
+        _f64(lineitem, "l_extendedprice"),
+        _f64(lineitem, "l_discount"),
+        _f64(lineitem, "l_tax"),
+        D_1998_12_01 - delta_days,
+    )
+    qty_s, price_s, dp_s, ch_s, qty_m, price_m, disc_m, n = (np.asarray(a) for a in out)
+    return {
+        "qty_sum": qty_s,
+        "price_sum": price_s,
+        "disc_price_sum": dp_s,
+        "charge_sum": ch_s,
+        "qty_mean": qty_m,
+        "price_mean": price_m,
+        "disc_mean": disc_m,
+        "count": n.astype(np.int64),
+    }

@@ -31,7 +31,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["pallas_partition_map", "pallas_available"]
+__all__ = ["pallas_partition_map", "pallas_groupby_sum_bounded", "pallas_available"]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # 512x128 u32 block = 256KB/input plane in VMEM
@@ -146,3 +146,111 @@ def pallas_partition_map(
     if keys.dtype.itemsize not in (4, 8):
         raise ValueError(f"pallas_partition_map supports 4/8-byte keys, got {keys.dtype}")
     return _partition_map_impl(keys, int(num_partitions), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# bounded-domain GROUP BY SUM on the MXU
+# ---------------------------------------------------------------------------
+#
+# TPUs have no fast scatter: jax.ops.segment_sum over 1M rows costs ~7ms
+# (XLA serializes the scatter-add), and an XLA one-hot matmul pays K*N*4
+# bytes of HBM traffic just materializing the one-hot. This kernel builds
+# each one-hot tile IN VMEM and contracts it on the MXU immediately —
+# the one-hot never touches HBM.
+#
+# Measured (v5e, 1M rows x 4096 keys): ~matches the scatter path
+# (~150 Mrows/s) rather than beating it — the [1, 256] x [256, K]
+# contraction is a matvec (M=1), which uses 1/128 of the MXU, and
+# Precision.HIGHEST (needed for f32-exact sums) triples the passes.
+# Next step when this op matters: batch 128 row-chunks into one
+# [128, 256] x [256, K] block-diagonal contraction per grid step, or
+# specialize K <= 128 where a full-width matmul applies.
+
+_GB_CHUNK = 256  # columns of each (8, 256) row block; one-hot tile [256, K]
+_GB_SUBLANES = 8  # TPU block sublane quantum
+
+
+def _groupby_kernel(k_ref, v_ref, out_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kpad = acc_ref.shape[1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_GB_CHUNK, kpad), 1)
+    # static unroll over the 8 sublanes: each [256, Kpad] one-hot tile
+    # lives only in VMEM/registers; rows with out-of-domain keys (incl.
+    # the padding sentinel) match no column and vanish
+    for s in range(_GB_SUBLANES):
+        oh = (k_ref[s, :].reshape(-1, 1) == cols).astype(jnp.float32)
+        # HIGHEST: the MXU's default single-pass bf16 loses ~3 decimal
+        # digits; the 3-pass f32 emulation keeps sums exact to f32 ulp
+        dot = jax.lax.dot_general(
+            v_ref[s, :].reshape(1, -1),
+            oh,
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[s : s + 1, :] += dot
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _groupby_impl(keys, vals, num_keys: int, interpret: bool):
+    n = keys.shape[0]
+    kpad = max((num_keys + _LANES - 1) // _LANES * _LANES, _LANES)
+    step_rows = _GB_SUBLANES * _GB_CHUNK
+    m = (n + step_rows - 1) // step_rows
+    total = m * step_rows
+    # domain check BEFORE any narrowing cast: int64 keys >= 2^32 must
+    # drop, not wrap into the valid domain
+    in_domain = (keys >= 0) & (keys < num_keys)
+    keys32 = jnp.where(in_domain, keys, -1).astype(jnp.int32)
+    # pad with an out-of-domain sentinel so padding rows sum nowhere
+    kp = jnp.full((total,), -1, jnp.int32).at[:n].set(keys32)
+    vp = jnp.zeros((total,), jnp.float32).at[:n].set(vals.astype(jnp.float32))
+    kp = kp.reshape(m * _GB_SUBLANES, _GB_CHUNK)
+    vp = vp.reshape(m * _GB_SUBLANES, _GB_CHUNK)
+
+    row_spec = pl.BlockSpec(
+        (_GB_SUBLANES, _GB_CHUNK),
+        lambda i: (i, jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    out_spec = pl.BlockSpec(
+        (_GB_SUBLANES, kpad),
+        lambda i: (jnp.int32(0), jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    if pltpu is None:
+        raise RuntimeError("pallas TPU plugin unavailable")
+    out = pl.pallas_call(
+        _groupby_kernel,
+        out_shape=jax.ShapeDtypeStruct((_GB_SUBLANES, kpad), jnp.float32),
+        grid=(m,),
+        in_specs=[row_spec, row_spec],
+        out_specs=out_spec,
+        scratch_shapes=[pltpu.VMEM((_GB_SUBLANES, kpad), jnp.float32)],
+        interpret=interpret,
+    )(kp, vp)
+    # 8 sublane partial accumulators -> final sums
+    return jnp.sum(out, axis=0)[:num_keys]
+
+
+def pallas_groupby_sum_bounded(
+    keys: jnp.ndarray, vals: jnp.ndarray, num_keys: int, interpret: bool = False
+) -> jnp.ndarray:
+    """GROUP BY SUM over a bounded key domain [0, num_keys), one-hot
+    matmul on the MXU with VMEM-resident tiles. float32 sums.
+
+    Matches ops.aggregate.groupby_sum_bounded's sums (float path) for
+    in-domain keys; out-of-domain keys are dropped.
+    """
+    if num_keys > 4096:
+        raise ValueError("pallas_groupby_sum_bounded supports num_keys <= 4096 (VMEM tile)")
+    return _groupby_impl(keys, vals, int(num_keys), bool(interpret))

@@ -9,4 +9,11 @@ over ICI (with a DCN outer axis for multi-pod), ``shard_map`` +
 bucket framing so the whole shuffle compiles into one XLA program.
 """
 
-from . import device, distributed, mesh, shuffle  # noqa: F401
+from . import (  # noqa: F401
+    device,
+    distributed,
+    join_distributed,
+    mesh,
+    shuffle,
+    sort_distributed,
+)

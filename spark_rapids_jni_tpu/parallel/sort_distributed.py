@@ -1,0 +1,87 @@
+"""Distributed sort over the mesh: range-partitioned sample sort.
+
+The global ORDER BY tier (Spark's rangepartitioning exchange + local
+sort, which the RAPIDS plugin runs as a sample-sort over its shuffle).
+One compiled program under ``shard_map``:
+
+1. sort the local shard,
+2. sample `oversample` evenly-spaced keys per shard, all_gather them
+   over ICI (tiny collective), sort, take P-1 splitters,
+3. route each row by splitter range (searchsorted — rows of shard i are
+   all <= rows of shard i+1), static-capacity bucket all_to_all,
+4. sort the received rows (absent-last), leaving each shard a sorted
+   run; shard order == global order.
+
+Keys use the total-order transform (ops/bitutils) so FLOAT64 sorts
+exactly on TPU. Capacity overflow is detected like the shuffle's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.dispatch import op_boundary
+from .shuffle import _bucketize
+
+__all__ = ["distributed_sort"]
+
+
+@op_boundary("distributed_sort")
+def distributed_sort(
+    keys: jnp.ndarray,  # [N_global] integer keys, row-sharded
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+    oversample: int = 32,
+    descending: bool = False,
+):
+    """Globally sort row-sharded keys. Returns (sorted_host, overflow):
+    the fully sorted host array (for the caller's gather/limit step) and
+    the capacity-overflow flag. Device-side, each shard ends holding a
+    sorted run with shard order == global order (the useful invariant
+    for downstream merge/limit operators)."""
+    n_parts = mesh.shape[axis]
+    n_global = keys.shape[0]
+    per_shard = n_global // n_parts
+    if capacity is None:
+        # skew headroom: a perfectly uniform split needs per_shard
+        capacity = min(2 * per_shard, n_global)
+    samples_per = min(oversample, per_shard)
+
+    def body(k):
+        ks = jnp.sort(k)
+        # evenly spaced local sample (positions cover the whole run)
+        pos = (jnp.arange(samples_per) * k.shape[0]) // samples_per
+        local_samples = ks[pos]
+        all_samples = lax.all_gather(local_samples, axis).reshape(-1)
+        all_sorted = jnp.sort(all_samples)
+        # P-1 splitters at even ranks
+        spl_pos = (jnp.arange(1, n_parts) * all_sorted.shape[0]) // n_parts
+        splitters = all_sorted[spl_pos]
+        dest = jnp.searchsorted(splitters, k, side="right").astype(jnp.int32)
+
+        kb, mask, ovf = _bucketize(k, dest, n_parts, capacity)
+        kr = lax.all_to_all(kb, axis, split_axis=0, concat_axis=0, tiled=True)
+        mr = lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True)
+        kf, mf = kr.reshape(-1), mr.reshape(-1)
+        # sort received with absent rows last (occupancy-primary sort)
+        order = jnp.lexsort((kf, ~mf))
+        return kf[order][None], mf[order][None], ovf[None]
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis),), out_specs=(P(axis), P(axis), P(axis))
+    )
+    vals, mask, ovf = f(keys)
+
+    v = np.asarray(vals).reshape(n_parts, -1)
+    m = np.asarray(mask).reshape(n_parts, -1)
+    out = np.concatenate([v[i][m[i]] for i in range(n_parts)])
+    if descending:
+        out = out[::-1]
+    return out, bool(np.asarray(ovf).any())

@@ -179,3 +179,26 @@ class TestTpcds:
         assert got["order_count"] == sel.o.nunique()
         assert got["total_shipping_cost"] == pytest.approx(float(sel.cost.sum()), rel=1e-9)
         assert got["total_net_profit"] == pytest.approx(float(sel.profit.sum()), rel=1e-9)
+
+
+class TestFusedPipelines:
+    def test_q6_fused_matches_op_tier(self):
+        li = tpch.gen_lineitem(30_000, seed=21)
+        from spark_rapids_jni_tpu.models.compiled import q6_fused
+
+        assert q6_fused(li) == pytest.approx(tpch.q6(li), rel=1e-9)
+
+    def test_q1_fused_matches_op_tier(self):
+        li = tpch.gen_lineitem(30_000, seed=22)
+        from spark_rapids_jni_tpu.models.compiled import q1_fused
+
+        fused = q1_fused(li)
+        op = tpch.q1(li)
+        # op-tier rows are key-sorted (rf, ls) == fused group id order
+        assert op.num_rows == 6
+        np.testing.assert_allclose(_f64(op.column("qty_sum")), fused["qty_sum"], rtol=1e-9)
+        np.testing.assert_allclose(_f64(op.column("charge_sum")), fused["charge_sum"], rtol=1e-9)
+        np.testing.assert_allclose(_f64(op.column("disc_mean")), fused["disc_mean"], rtol=1e-9)
+        np.testing.assert_array_equal(
+            np.asarray(op.column("qty_count_all").data), fused["count"]
+        )

@@ -286,3 +286,27 @@ def test_expression_divide_by_zero_null():
     vals = r.to_pylist()
     assert vals[0] == 2.0
     assert vals[1] is None
+
+
+def test_groupby_sum_bounded_matches_general(rng):
+    from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+
+    keys = rng.integers(0, 50, 500).astype(np.int64)
+    vals = rng.integers(-100, 100, 500).astype(np.int64)
+    sums, counts = groupby_sum_bounded(jnp.asarray(keys), jnp.asarray(vals), 50)
+    df = pd.DataFrame({"k": keys, "v": vals}).groupby("k")["v"].agg(["sum", "count"])
+    for k in range(50):
+        want_sum = int(df["sum"].get(k, 0))
+        want_cnt = int(df["count"].get(k, 0))
+        assert int(np.asarray(sums)[k]) == want_sum
+        assert int(np.asarray(counts)[k]) == want_cnt
+
+
+def test_groupby_sum_bounded_out_of_domain_dropped():
+    from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+
+    keys = jnp.asarray([0, 1, 99, -5], jnp.int64)
+    vals = jnp.asarray([10, 20, 30, 40], jnp.int64)
+    sums, counts = groupby_sum_bounded(keys, vals, 2)
+    assert np.asarray(sums).tolist() == [10, 20]
+    assert np.asarray(counts).tolist() == [1, 1]

@@ -31,3 +31,32 @@ def test_partition_map_range(rng):
 def test_rejects_narrow_keys():
     with pytest.raises(ValueError, match="4/8-byte"):
         pallas_partition_map(jnp.zeros((4,), jnp.int16), 4, interpret=True)
+
+
+def test_groupby_sum_bounded_parity(rng):
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_bounded
+
+    keys = rng.integers(0, 50, 5000).astype(np.int64)
+    vals = rng.standard_normal(5000).astype(np.float32)
+    got = np.asarray(
+        pallas_groupby_sum_bounded(jnp.asarray(keys), jnp.asarray(vals), 50, interpret=True)
+    )
+    want = np.bincount(keys, weights=vals, minlength=50).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_groupby_sum_bounded_rejects_large_domain():
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_bounded
+
+    with pytest.raises(ValueError, match="num_keys"):
+        pallas_groupby_sum_bounded(jnp.zeros((8,), jnp.int32), jnp.zeros((8,)), 100000)
+
+
+def test_groupby_sum_bounded_int64_overflow_keys_dropped():
+    # keys >= 2^32 must drop, not wrap into the domain via the i32 cast
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_bounded
+
+    keys = jnp.asarray([0, 1, 2**32, 2**32 + 1], jnp.int64)
+    vals = jnp.asarray([1.0, 2.0, 100.0, 200.0], jnp.float32)
+    got = np.asarray(pallas_groupby_sum_bounded(keys, vals, 4, interpret=True))
+    np.testing.assert_allclose(got, [1.0, 2.0, 0.0, 0.0])
