@@ -157,26 +157,21 @@ def bench_groupby(rows: int, reps: int) -> None:
 def bench_tpch(rows: int, reps: int) -> None:
     """Fused q1/q6 over a generated lineitem (BASELINE configs[1])."""
     from spark_rapids_jni_tpu.models import tpch
-    from spark_rapids_jni_tpu.models.compiled import _q1_kernel, _q6_kernel, _f64
+    from spark_rapids_jni_tpu.models.compiled import (
+        _q1_kernel,
+        _q6_kernel,
+        q1_kernel_args,
+        q6_kernel_args,
+    )
 
     li = tpch.gen_lineitem(rows, seed=42)
     nbytes = _table_bytes(li)
-    ship = li.column("l_shipdate").data
-    args6 = (ship, _f64(li, "l_discount"), _f64(li, "l_quantity"), _f64(li, "l_extendedprice"))
+    args6 = q6_kernel_args(li)
     q6_bytes = sum(a.size * a.dtype.itemsize for a in args6)  # q6 reads 4 cols
     secs = _time(lambda: _q6_kernel(*args6), reps)
     _report("tpch_q6_fused", rows, 4, secs, q6_bytes)
 
-    args1 = (
-        ship,
-        li.column("l_returnflag").data,
-        li.column("l_linestatus").data,
-        _f64(li, "l_quantity"),
-        _f64(li, "l_extendedprice"),
-        _f64(li, "l_discount"),
-        _f64(li, "l_tax"),
-        tpch.D_1998_12_01 - 90,
-    )
+    args1 = q1_kernel_args(li)
     secs = _time(lambda: _q1_kernel(*args1), reps)
     _report("tpch_q1_fused", rows, li.num_columns, secs, nbytes)
 

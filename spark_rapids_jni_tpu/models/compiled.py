@@ -25,7 +25,7 @@ from ..columnar import dtype as dt
 from ..ops import bitutils
 from .tpch import D_1998_12_01, _D_1994_01_01, _D_1995_01_01
 
-__all__ = ["q6_fused", "q1_fused"]
+__all__ = ["q6_fused", "q1_fused", "q6_kernel_args", "q1_kernel_args", "_q6_kernel", "_q1_kernel"]
 
 
 def _f64(table: Table, name: str) -> jnp.ndarray:
@@ -44,16 +44,21 @@ def _q6_kernel(ship, disc, qty, price):
     return jnp.sum(jnp.where(pred, price * disc, 0.0))
 
 
-def q6_fused(lineitem: Table) -> float:
-    """TPC-H q6 as one program: predicate + masked sum, no row
-    materialization at all (the filter never builds a filtered table)."""
-    revenue = _q6_kernel(
+def q6_kernel_args(lineitem: Table) -> Tuple[jnp.ndarray, ...]:
+    """The (ship, disc, qty, price) arrays _q6_kernel consumes — the ONE
+    place the positional contract lives (benchmarks reuse it)."""
+    return (
         lineitem.column("l_shipdate").data,
         _f64(lineitem, "l_discount"),
         _f64(lineitem, "l_quantity"),
         _f64(lineitem, "l_extendedprice"),
     )
-    return float(np.asarray(revenue))
+
+
+def q6_fused(lineitem: Table) -> float:
+    """TPC-H q6 as one program: predicate + masked sum, no row
+    materialization at all (the filter never builds a filtered table)."""
+    return float(np.asarray(_q6_kernel(*q6_kernel_args(lineitem))))
 
 
 @partial(jax.jit, static_argnums=(7,))
@@ -79,10 +84,10 @@ def _q1_kernel(ship, rf, ls, qty, price, disc, tax, cutoff: int):
     return qty_s, price_s, dp_s, ch_s, qty_s / cnt, price_s / cnt, disc_s / cnt, n
 
 
-def q1_fused(lineitem: Table, delta_days: int = 90):
-    """TPC-H q1 as one program. Returns a dict of [6] arrays keyed like
-    the op-tier output (rows ordered by (returnflag, linestatus))."""
-    out = _q1_kernel(
+def q1_kernel_args(lineitem: Table, delta_days: int = 90):
+    """The positional argument tuple _q1_kernel consumes (last element
+    is the static cutoff)."""
+    return (
         lineitem.column("l_shipdate").data,
         lineitem.column("l_returnflag").data,
         lineitem.column("l_linestatus").data,
@@ -92,6 +97,12 @@ def q1_fused(lineitem: Table, delta_days: int = 90):
         _f64(lineitem, "l_tax"),
         D_1998_12_01 - delta_days,
     )
+
+
+def q1_fused(lineitem: Table, delta_days: int = 90):
+    """TPC-H q1 as one program. Returns a dict of [6] arrays keyed like
+    the op-tier output (rows ordered by (returnflag, linestatus))."""
+    out = _q1_kernel(*q1_kernel_args(lineitem, delta_days))
     qty_s, price_s, dp_s, ch_s, qty_m, price_m, disc_m, n = (np.asarray(a) for a in out)
     return {
         "qty_sum": qty_s,

@@ -205,6 +205,35 @@ def groupby_aggregate(
     out_names: List[str] = list(out_keys.names)
     for col_name, how in aggs:
         col = values.column(col_name)
-        out_cols.append(_agg_column(col, order, seg, num, how))
+        if how == "nunique":
+            out_cols.append(_nunique_column(keys, col, num))
+        else:
+            out_cols.append(_agg_column(col, order, seg, num, how))
         out_names.append(f"{col_name}_{how}")
     return Table(out_cols, out_names)
+
+
+def _nunique_column(keys: Table, col: Column, num: int) -> Column:
+    """COUNT(DISTINCT col) per group, nulls excluded (SQL semantics).
+
+    Re-sorts by (keys..., col) so equal values are adjacent within each
+    group; a value is a NEW distinct when it is valid and differs from
+    its predecessor (or the predecessor is another group / null — nulls
+    sort first within the group under nulls_first)."""
+    both = Table(list(keys.columns) + [col], list(keys.names) + ["__v"])
+    order2 = sorted_order(both)
+    seg2, num2 = _segment_ids(keys, order2)
+    if num2 != num:
+        raise AssertionError("group count mismatch between sort orders")
+    n = keys.num_rows
+    if n == 0:
+        return Column(dt.INT64, data=jnp.zeros((0,), jnp.int64))
+
+    valid = col.valid_mask()[order2]
+    same_val = _keys_equal_neighbor(col, order2)  # [n-1], value equal to prev
+    same_group = seg2[1:] == seg2[:-1]
+    prev_valid = valid[:-1]
+    is_new_tail = valid[1:] & ~(same_group & same_val & prev_valid)
+    is_new = jnp.concatenate([valid[:1], is_new_tail])
+    data = jax.ops.segment_sum(is_new.astype(jnp.int64), seg2, num)
+    return Column(dt.INT64, data=data)

@@ -12,8 +12,11 @@ One compiled program under ``shard_map``:
 4. sort the received rows (absent-last), leaving each shard a sorted
    run; shard order == global order.
 
-Keys use the total-order transform (ops/bitutils) so FLOAT64 sorts
-exactly on TPU. Capacity overflow is detected like the shuffle's.
+Operates on raw INTEGER key arrays (the shard_map calling convention).
+FLOAT64 callers must pre-transform bits with
+``ops.bitutils.total_order_key`` (monotone, invertible) — raw f64 bit
+patterns do NOT sort numerically. Capacity overflow is detected like
+the shuffle's.
 """
 
 from __future__ import annotations
@@ -50,8 +53,9 @@ def distributed_sort(
     n_global = keys.shape[0]
     per_shard = n_global // n_parts
     if capacity is None:
-        # skew headroom: a perfectly uniform split needs per_shard
-        capacity = min(2 * per_shard, n_global)
+        # tight: a source shard holds only per_shard rows, so no
+        # (src, dst) bucket can exceed that regardless of skew
+        capacity = per_shard
     samples_per = min(oversample, per_shard)
 
     def body(k):

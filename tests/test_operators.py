@@ -310,3 +310,24 @@ def test_groupby_sum_bounded_out_of_domain_dropped():
     sums, counts = groupby_sum_bounded(keys, vals, 2)
     assert np.asarray(sums).tolist() == [10, 20]
     assert np.asarray(counts).tolist() == [1, 1]
+
+
+def test_groupby_nunique(rng):
+    keys = [int(k) for k in rng.integers(0, 6, 300)]
+    vals = [int(v) for v in rng.integers(0, 10, 300)]
+    with_nulls = [v if i % 7 else None for i, v in enumerate(vals)]
+    t_keys = make_table(k=(keys, dt.INT32))
+    t_vals = make_table(v=(with_nulls, dt.INT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "nunique"), ("v", "count")])
+    df = pd.DataFrame({"k": keys, "v": with_nulls})
+    exp = df.groupby("k")["v"].agg(["nunique", "count"]).reset_index()
+    assert out.column("k").to_pylist() == exp["k"].tolist()
+    assert out.column("v_nunique").to_pylist() == exp["nunique"].tolist()
+    assert out.column("v_count").to_pylist() == exp["count"].tolist()
+
+
+def test_groupby_nunique_strings():
+    t_keys = make_table(k=([1, 1, 1, 2, 2], dt.INT32))
+    t_vals = make_table(s=(["a", "b", "a", "c", "c"], dt.STRING))
+    out = groupby_aggregate(t_keys, t_vals, [("s", "nunique")])
+    assert out.column("s_nunique").to_pylist() == [2, 1]

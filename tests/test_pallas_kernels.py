@@ -60,3 +60,14 @@ def test_groupby_sum_bounded_int64_overflow_keys_dropped():
     vals = jnp.asarray([1.0, 2.0, 100.0, 200.0], jnp.float32)
     got = np.asarray(pallas_groupby_sum_bounded(keys, vals, 4, interpret=True))
     np.testing.assert_allclose(got, [1.0, 2.0, 0.0, 0.0])
+
+
+def test_groupby_sum_bounded_empty_input():
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_bounded
+
+    got = np.asarray(
+        pallas_groupby_sum_bounded(
+            jnp.zeros((0,), jnp.int64), jnp.zeros((0,), jnp.float32), 4, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, np.zeros(4, np.float32))
