@@ -135,13 +135,14 @@ def murmur3_table(table_or_cols, seed: int = 42) -> jnp.ndarray:
     return h
 
 
-def murmur3_raw(data: jnp.ndarray, seed: int = 42) -> jnp.ndarray:
+def murmur3_raw(data: jnp.ndarray, seed=42) -> jnp.ndarray:
     """[N] uint32 murmur3 over a raw integer array — identical result to
     ``murmur3_table`` on a Column of the same width (4-byte values hash
     as one block, 8-byte as two), for use inside shard_map where values
-    travel as bare arrays."""
+    travel as bare arrays. ``seed`` may be an int or a [N] uint32 array
+    (the running hash, for Spark-style multi-column chaining)."""
     n = data.shape[0]
-    h = jnp.full((n,), seed, jnp.uint32)
+    h = jnp.broadcast_to(jnp.asarray(seed, jnp.uint32), (n,))
     if data.dtype.itemsize == 8:
         u = lax.bitcast_convert_type(data, jnp.uint32)  # [N, 2]
         words = [u[:, 0], u[:, 1]]

@@ -29,17 +29,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.hashing import murmur3_raw
 from .shuffle import _bucketize
 
-__all__ = ["shard_groupby_sum", "distributed_groupby_sum"]
+__all__ = ["shard_groupby_sum", "distributed_groupby_sum", "distributed_groupby_sum_multi"]
 
 
-def _hash_dest(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
-    """Murmur3(key) pmod n_parts — exact parity with the single-device
-    partitioner (hash_partition_map) for the same key width, jit-safe on
-    raw arrays inside shard_map."""
-    h = murmur3_raw(keys)
+def _hash_dest_multi(key_arrays, n_parts: int) -> jnp.ndarray:
+    """Chained murmur3 over raw key columns pmod n_parts (Spark
+    Murmur3Hash chaining: each column hashes with the running hash as
+    seed) — exact parity with hash_partition_map on the equivalent
+    Columns, jit-safe inside shard_map."""
+    h = None
+    for k in key_arrays:
+        h = murmur3_raw(k) if h is None else murmur3_raw(k, seed=h)
     signed = lax.bitcast_convert_type(h, jnp.int32)
     m = signed % jnp.int32(n_parts)
     return jnp.where(m < 0, m + n_parts, m)
+
+
+def _hash_dest(keys: jnp.ndarray, n_parts: int) -> jnp.ndarray:
+    """Single-key convenience over _hash_dest_multi."""
+    return _hash_dest_multi([keys], n_parts)
 
 
 def shard_groupby_sum(
@@ -50,29 +58,12 @@ def shard_groupby_sum(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Static-shape groupby-sum: returns (keys[capacity], sums[capacity],
     group_valid[capacity], overflow[]). Absent rows are excluded; group
-    count beyond capacity flags overflow."""
-    # Sort by (absent-last, key): padding cannot collide with any real key
-    # value (even iinfo max) because occupancy is the primary sort key.
-    order = jnp.lexsort((keys, ~present))
-    ks = keys[order]
-    vs = jnp.where(present, vals, 0)[order]
-    if jnp.issubdtype(vs.dtype, jnp.integer):
-        vs = vs.astype(jnp.int64)  # Spark integral-sum semantics, no wrap
-    ps = present[order]
-
-    n = keys.shape[0]
-    # present rows are contiguous at the front, so a segment starts at row 0
-    # or where the key changes; absent rows are masked out entirely
-    new_seg = jnp.concatenate([jnp.ones((1,), bool), ks[1:] != ks[:-1]]) & ps
-    seg = jnp.cumsum(new_seg).astype(jnp.int32) - 1  # -1 for leading absent rows
-    num_groups = jnp.maximum(seg[-1] + 1, 0)
-    overflow = num_groups > capacity
-    seg = jnp.where(ps, jnp.clip(seg, 0, capacity - 1), capacity)  # drop absent
-
-    sums = jax.ops.segment_sum(vs, seg, num_segments=capacity + 1)[:capacity]
-    out_keys = jnp.zeros((capacity,), keys.dtype).at[seg].set(ks, mode="drop")
-    group_valid = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-    return out_keys, sums, group_valid, overflow
+    count beyond capacity flags overflow. Single-key convenience over
+    _shard_groupby_sum_multi — ONE copy of the segmentation logic."""
+    out_keys, sums, group_valid, overflow = _shard_groupby_sum_multi(
+        [keys], vals, present, capacity
+    )
+    return out_keys[0], sums, group_valid, overflow
 
 
 def distributed_groupby_sum(
@@ -125,3 +116,80 @@ def distributed_groupby_sum(
     gv_h = np.asarray(gv).reshape(-1)
     keep = gv_h
     return gk_h[keep], gs_h[keep], bool(np.asarray(ovf).any())
+
+
+def _shard_groupby_sum_multi(key_arrays, vals, present, capacity: int):
+    """Multi-key sibling of shard_groupby_sum: lexsort over all key
+    columns (occupancy primary), segment where ANY key changes."""
+    order = jnp.lexsort(tuple(reversed(list(key_arrays))) + (~present,))
+    ks = [k[order] for k in key_arrays]
+    vs = jnp.where(present, vals, 0)[order]
+    if jnp.issubdtype(vs.dtype, jnp.integer):
+        vs = vs.astype(jnp.int64)
+    ps = present[order]
+
+    changed = jnp.zeros((ks[0].shape[0] - 1,), bool)
+    for k in ks:
+        changed = changed | (k[1:] != k[:-1])
+    new_seg = jnp.concatenate([jnp.ones((1,), bool), changed]) & ps
+    seg = jnp.cumsum(new_seg).astype(jnp.int32) - 1
+    num_groups = jnp.maximum(seg[-1] + 1, 0)
+    overflow = num_groups > capacity
+    seg = jnp.where(ps, jnp.clip(seg, 0, capacity - 1), capacity)
+
+    sums = jax.ops.segment_sum(vs, seg, num_segments=capacity + 1)[:capacity]
+    out_keys = [
+        jnp.zeros((capacity,), k.dtype).at[seg].set(kk, mode="drop")
+        for k, kk in zip(key_arrays, ks)
+    ]
+    group_valid = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+    return out_keys, sums, group_valid, overflow
+
+
+def distributed_groupby_sum_multi(
+    key_arrays,  # sequence of [N_global] int arrays, row-sharded alike
+    vals: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "data",
+    capacity: Optional[int] = None,
+    group_capacity: Optional[int] = None,
+):
+    """GROUP BY (k1, k2, ...) SUM(val) across the mesh — the composite-
+    key form of distributed_groupby_sum (Spark group-by keys are usually
+    composite; rows of one key TUPLE land on one shard via chained
+    murmur3). Returns (list of key arrays, sums, overflow)."""
+    key_arrays = list(key_arrays)
+    n_parts = mesh.shape[axis]
+    n_global = key_arrays[0].shape[0]
+    per_shard = n_global // n_parts
+    if capacity is None:
+        capacity = per_shard
+    if group_capacity is None:
+        group_capacity = capacity * n_parts
+    cap_g = int(group_capacity)
+    nk = len(key_arrays)
+
+    def body(v, *ks):
+        dest = _hash_dest_multi(ks, n_parts)
+        bucketed = [_bucketize(k, dest, n_parts, capacity) for k in ks]
+        vb, _, _ = _bucketize(v, dest, n_parts, capacity)
+        _, mask, ovf1 = bucketed[0]
+        a2a = lambda x: lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+        krs = [a2a(kb).reshape(-1) for kb, _, _ in bucketed]
+        vr = a2a(vb).reshape(-1)
+        mr = a2a(mask).reshape(-1)
+        gks, gs, gv, ovf2 = _shard_groupby_sum_multi(krs, vr, mr, cap_g)
+        out = tuple(gk[None] for gk in gks) + (gs[None], gv[None], (ovf1 | ovf2)[None])
+        return out
+
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis),) * (nk + 1),
+        out_specs=(P(axis),) * (nk + 3),
+    )
+    outs = f(vals, *key_arrays)
+    gks, gs, gv, ovf = outs[:nk], outs[nk], outs[nk + 1], outs[nk + 2]
+    keep = np.asarray(gv).reshape(-1)
+    out_keys = [np.asarray(g).reshape(-1)[keep] for g in gks]
+    return out_keys, np.asarray(gs).reshape(-1)[keep], bool(np.asarray(ovf).any())

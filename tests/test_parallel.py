@@ -201,3 +201,35 @@ def test_distributed_groupby_keys_disjoint_across_shards(mesh8, rng):
         jax.device_put(jnp.asarray(keys), sh), jax.device_put(jnp.asarray(vals), sh), mesh8
     )
     assert len(gk) == len(set(gk.tolist()))  # no duplicates after compaction
+
+
+def test_distributed_groupby_multi_key_matches_pandas(mesh8, rng):
+    from spark_rapids_jni_tpu.parallel.distributed import distributed_groupby_sum_multi
+
+    n = 8 * 256
+    k1 = rng.integers(0, 9, n).astype(np.int64)
+    k2 = rng.integers(0, 7, n).astype(np.int32)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    sh = mesh_mod.row_sharding(mesh8)
+    put = lambda a: jax.device_put(jnp.asarray(a), sh)
+    (g1, g2), sums, ovf = distributed_groupby_sum_multi([put(k1), put(k2)], put(vals), mesh8)
+    assert not ovf
+
+    exp = pd.DataFrame({"a": k1, "b": k2, "v": vals}).groupby(["a", "b"])["v"].sum()
+    got = {(int(a), int(b)): int(s) for a, b, s in zip(g1, g2, sums)}
+    assert got == {k: int(v) for k, v in exp.to_dict().items()}
+
+
+def test_hash_dest_multi_parity_with_partitioner(rng):
+    from spark_rapids_jni_tpu.ops.hashing import hash_partition_map
+    from spark_rapids_jni_tpu.parallel.distributed import _hash_dest_multi
+
+    k1 = rng.integers(-(10**9), 10**9, 200).astype(np.int64)
+    k2 = rng.integers(-1000, 1000, 200).astype(np.int32)
+    want = np.asarray(
+        hash_partition_map(
+            [Column(dt.INT64, data=jnp.asarray(k1)), Column(dt.INT32, data=jnp.asarray(k2))], 8
+        )
+    )
+    got = np.asarray(_hash_dest_multi([jnp.asarray(k1), jnp.asarray(k2)], 8))
+    np.testing.assert_array_equal(got, want)
