@@ -1,0 +1,85 @@
+/*
+ * Host memory buffer over the srjt host arena (L4 tier, SURVEY §2.1/§2.8).
+ *
+ * Mirrors the `ai.rapids.cudf.HostMemoryBuffer` surface the reference
+ * bundles from the cudf submodule (pom.xml:548; used by
+ * ParquetFooter.readAndFilter, reference ParquetFooter.java:200): an
+ * owned, explicitly closed host allocation addressed by raw pointer.
+ * Backed by native/src/host_buffer.cc through the same C ABI the ctypes
+ * path uses, so leak accounting (srjt_host_bytes_in_use) sees
+ * Java-created buffers too. Natives bind via native/src/jni/srjt_jni.cc
+ * (-DSRJT_BUILD_JNI=ON).
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.NativeDepsLoader;
+
+public class HostMemoryBuffer implements AutoCloseable {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long handle;
+  private final long address;
+  private final long length;
+
+  HostMemoryBuffer(long handle, long address, long length) {
+    this.handle = handle;
+    this.address = address;
+    this.length = length;
+  }
+
+  /** Allocate an owned host buffer of the given byte size. */
+  public static HostMemoryBuffer allocate(long bytes) {
+    long h = allocateNative(bytes);
+    return new HostMemoryBuffer(h, addressNative(h), bytes);
+  }
+
+  public long getAddress() {
+    return address;
+  }
+
+  public long getLength() {
+    return length;
+  }
+
+  /** Copy {@code len} bytes from {@code src[srcOffset..]} into this buffer at {@code dstOffset}. */
+  public void setBytes(long dstOffset, byte[] src, long srcOffset, long len) {
+    checkRange(dstOffset, len);
+    setBytesNative(address, dstOffset, src, srcOffset, len);
+  }
+
+  /** Copy {@code len} bytes from this buffer at {@code srcOffset} into {@code dst[dstOffset..]}. */
+  public void getBytes(byte[] dst, long dstOffset, long srcOffset, long len) {
+    checkRange(srcOffset, len);
+    getBytesNative(dst, dstOffset, address, srcOffset, len);
+  }
+
+  private void checkRange(long offset, long len) {
+    if (offset < 0 || len < 0 || offset + len > length) {
+      throw new IndexOutOfBoundsException(
+          "range [" + offset + ", " + (offset + len) + ") outside buffer of " + length);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      freeNative(handle);
+      handle = 0;
+    }
+  }
+
+  private static native long allocateNative(long bytes);
+
+  private static native long addressNative(long handle);
+
+  private static native void freeNative(long handle);
+
+  private static native void setBytesNative(
+      long address, long dstOffset, byte[] src, long srcOffset, long len);
+
+  private static native void getBytesNative(
+      byte[] dst, long dstOffset, long address, long srcOffset, long len);
+}

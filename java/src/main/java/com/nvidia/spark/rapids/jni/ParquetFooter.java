@@ -10,6 +10,8 @@
  */
 package com.nvidia.spark.rapids.jni;
 
+import ai.rapids.cudf.HostMemoryBuffer;
+
 import java.util.ArrayList;
 import java.util.List;
 import java.util.Locale;
@@ -103,13 +105,18 @@ public class ParquetFooter implements AutoCloseable {
   }
 
   public static class StructElement extends SchemaElement {
-    private final List<String> childNames = new ArrayList<>();
-    private final List<SchemaElement> children = new ArrayList<>();
+    /** Structs build through {@link StructBuilder}, matching the
+     * reference's construction surface (private ctor + builder). */
+    public static StructBuilder builder() {
+      return new StructBuilder();
+    }
 
-    public StructElement addChild(String name, SchemaElement child) {
-      childNames.add(name);
-      children.add(child);
-      return this;
+    private final List<String> childNames;
+    private final List<SchemaElement> children;
+
+    private StructElement(List<String> childNames, List<SchemaElement> children) {
+      this.childNames = childNames;
+      this.children = children;
     }
 
     @Override
@@ -134,6 +141,25 @@ public class ParquetFooter implements AutoCloseable {
     }
   }
 
+  public static class StructBuilder {
+    private final List<String> childNames = new ArrayList<>();
+    private final List<SchemaElement> children = new ArrayList<>();
+
+    StructBuilder() {}
+
+    public StructBuilder addChild(String name, SchemaElement child) {
+      childNames.add(name);
+      children.add(child);
+      return this;
+    }
+
+    public StructElement build() {
+      // copy: further builder mutation must not alias into the
+      // (immutable by contract) built element
+      return new StructElement(new ArrayList<>(childNames), new ArrayList<>(children));
+    }
+  }
+
   private long nativeHandle;
 
   private ParquetFooter(long handle) {
@@ -141,8 +167,22 @@ public class ParquetFooter implements AutoCloseable {
   }
 
   /**
-   * Parse + prune a footer held in host memory (address/length pair, the
-   * HostMemoryBuffer contract of the reference).
+   * Parse + prune a footer held in a {@link HostMemoryBuffer} — the
+   * reference's drop-in signature (reference ParquetFooter.java:200).
+   */
+  public static ParquetFooter readAndFilter(
+      HostMemoryBuffer buffer,
+      long partOffset,
+      long partLength,
+      StructElement schema,
+      boolean ignoreCase) {
+    return readAndFilter(
+        buffer.getAddress(), buffer.getLength(), partOffset, partLength, schema, ignoreCase);
+  }
+
+  /**
+   * Parse + prune a footer held in host memory (raw address/length pair;
+   * the JDK-less-testable variant the ctypes tier drives).
    */
   public static ParquetFooter readAndFilter(
       long address,
@@ -185,8 +225,25 @@ public class ParquetFooter implements AutoCloseable {
     return getNumColumnsNative(nativeHandle);
   }
 
-  /** Serialized PAR1-framed footer bytes (data-less parquet file). */
-  public byte[] serializeThriftFile() {
+  /**
+   * Serialized PAR1-framed footer (data-less parquet file) in a
+   * {@link HostMemoryBuffer}, matching the reference's return type
+   * (reference ParquetFooter.java:106). Caller owns the buffer.
+   */
+  public HostMemoryBuffer serializeThriftFile() {
+    byte[] bytes = serializeThriftFileNative(nativeHandle);
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(bytes.length);
+    try {
+      buf.setBytes(0, bytes, 0, bytes.length);
+    } catch (RuntimeException | Error e) {
+      buf.close();
+      throw e;
+    }
+    return buf;
+  }
+
+  /** Serialized PAR1-framed footer bytes (array-returning convenience). */
+  public byte[] serializeThriftFileBytes() {
     return serializeThriftFileNative(nativeHandle);
   }
 

@@ -30,6 +30,10 @@ int64_t srjt_footer_serialize(int64_t h, int64_t* out_size);
 int32_t srjt_blob_copy(int64_t blob_h, uint8_t* out, int64_t capacity);
 void srjt_blob_free(int64_t blob_h);
 void srjt_footer_close(int64_t h);
+int64_t srjt_host_alloc(int64_t size, int64_t alignment);
+uint8_t* srjt_host_ptr(int64_t h);
+int64_t srjt_host_size(int64_t h);
+void srjt_host_free(int64_t h);
 }
 
 namespace {
@@ -105,26 +109,80 @@ Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeThriftFileNative(
     return nullptr;
   }
   jbyteArray out = env->NewByteArray(static_cast<jsize>(size));
-  if (out != nullptr) {
-    // one copy: blob -> pinned Java array storage
-    void* dst = env->GetPrimitiveArrayCritical(out, nullptr);
-    if (dst != nullptr) {
-      int32_t rc = srjt_blob_copy(blob, static_cast<uint8_t*>(dst), size);
-      env->ReleasePrimitiveArrayCritical(out, dst, 0);
-      if (rc != 0) {
-        srjt_blob_free(blob);
-        throw_last_error(env);
-        return nullptr;
-      }
-    }
+  if (out == nullptr) {
+    // NewByteArray already left an OutOfMemoryError pending
+    srjt_blob_free(blob);
+    return nullptr;
   }
+  // one copy: blob -> pinned Java array storage
+  void* dst = env->GetPrimitiveArrayCritical(out, nullptr);
+  if (dst == nullptr) {
+    // pin failure must surface as an exception, never as a silently
+    // zero-filled (corrupt) footer byte array
+    srjt_blob_free(blob);
+    jclass oom = env->FindClass("java/lang/OutOfMemoryError");
+    if (oom != nullptr) {
+      env->ThrowNew(oom, "GetPrimitiveArrayCritical failed pinning footer bytes");
+    }
+    return nullptr;
+  }
+  int32_t rc = srjt_blob_copy(blob, static_cast<uint8_t*>(dst), size);
+  env->ReleasePrimitiveArrayCritical(out, dst, 0);
   srjt_blob_free(blob);
+  if (rc != 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
   return out;
 }
 
 JNIEXPORT void JNICALL Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative(
     JNIEnv*, jclass, jlong handle) {
   srjt_footer_close(handle);
+}
+
+// --- ai.rapids.cudf.HostMemoryBuffer over the srjt host arena ------------
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_allocateNative(
+    JNIEnv* env, jclass, jlong bytes) {
+  int64_t h = srjt_host_alloc(bytes, 64);
+  if (h == 0) {
+    throw_last_error(env);
+  }
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_addressNative(
+    JNIEnv* env, jclass, jlong handle) {
+  uint8_t* p = srjt_host_ptr(handle);
+  if (p == nullptr) {
+    // a valid zero-length buffer legitimately has a null data pointer
+    if (srjt_host_size(handle) == 0) {
+      return 0;
+    }
+    throw_last_error(env);
+    return 0;
+  }
+  return reinterpret_cast<jlong>(p);
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_freeNative(
+    JNIEnv*, jclass, jlong handle) {
+  srjt_host_free(handle);
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_setBytesNative(
+    JNIEnv* env, jclass, jlong address, jlong dst_offset, jbyteArray src, jlong src_offset,
+    jlong len) {
+  env->GetByteArrayRegion(src, static_cast<jsize>(src_offset), static_cast<jsize>(len),
+                          reinterpret_cast<jbyte*>(address + dst_offset));
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_getBytesNative(
+    JNIEnv* env, jclass, jbyteArray dst, jlong dst_offset, jlong address, jlong src_offset,
+    jlong len) {
+  env->SetByteArrayRegion(dst, static_cast<jsize>(dst_offset), static_cast<jsize>(len),
+                          reinterpret_cast<const jbyte*>(address + src_offset));
 }
 
 }  // extern "C"

@@ -123,9 +123,9 @@ void filter_schema(const Pruner& p, SchemaWalk& w);
 void filter_value(SchemaWalk& w) {
   const TStruct& e = w.elem();
   if (!SchemaWalk::is_leaf(e))
-    throw FooterError("found a non-leaf entry when reading a leaf value");
+    throw FooterError("leaf request hit a group element");
   if (SchemaWalk::n_children(e) != 0)
-    throw FooterError("found an entry with children when reading a leaf value");
+    throw FooterError("leaf request but file element has children");
   w.schema_map.push_back(w.i);
   w.schema_num_children.push_back(0);
   ++w.i;
@@ -136,7 +136,7 @@ void filter_value(SchemaWalk& w) {
 void filter_struct(const Pruner& p, SchemaWalk& w) {
   const TStruct& e = w.elem();
   if (SchemaWalk::is_leaf(e))
-    throw FooterError("Found a leaf node, but expected to find a struct");
+    throw FooterError("struct request hit a leaf file element");
   int64_t n = SchemaWalk::n_children(e);
   w.schema_map.push_back(w.i);
   size_t my_count_idx = w.schema_num_children.size();
@@ -163,21 +163,21 @@ void filter_list(const Pruner& p, SchemaWalk& w) {
   std::string list_name = nv == nullptr ? std::string() : nv->bin;
   if (SchemaWalk::is_leaf(e)) {
     if (e.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
-      throw FooterError("expected list item to be repeating");
+      throw FooterError("list element child is not marked repeated");
     filter_value(w);
     return;
   }
   if (e.get_int(SE_CONVERTED_TYPE, -1) != CONVERTED_LIST)
-    throw FooterError("expected a list type, but it was not found.");
+    throw FooterError("requested LIST does not match the file element type");
   if (SchemaWalk::n_children(e) != 1)
-    throw FooterError("the structure of the outer list group is not standard");
+    throw FooterError("outer list group has an unsupported layout");
   w.schema_map.push_back(w.i);
   w.schema_num_children.push_back(1);
   ++w.i;
 
   const TStruct& rep = w.elem();
   if (rep.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
-    throw FooterError("the structure of the list's child is not standard (non repeating)");
+    throw FooterError("list child layout unsupported: child is not repeated");
   bool rep_is_group = !SchemaWalk::is_leaf(rep);
   int64_t rep_n = SchemaWalk::n_children(rep);
   const TValue* rn = rep.get(SE_NAME);
@@ -201,21 +201,21 @@ void filter_map(const Pruner& p, SchemaWalk& w) {
     throw FooterError("map pruner missing key/value children");
   const TStruct& e = w.elem();
   if (SchemaWalk::is_leaf(e))
-    throw FooterError("expected a map item, but found a single value");
+    throw FooterError("requested MAP hit a single-value element");
   int64_t ct = e.get_int(SE_CONVERTED_TYPE, -1);
   if (ct != CONVERTED_MAP && ct != CONVERTED_MAP_KEY_VALUE)
-    throw FooterError("expected a map type, but it was not found.");
+    throw FooterError("requested MAP does not match the file element type");
   if (SchemaWalk::n_children(e) != 1)
-    throw FooterError("the structure of the outer map group is not standard");
+    throw FooterError("outer map group has an unsupported layout");
   w.schema_map.push_back(w.i);
   w.schema_num_children.push_back(1);
   ++w.i;
 
   const TStruct& rep = w.elem();
   if (rep.get_int(SE_REPETITION, -1) != REPETITION_REPEATED)
-    throw FooterError("found non repeating map child");
+    throw FooterError("map key_value child is not marked repeated");
   int64_t rep_n = SchemaWalk::n_children(rep);
-  if (rep_n != 1 && rep_n != 2) throw FooterError("found map with wrong number of children");
+  if (rep_n != 1 && rep_n != 2) throw FooterError("map key_value group must have 1 or 2 children");
   w.schema_map.push_back(w.i);
   w.schema_num_children.push_back(static_cast<int32_t>(rep_n));
   ++w.i;
@@ -447,10 +447,12 @@ std::unique_ptr<ParquetFooter> read_and_filter(
     TValue e = (*walk.schema)[walk.schema_map[k]];  // shallow copy
     auto st = std::make_shared<TStruct>(as_struct(e));  // own our field map
     int32_t n_kids = walk.schema_num_children[k];
+    // Groups keep num_children even when pruned to 0 (the reference
+    // serializes num_children=0 rather than an untyped pseudo-leaf);
+    // true leaves never had the field and stay without it.
     if (n_kids > 0 || st->has(SE_NUM_CHILDREN)) {
       st->set(SE_NUM_CHILDREN, TValue::of_int(WT_I32, n_kids));
     }
-    if (n_kids == 0) st->erase(SE_NUM_CHILDREN);
     e.st = std::move(st);
     new_schema.push_back(std::move(e));
   }

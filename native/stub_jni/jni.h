@@ -1,0 +1,64 @@
+// Minimal JNI header STUB for compile-checking srjt_jni.cc on hosts
+// without a JDK (the reference's JNI tier is gated on a GPU+JDK CI
+// runner; ours must at least catch signature rot in every premerge).
+//
+// This is NOT a functional JNI: every method aborts if called. It only
+// provides the types and JNIEnv surface srjt_jni.cc references, with
+// the same ABI shapes (jlong=int64, jint=int32, JNIEnv passed as
+// pointer-to-struct-of-methods) so the compiled object's JNIEXPORT
+// symbol signatures match a real JDK build.
+//
+// Selected when cmake is configured with -DSRJT_BUILD_JNI=ON and no
+// real JNI_INCLUDE_DIRS is found (see native/CMakeLists.txt).
+#ifndef SRJT_STUB_JNI_H
+#define SRJT_STUB_JNI_H
+
+#include <cstdint>
+#include <cstdlib>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+
+using jboolean = uint8_t;
+using jbyte = int8_t;
+using jchar = uint16_t;
+using jshort = int16_t;
+using jint = int32_t;
+using jlong = int64_t;
+using jfloat = float;
+using jdouble = double;
+using jsize = jint;
+
+class _jobject {};
+using jobject = _jobject*;
+using jclass = jobject;
+using jstring = jobject;
+using jarray = jobject;
+using jobjectArray = jobject;
+using jbooleanArray = jobject;
+using jbyteArray = jobject;
+using jintArray = jobject;
+using jlongArray = jobject;
+using jthrowable = jobject;
+
+struct JNIEnv {
+  [[noreturn]] static void die() { ::abort(); }
+
+  jclass FindClass(const char*) { die(); }
+  jint ThrowNew(jclass, const char*) { die(); }
+  jsize GetArrayLength(jarray) { die(); }
+  jobject GetObjectArrayElement(jobjectArray, jsize) { die(); }
+  const char* GetStringUTFChars(jstring, jboolean*) { die(); }
+  void ReleaseStringUTFChars(jstring, const char*) { die(); }
+  void DeleteLocalRef(jobject) { die(); }
+  jbyteArray NewByteArray(jsize) { die(); }
+  void* GetPrimitiveArrayCritical(jarray, jboolean*) { die(); }
+  void ReleasePrimitiveArrayCritical(jarray, void*, jint) { die(); }
+  void GetByteArrayRegion(jbyteArray, jsize, jsize, jbyte*) { die(); }
+  void SetByteArrayRegion(jbyteArray, jsize, jsize, const jbyte*) { die(); }
+  void GetIntArrayRegion(jintArray, jsize, jsize, jint*) { die(); }
+};
+
+#endif  // SRJT_STUB_JNI_H

@@ -241,7 +241,7 @@ def _filter_schema(p: _Pruner, w: _SchemaWalk) -> None:
 def _filter_struct(p: _Pruner, w: _SchemaWalk) -> None:
     e = w.elem()
     if w.is_leaf(e):
-        raise ValueError("Found a leaf node, but expected to find a struct")
+        raise ValueError("struct request hit a leaf file element")
     n = w.n_children(e)
     w.schema_map.append(w.i)
     my_count_idx = len(w.schema_num_children)
@@ -262,9 +262,9 @@ def _filter_struct(p: _Pruner, w: _SchemaWalk) -> None:
 def _filter_value(w: _SchemaWalk) -> None:
     e = w.elem()
     if not w.is_leaf(e):
-        raise ValueError("found a non-leaf entry when reading a leaf value")
+        raise ValueError("leaf request hit a group element")
     if w.n_children(e) != 0:
-        raise ValueError("found an entry with children when reading a leaf value")
+        raise ValueError("leaf request but file element has children")
     w.schema_map.append(w.i)
     w.schema_num_children.append(0)
     w.i += 1
@@ -278,19 +278,19 @@ def _filter_list(p: _Pruner, w: _SchemaWalk) -> None:
     list_name = e.get(_SE_NAME, b"").decode("utf-8", "replace")
     if w.is_leaf(e):
         if e.get(_SE_REPETITION) != _REPEATED:
-            raise ValueError("expected list item to be repeating")
+            raise ValueError("list element child is not marked repeated")
         return _filter_value(w)
     if e.get(_SE_CONVERTED_TYPE) != _CONVERTED_LIST:
-        raise ValueError("expected a list type, but it was not found.")
+        raise ValueError("requested LIST does not match the file element type")
     if w.n_children(e) != 1:
-        raise ValueError("the structure of the outer list group is not standard")
+        raise ValueError("outer list group has an unsupported layout")
     w.schema_map.append(w.i)
     w.schema_num_children.append(1)
     w.i += 1
 
     rep = w.elem()
     if rep.get(_SE_REPETITION) != _REPEATED:
-        raise ValueError("the structure of the list's child is not standard (non repeating)")
+        raise ValueError("list child layout unsupported: child is not repeated")
     rep_is_group = not w.is_leaf(rep)
     rep_n = w.n_children(rep)
     rep_name = rep.get(_SE_NAME, b"").decode("utf-8", "replace")
@@ -310,21 +310,21 @@ def _filter_map(p: _Pruner, w: _SchemaWalk) -> None:
     value_found = p.children["value"]
     e = w.elem()
     if w.is_leaf(e):
-        raise ValueError("expected a map item, but found a single value")
+        raise ValueError("requested MAP hit a single-value element")
     if e.get(_SE_CONVERTED_TYPE) not in (_CONVERTED_MAP, _CONVERTED_MAP_KEY_VALUE):
-        raise ValueError("expected a map type, but it was not found.")
+        raise ValueError("requested MAP does not match the file element type")
     if w.n_children(e) != 1:
-        raise ValueError("the structure of the outer map group is not standard")
+        raise ValueError("outer map group has an unsupported layout")
     w.schema_map.append(w.i)
     w.schema_num_children.append(1)
     w.i += 1
 
     rep = w.elem()
     if rep.get(_SE_REPETITION) != _REPEATED:
-        raise ValueError("found non repeating map child")
+        raise ValueError("map key_value child is not marked repeated")
     rep_n = w.n_children(rep)
     if rep_n not in (1, 2):
-        raise ValueError("found map with wrong number of children")
+        raise ValueError("map key_value group must have 1 or 2 children")
     w.schema_map.append(w.i)
     w.schema_num_children.append(rep_n)
     w.i += 1
@@ -453,10 +453,11 @@ def read_and_filter(
     new_schema = []
     for idx, n_kids in zip(walk.schema_map, walk.schema_num_children):
         e = ThriftStruct(dict(schema_list.values[idx].fields))
+        # Groups keep num_children even when pruned to 0 (the reference
+        # serializes num_children=0 rather than an untyped pseudo-leaf);
+        # true leaves never had the field and stay without it.
         if e.has(_SE_NUM_CHILDREN) or n_kids > 0:
             e.set(_SE_NUM_CHILDREN, tc.CT_I32, n_kids)
-        if n_kids == 0:
-            e.delete(_SE_NUM_CHILDREN)
         new_schema.append(e)
     schema_list.values = new_schema
 

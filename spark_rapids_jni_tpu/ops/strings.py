@@ -32,6 +32,7 @@ __all__ = [
     "lower",
     "substring",
     "concat",
+    "concat_ws",
     "contains",
     "startswith",
     "endswith",
@@ -127,9 +128,22 @@ def substring(col: Column, start: int, slen: Optional[int] = None) -> Column:
 
 
 @op_boundary("strings.concat")
-def concat(cols: Sequence[Column], separator: bytes = b"") -> Column:
-    """Row-wise concatenation with a scalar separator (Spark concat_ws
-    shape; null row in any input -> null output row, concat semantics)."""
+def concat(
+    cols: Sequence[Column], separator: bytes = b"", null_policy: str = "propagate"
+) -> Column:
+    """Row-wise concatenation with a scalar separator.
+
+    ``null_policy`` selects between Spark's two distinct operators
+    (they differ ONLY in null handling, so both ride one kernel):
+
+    - ``"propagate"`` — Spark ``concat`` semantics: a null row in any
+      input nulls the whole output row.
+    - ``"skip"`` — Spark ``concat_ws`` semantics: null inputs are
+      skipped entirely (contributing neither text nor a separator
+      slot); the result is never null for a non-null separator.
+    """
+    if null_policy not in ("propagate", "skip"):
+        raise ValueError(f"unknown null_policy {null_policy!r}")
     cols = list(cols)
     if not cols:
         raise ValueError("concat needs at least one column")
@@ -139,33 +153,57 @@ def concat(cols: Sequence[Column], separator: bytes = b"") -> Column:
     n = len(cols[0])
 
     parts = [to_padded(c) for c in cols]
-    out_lens = parts[0][1]
-    for _, lens in parts[1:]:
-        out_lens = out_lens + lens + len(sep)
+    if null_policy == "skip":
+        kept = [
+            jnp.ones((n,), bool) if c.validity is None else c.validity for c in cols
+        ]
+    else:
+        # every input contributes text; nullness is applied to the
+        # output validity mask instead
+        kept = [jnp.ones((n,), bool)] * len(cols)
+
+    # per-row output length: kept parts + a separator before each kept
+    # part that follows at least one earlier kept part
+    out_lens = jnp.zeros((n,), jnp.int32)
+    emitted = jnp.zeros((n,), bool)
+    sep_present: list = []
+    for k, (_, lens) in enumerate(parts):
+        present = (emitted & kept[k]) if (k > 0 and len(sep)) else jnp.zeros((n,), bool)
+        sep_present.append(present)
+        out_lens = out_lens + present * len(sep) + jnp.where(kept[k], lens, 0)
+        emitted = emitted | kept[k]
     L = max(int(jnp.max(out_lens)) if n else 1, 1)
 
     out = jnp.zeros((n, L), jnp.uint8)
     cursor = jnp.zeros((n,), jnp.int32)
-    j = jnp.arange(L, dtype=jnp.int32)[None, :]
     for k, (padded, lens) in enumerate(parts):
         if k > 0 and len(sep):
+            sep_lens = jnp.where(sep_present[k], len(sep), 0).astype(jnp.int32)
             sep_j = jnp.arange(len(sep), dtype=jnp.int32)[None, :]
             dest = cursor[:, None] + sep_j
-            out = _scatter_rows(out, dest, jnp.broadcast_to(jnp.asarray(sep)[None, :], (n, len(sep))), jnp.full((n,), len(sep), jnp.int32), sep_j)
-            cursor = cursor + len(sep)
+            out = _scatter_rows(out, dest, jnp.broadcast_to(jnp.asarray(sep)[None, :], (n, len(sep))), sep_lens, sep_j)
+            cursor = cursor + sep_lens
+        eff_lens = jnp.where(kept[k], lens, 0).astype(jnp.int32)
         src_j = jnp.arange(padded.shape[1], dtype=jnp.int32)[None, :]
         dest = cursor[:, None] + src_j
-        out = _scatter_rows(out, dest, padded, lens, src_j)
-        cursor = cursor + lens
+        out = _scatter_rows(out, dest, padded, eff_lens, src_j)
+        cursor = cursor + eff_lens
 
     validity = None
-    masks = [c.validity for c in cols if c.validity is not None]
-    if masks:
-        v = masks[0]
-        for m in masks[1:]:
-            v = v & m
-        validity = v
+    if null_policy == "propagate":
+        masks = [c.validity for c in cols if c.validity is not None]
+        if masks:
+            v = masks[0]
+            for m in masks[1:]:
+                v = v & m
+            validity = v
     return from_padded(out, out_lens, validity)
+
+
+@op_boundary("strings.concat_ws")
+def concat_ws(cols: Sequence[Column], separator: bytes) -> Column:
+    """Spark ``concat_ws``: null inputs skipped, never-null output."""
+    return concat(cols, separator, null_policy="skip")
 
 
 def _scatter_rows(out, dest, vals, lens, src_j):

@@ -12,6 +12,8 @@ re-run the batch).
 
 from __future__ import annotations
 
+import re
+
 __all__ = ["DeviceError", "FatalDeviceError", "RetryableError", "classify"]
 
 
@@ -27,9 +29,11 @@ class RetryableError(DeviceError):
     """Transient failure; the same batch may be retried on this device."""
 
 
-# Substrings in backend error text that indicate a dead device/client.
+# Patterns in backend error text that indicate a dead device/client.
+# "DEAD" is word-bounded so it cannot swallow DEADLINE_EXCEEDED (a
+# retryable timeout), since fatal patterns are checked first.
 _FATAL_MARKERS = (
-    "DEAD",
+    r"\bDEAD\b",
     "device is in an invalid state",
     "client has been shut down",
     "deadlock",
@@ -53,12 +57,16 @@ def classify(exc: BaseException) -> DeviceError:
     if isinstance(exc, DeviceError):
         return exc
     text = str(exc)
+    # Fatal markers are checked FIRST: a message carrying both (e.g.
+    # "INTERNAL: Accelerator ... channel UNAVAILABLE") means the device
+    # is gone, and retrying batches on a dead device would strand the
+    # executor — fatal must win on mixed-marker messages.
+    for m in _FATAL_MARKERS:
+        if re.search(m, text):
+            return FatalDeviceError(text)
     for m in _RETRYABLE_MARKERS:
         if m in text:
             return RetryableError(text)
-    for m in _FATAL_MARKERS:
-        if m in text:
-            return FatalDeviceError(text)
     if isinstance(exc, (ValueError, TypeError, KeyError, IndexError)):
         # host-side programming/input errors are not device failures;
         # re-raise unchanged by convention (caller checks type)

@@ -124,6 +124,33 @@ def test_nested_struct_pruning():
     assert md.schema.column(0).path.split(".") == ["s", "x"]
 
 
+def test_struct_pruned_to_zero_children_keeps_num_children():
+    # A requested struct whose requested children are all absent from
+    # the file must serialize as a group with num_children=0 (matching
+    # the reference), NOT as an untyped pseudo-leaf with neither type
+    # nor num_children.
+    t = pa.table({
+        "s": pa.array([{"x": i, "y": i * 2} for i in range(5)],
+                      pa.struct([("x", pa.int64()), ("y", pa.int64())])),
+        "a": pa.array(range(5), pa.int32()),
+    })
+    data = make_parquet(t)
+    schema = StructElement().add_child(
+        "s", StructElement().add_child("nope", ValueElement())
+    )
+    f = read_and_filter(data, 0, len(data), schema)
+    # getNumColumns counts root schema children (reference semantics):
+    # the emptied group itself is still one child of the root
+    assert f.get_num_columns() == 1
+    raw = footer_bytes(f.serialize_thrift_file())
+    meta = tc.read_struct(raw)
+    elems = meta.get(2).values  # FileMetaData.schema
+    s_elem = [e for e in elems if e.get(4) == b"s"]
+    assert len(s_elem) == 1
+    assert s_elem[0].has(5) and s_elem[0].get(5) == 0  # num_children kept
+    assert not s_elem[0].has(1)  # still a group: no type field
+
+
 def test_list_pruning():
     t = pa.table({
         "l": pa.array([[1, 2], [3], []], pa.list_(pa.int32())),

@@ -83,6 +83,19 @@ def test_concat_no_separator():
     assert got_strings(ss.concat([a, b])) == ["abcd", "ef"]
 
 
+def test_concat_ws_skips_nulls():
+    # Spark concat_ws: null inputs are skipped (no separator slot), and
+    # the result is never null for a non-null separator.
+    a = Column.from_pylist(["x", None, "", None], dt.STRING)
+    b = Column.from_pylist(["y", "mid", None, None], dt.STRING)
+    c = Column.from_pylist(["z", "end", "tail", None], dt.STRING)
+    out = ss.concat_ws([a, b, c], b"-")
+    assert got_strings(out) == ["x-y-z", "mid-end", "-tail", ""]
+    # same inputs under concat semantics: any null row nulls the output
+    out2 = ss.concat([a, b, c], b"-")
+    assert got_strings(out2) == ["x-y-z", None, None, None]
+
+
 @pytest.mark.parametrize("pat", [b"l", b"Case", b"", b"zz", b"notthere", b"xyzzy plugh!"])
 def test_contains(pat):
     out = ss.contains(col(), pat)

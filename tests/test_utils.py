@@ -114,6 +114,20 @@ class TestErrors:
         e = errors.classify(RuntimeError("backend exploded in a new way"))
         assert isinstance(e, errors.FatalDeviceError)
 
+    def test_classify_deadline_exceeded_retryable(self):
+        # "DEAD" is word-bounded: it must not swallow DEADLINE_EXCEEDED
+        e = errors.classify(RuntimeError("DEADLINE_EXCEEDED: op timed out"))
+        assert isinstance(e, errors.RetryableError)
+
+    def test_classify_mixed_markers_fatal_wins(self):
+        # A dead accelerator often surfaces with a retryable-looking
+        # suffix; retrying batches on a dead device strands the
+        # executor, so fatal must win.
+        e = errors.classify(
+            RuntimeError("INTERNAL: Accelerator t5 channel UNAVAILABLE")
+        )
+        assert isinstance(e, errors.FatalDeviceError)
+
     def test_host_errors_pass_through(self):
         with pytest.raises(ValueError):
             errors.classify(ValueError("bad argument"))
