@@ -62,7 +62,7 @@ def _timed(fn) -> float:
     return float(np.median(times))
 
 
-def bench_device() -> float:
+def bench_device() -> "tuple[float, float, float]":
     rng = np.random.default_rng(42)
     keys = jnp.asarray(rng.integers(0, N_KEYS, N_ROWS), jnp.int64)
     vals = jnp.asarray(rng.standard_normal(N_ROWS), jnp.float32)
@@ -72,7 +72,7 @@ def bench_device() -> float:
     t_short = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_SHORT))
     t_long = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_LONG))
     per_iter = max((t_long - t_short) / (K_LONG - K_SHORT), 1e-9)
-    return per_iter
+    return per_iter, t_short, t_long
 
 
 def bench_cpu_ref() -> float:
@@ -92,7 +92,7 @@ def bench_cpu_ref() -> float:
 
 
 def main():
-    t_dev = bench_device()
+    t_dev, t_short, t_long = bench_device()
     t_cpu = bench_cpu_ref()
     mrows_s = (N_ROWS / t_dev) / 1e6
     vs_baseline = t_cpu / t_dev  # >1 means faster than the CPU ref
@@ -103,6 +103,16 @@ def main():
                 "value": round(mrows_s, 2),
                 "unit": "Mrows/s",
                 "vs_baseline": round(vs_baseline, 3),
+                # raw protocol inputs so the derived per-iter can be
+                # audited against tunnel-latency drift: per_iter =
+                # (t_long - t_short) / (K_LONG - K_SHORT)
+                "raw": {
+                    "t_short_s": round(t_short, 5),
+                    "t_long_s": round(t_long, 5),
+                    "k_short": K_SHORT,
+                    "k_long": K_LONG,
+                    "cpu_ref_s": round(t_cpu, 5),
+                },
             }
         )
     )

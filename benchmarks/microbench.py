@@ -108,6 +108,16 @@ def bench_row_conversion_fixed(rows: int, reps: int, cols: int = 212) -> None:
     secs = _time(lambda: [rc.convert_from_rows(b, dtypes) for b in row_cols], reps)
     _report("row_conversion_fixed_from_rows", rows, cols, secs, nbytes)
 
+    # grouped decode: the fused-pipeline form — one program, O(width
+    # groups) output buffers instead of O(columns). The per-column
+    # variant above additionally pays one buffer registration per
+    # column+validity (~0.5 ms each through a remote tunnel), which is
+    # runtime overhead, not decode work; this axis isolates the decode.
+    secs = _time(
+        lambda: [rc.convert_from_rows_grouped(b, dtypes).groups for b in row_cols], reps
+    )
+    _report("row_conversion_fixed_from_rows_grouped", rows, cols, secs, nbytes)
+
 
 def bench_row_conversion_mixed(rows: int, reps: int, cols: int = 155, strings: bool = True) -> None:
     from spark_rapids_jni_tpu.ops import row_conversion as rc

@@ -49,6 +49,21 @@ def groupby_sum_bounded(
     O(N) and HBM-bandwidth-bound on TPU, where the general path pays an
     O(N log^2 N) sort.
     """
+    if (
+        not jnp.issubdtype(vals.dtype, jnp.integer)
+        and num_keys <= 65536
+        and keys.shape[0] < (1 << 24)  # counts ride an f32 accumulator:
+        # exact only while every per-key count stays below 2^24
+        and jax.default_backend() == "tpu"
+    ):
+        # float path on hardware: the outer-product MXU kernel beats the
+        # XLA scatter ~5x at the 1M x 4096 axis (see pallas_kernels).
+        # Integer sums stay on the exact int64 scatter path.
+        from .pallas_kernels import pallas_available, pallas_groupby_sum_outer
+
+        if pallas_available():
+            return pallas_groupby_sum_outer(keys, vals, num_keys)
+
     seg = jnp.where((keys >= 0) & (keys < num_keys), keys, num_keys).astype(jnp.int32)
     if jnp.issubdtype(vals.dtype, jnp.integer):
         vals = vals.astype(jnp.int64)

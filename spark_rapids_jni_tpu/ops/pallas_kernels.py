@@ -31,7 +31,12 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["pallas_partition_map", "pallas_groupby_sum_bounded", "pallas_available"]
+__all__ = [
+    "pallas_partition_map",
+    "pallas_groupby_sum_bounded",
+    "pallas_groupby_sum_outer",
+    "pallas_available",
+]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # 512x128 u32 block = 256KB/input plane in VMEM
@@ -235,6 +240,129 @@ def _groupby_impl(keys, vals, num_keys: int, interpret: bool):
     )(kp, vp)
     # 8 sublane partial accumulators -> final sums
     return jnp.sum(out, axis=0)[:num_keys]
+
+
+# ---------------------------------------------------------------------------
+# outer-product GROUP BY SUM: full-width MXU formulation
+# ---------------------------------------------------------------------------
+#
+# The kernel above is a matvec (M=1) and wastes 127/128 of the MXU.
+# This one restores the M dimension with the histogram outer-product
+# decomposition: write key = hi*128 + lo, then
+#
+#   sums[hi, lo]   = sum_i vals[i] * OH_hi[i, hi] * OH_lo[i, lo]
+#   counts[hi, lo] = sum_i           OH_hi[i, hi] * OH_lo[i, lo]
+#
+# i.e. ONE [4H, NT] x [NT, 128] matmul per row block:
+#   lhs = [A1 | A2 | A3 | C] with A_k = v_k-weighted hi-one-hot and C
+#   the unweighted hi-one-hot, rhs = lo-one-hot. v is split into three
+#   bf16 limbs (v = v1+v2+v3 captures all 24 f32 mantissa bits), and
+#   the rhs one-hot is exactly representable in bf16, so each MXU
+#   product is exact and the f32 accumulator gives segment_sum-class
+#   accuracy — at single-pass bf16 speed, with H=32 (num_keys=4096)
+#   filling the MXU's M dimension (4H=128).
+#
+# Both one-hots live only in VMEM; HBM traffic is just keys+vals.
+
+_OUTER_NT = 512  # rows contracted per sublane step (VMEM-bounded: the 8x
+# sublane unroll keeps ~8 blocks of one-hot intermediates live)
+
+
+def _outer_kernel(k_ref, v_ref, out_ref, *, H: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # static unroll over the block's 8 sublanes (the legal (8, NT)
+    # block shape); each iteration contracts NT rows at full MXU width
+    for s in range(_GB_SUBLANES):
+        k = k_ref[s, :].reshape(-1, 1)  # [NT, 1] i32 (pre-mapped to [0, H*128))
+        v = v_ref[s, :].reshape(-1, 1)  # [NT, 1] f32
+        nt = k.shape[0]
+
+        lo = k & 127
+        hi = k >> 7
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (nt, _LANES), 1)
+        iota_h = jax.lax.broadcasted_iota(jnp.int32, (nt, H), 1)
+        rhs = (lo == iota_l).astype(jnp.bfloat16)  # [NT, 128]
+        # single bool->bf16 consumer, then multiplies: Mosaic rejects
+        # the multi-consumer broadcast i1 relayout a where-chain needs,
+        # and one-hot products are exact either way (factors are 0/1)
+        ohh = (hi == iota_h).astype(jnp.bfloat16)  # [NT, H]
+
+        v1 = v.astype(jnp.bfloat16)
+        r1 = v - v1.astype(jnp.float32)
+        v2 = r1.astype(jnp.bfloat16)
+        v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
+        lhs = jnp.concatenate(
+            [ohh * v1, ohh * v2, ohh * v3, ohh],
+            axis=1,
+        )  # [NT, 4H]
+        out_ref[...] += jax.lax.dot_general(
+            lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [4H, 128]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _outer_impl(keys, vals, num_keys: int, interpret: bool):
+    n = keys.shape[0]
+    H = max((num_keys + _LANES - 1) // _LANES, 1)  # ceil(num_keys/128)
+    # out-of-domain/padding rows map to hi == H: outside the hi-one-hot,
+    # so they match no column and vanish (no in-matrix trash slot, which
+    # would force a 128-misaligned H)
+    trash = H * _LANES
+    in_domain = (keys >= 0) & (keys < num_keys)
+    seg = jnp.where(in_domain, keys, trash).astype(jnp.int32)
+
+    step_rows = _GB_SUBLANES * _OUTER_NT
+    g = max((n + step_rows - 1) // step_rows, 1)
+    total = g * step_rows
+    kp = jnp.full((total,), trash, jnp.int32).at[:n].set(seg).reshape(g * _GB_SUBLANES, _OUTER_NT)
+    vp = (
+        jnp.zeros((total,), jnp.float32)
+        .at[:n]
+        .set(vals.astype(jnp.float32))
+        .reshape(g * _GB_SUBLANES, _OUTER_NT)
+    )
+
+    row_spec = pl.BlockSpec(
+        (_GB_SUBLANES, _OUTER_NT),
+        lambda i: (i, jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    out_spec = pl.BlockSpec(
+        (4 * H, _LANES),
+        lambda i: (jnp.int32(0), jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    out = pl.pallas_call(
+        functools.partial(_outer_kernel, H=H),
+        out_shape=jax.ShapeDtypeStruct((4 * H, _LANES), jnp.float32),
+        grid=(g,),
+        in_specs=[row_spec, row_spec],
+        out_specs=out_spec,
+        interpret=interpret,
+    )(kp, vp)
+    sums = (out[:H] + out[H : 2 * H] + out[2 * H : 3 * H]).reshape(H * _LANES)[:num_keys]
+    counts = out[3 * H :].reshape(H * _LANES)[:num_keys].astype(jnp.int64)
+    return sums, counts
+
+
+def pallas_groupby_sum_outer(
+    keys: jnp.ndarray, vals: jnp.ndarray, num_keys: int, interpret: bool = False
+):
+    """GROUP BY SUM + COUNT over a bounded key domain [0, num_keys) as a
+    full-width MXU outer-product contraction. float32 sums, exact
+    int64-safe counts (f32 accumulator: exact below 2^24 rows/key).
+
+    Returns (sums[num_keys] f32, counts[num_keys] i64); out-of-domain
+    keys are dropped. num_keys <= 65536 (VMEM lhs tile).
+    """
+    if num_keys > 65536:
+        raise ValueError("pallas_groupby_sum_outer supports num_keys <= 65536")
+    return _outer_impl(keys, vals, int(num_keys), bool(interpret))
 
 
 def pallas_groupby_sum_bounded(

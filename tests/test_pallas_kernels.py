@@ -45,6 +45,48 @@ def test_groupby_sum_bounded_parity(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("n,num_keys", [(5000, 4096), (300, 7), (40000, 130), (2048, 65536)])
+def test_groupby_sum_outer_parity(rng, n, num_keys):
+    # dual-implementation cross-check: the MXU outer-product kernel must
+    # agree with the host bincount oracle on sums AND counts, dropping
+    # out-of-domain keys
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_outer
+
+    keys = rng.integers(-5, num_keys + 5, n)
+    vals = (rng.standard_normal(n) * 100).astype(np.float32)
+    s, c = pallas_groupby_sum_outer(
+        jnp.asarray(keys, jnp.int64), jnp.asarray(vals), num_keys, interpret=True
+    )
+    ind = (keys >= 0) & (keys < num_keys)
+    want_s = np.bincount(keys[ind], weights=vals[ind].astype(np.float64), minlength=num_keys)
+    want_c = np.bincount(keys[ind], minlength=num_keys)
+    np.testing.assert_allclose(np.asarray(s), want_s, rtol=2e-6, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(c), want_c)
+    assert c.dtype == jnp.int64
+
+
+def test_groupby_sum_outer_int64_overflow_keys_dropped():
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_outer
+
+    keys = jnp.asarray([0, 1, 2**32, -3], jnp.int64)
+    vals = jnp.asarray([1.0, 2.0, 100.0, 200.0], jnp.float32)
+    s, c = pallas_groupby_sum_outer(keys, vals, 4, interpret=True)
+    np.testing.assert_allclose(np.asarray(s), [1.0, 2.0, 0.0, 0.0])
+    np.testing.assert_array_equal(np.asarray(c), [1, 1, 0, 0])
+
+
+def test_groupby_sum_outer_limb_split_precision(rng):
+    # values chosen so single-bf16 rounding would visibly corrupt sums:
+    # the 3-limb split must keep f32-class accuracy
+    keys = np.zeros(1000, np.int64)
+    vals = (1.0 + rng.random(1000) * 1e-4).astype(np.float32)
+    from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_outer
+
+    s, c = pallas_groupby_sum_outer(jnp.asarray(keys), jnp.asarray(vals), 4, interpret=True)
+    want = float(np.sum(vals.astype(np.float64)))
+    assert abs(float(s[0]) - want) / want < 1e-6
+
+
 def test_groupby_sum_bounded_rejects_large_domain():
     from spark_rapids_jni_tpu.ops.pallas_kernels import pallas_groupby_sum_bounded
 
