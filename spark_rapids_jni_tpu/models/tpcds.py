@@ -21,7 +21,7 @@ from ..columnar import dtype as dt
 from ..ops import bitutils, copying
 from ..ops.aggregate import groupby_aggregate
 from ..ops.expressions import col, lit
-from ..ops.join import inner_join
+from ..ops.join import inner_join, left_semi_join
 from ..ops.sort import sort_by_key
 
 __all__ = ["gen_store", "gen_web", "q3", "q95"]
@@ -144,8 +144,8 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
           AND ws_order_number IN (SELECT * FROM ws_wh)
           AND ws_order_number IN (SELECT wr_order_number FROM web_returns)
 
-    Semi-joins run as inner joins against deduplicated key tables (the
-    plan spark-rapids produces for IN-subqueries after dedup).
+    The IN-subqueries run as true left-semi joins (the plan Spark
+    produces for IN; ops.join.left_semi_join).
     """
     ws = tables["web_sales"]
 
@@ -159,20 +159,17 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
     multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
     ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
 
-    # returned orders, deduplicated
+    # returned orders (no dedup needed: semi-join multiplicity is 0/1)
     wr = tables["web_returns"]
-    wr_dedup = groupby_aggregate(
-        wr.select(["wr_order_number"]), wr.select(["wr_order_number"]), [("wr_order_number", "count_all")]
-    ).select(["wr_order_number"])
-    wr_dedup = Table(wr_dedup.columns, ["ws_order_number"])
+    wr_keys = Table(wr.select(["wr_order_number"]).columns, ["ws_order_number"])
 
     pred = (
         (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
         & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
     ).evaluate(ws)
     ws1 = copying.apply_boolean_mask(ws, pred)
-    ws1 = inner_join(ws1, ws_wh, on=["ws_order_number"])  # semi: right is unique
-    ws1 = inner_join(ws1, wr_dedup, on=["ws_order_number"])
+    ws1 = left_semi_join(ws1, ws_wh, on=["ws_order_number"])
+    ws1 = left_semi_join(ws1, wr_keys, on=["ws_order_number"])
 
     per = groupby_aggregate(
         ws1.select(["ws_order_number"]),

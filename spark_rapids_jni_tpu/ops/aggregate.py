@@ -50,8 +50,8 @@ def groupby_sum_bounded(
     O(N log^2 N) sort.
     """
     if (
-        not jnp.issubdtype(vals.dtype, jnp.integer)
-        and num_keys <= 65536
+        vals.dtype == jnp.float32  # f64 sums must keep exact f64 segment_sum
+        and num_keys <= 16384
         and keys.shape[0] < (1 << 24)  # counts ride an f32 accumulator:
         # exact only while every per-key count stays below 2^24
         and jax.default_backend() == "tpu"

@@ -20,9 +20,33 @@ import numpy as np
 from jax import lax
 
 from ..columnar import Column, Table
+from ..columnar import dtype as dt
 from ..columnar.dtype import TypeId
 
 __all__ = ["gather", "gather_column", "apply_boolean_mask", "concatenate", "slice_table"]
+
+
+def _all_null_column(d, n_out: int) -> Column:
+    from ..columnar.dtype import TypeId as _T
+
+    valid = jnp.zeros((n_out,), bool)
+    if d.id == _T.STRING:
+        return Column(
+            d,
+            validity=valid,
+            offsets=jnp.zeros((n_out + 1,), jnp.int32),
+            chars=jnp.zeros((0,), jnp.uint8),
+        )
+    if d.id == _T.LIST:
+        return Column(
+            d,
+            validity=valid,
+            offsets=jnp.zeros((n_out + 1,), jnp.int32),
+            child=Column(dt.INT8, data=jnp.zeros((0,), jnp.int8)),
+        )
+    if d.id == _T.DECIMAL128:
+        return Column(d, data=jnp.zeros((n_out, 4), jnp.uint32), validity=valid)
+    return Column(d, data=jnp.zeros((n_out,), d.jnp_dtype), validity=valid)
 
 
 def gather_column(col: Column, idx: jnp.ndarray, check_bounds: bool = False) -> Column:
@@ -31,6 +55,12 @@ def gather_column(col: Column, idx: jnp.ndarray, check_bounds: bool = False) -> 
     n_out = idx.shape[0]
     n_in = len(col)
     idx = idx.astype(jnp.int32)
+    if n_in == 0:
+        # gathering from an empty source (e.g. the null-extended side of
+        # an outer join against an empty table): every row is OOB-null
+        if not check_bounds and n_out > 0:
+            raise IndexError("gather from empty column without check_bounds")
+        return _all_null_column(col.dtype, n_out)
     oob = (idx < 0) | (idx >= n_in)
     safe = jnp.clip(idx, 0, max(n_in - 1, 0))
 

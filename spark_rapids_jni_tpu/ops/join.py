@@ -1,4 +1,5 @@
-"""Equi-join tier (cudf hash join, SURVEY §2.8) — inner / left joins.
+"""Equi-join tier (cudf hash join, SURVEY §2.8) — inner / left /
+full-outer / left-semi / left-anti joins.
 
 TPU-first: XLA has no device hash table, so the join is the canonical
 sort-probe formulation:
@@ -32,7 +33,15 @@ from .aggregate import _segment_ids
 from .copying import concatenate, gather, gather_column
 from .sort import sorted_order
 
-__all__ = ["join_gather_maps", "inner_join", "left_join"]
+__all__ = [
+    "join_gather_maps",
+    "semi_anti_gather_map",
+    "inner_join",
+    "left_join",
+    "full_join",
+    "left_semi_join",
+    "left_anti_join",
+]
 
 
 def _factorize(left_keys: Table, right_keys: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -54,12 +63,27 @@ def _any_null(keys: Table) -> Optional[jnp.ndarray]:
     return m
 
 
+def _expand_rows(counts: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Enumerate counts[i] output slots per row i: returns (row_of_slot,
+    slot_within_row, cum) after the one host sync every join pays for
+    the output allocation size."""
+    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    total = int(cum[-1])  # host sync: output size
+    if total == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, cum
+    pair = jnp.arange(total, dtype=jnp.int32)
+    row = jnp.searchsorted(cum, pair, side="right").astype(jnp.int32) - 1
+    return row, pair - cum[row], cum
+
+
 def join_gather_maps(
     left_keys: Table, right_keys: Table, how: str = "inner"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(left_idx, right_idx) gather maps; right_idx == -1 marks the
-    null-extended rows of a left join."""
-    if how not in ("inner", "left"):
+    """(left_idx, right_idx) gather maps; an index of -1 marks the
+    null-extended rows of a left/full-outer join (cudf's out-of-bounds
+    sentinel discipline)."""
+    if how not in ("inner", "left", "full"):
         raise ValueError(f"unsupported join type {how!r}")
     nl, nr = left_keys.num_rows, right_keys.num_rows
     lid, rid = _factorize(left_keys, right_keys)
@@ -78,21 +102,58 @@ def join_gather_maps(
     hi = jnp.searchsorted(rid_sorted, probe_id, side="right").astype(jnp.int32)
     counts = hi - lo
 
-    if how == "left":
+    if how in ("left", "full"):
         counts = jnp.maximum(counts, 1)
 
-    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
-    total = int(cum[-1])  # host sync: output size
-    if total == 0:
-        z = jnp.zeros((0,), jnp.int32)
-        return z, z
-    pair = jnp.arange(total, dtype=jnp.int32)
-    lrow = jnp.searchsorted(cum, pair, side="right").astype(jnp.int32) - 1
-    within = pair - cum[lrow]
-    matched = (hi - lo)[lrow] > 0
+    lrow, within, _cum = _expand_rows(counts)
+    if lrow.shape[0] == 0 and how != "full":
+        return lrow, within
+    matched = (hi - lo)[lrow] > 0 if lrow.shape[0] else jnp.zeros((0,), bool)
     rpos = jnp.where(matched, lo[lrow] + within, jnp.int32(-1))
-    rrow = jnp.where(rpos >= 0, r_order[jnp.clip(rpos, 0, max(nr - 1, 0))], jnp.int32(-1))
+    if nr == 0:  # empty probe set: nothing can match
+        rrow = jnp.full(lrow.shape, -1, jnp.int32)
+    else:
+        rrow = jnp.where(rpos >= 0, r_order[jnp.clip(rpos, 0, nr - 1)], jnp.int32(-1))
+
+    if how == "full":
+        # append right rows that matched NO left row, with -1 left map.
+        # Sentinels are distinct on purpose: left null keys sit in the
+        # probe universe as -2 and right null keys as -1, so a null can
+        # never accidentally pair with a null from the other side.
+        l_sorted = jnp.sort(probe_id)
+        r_probe = rid if rnull is None else jnp.where(rnull, jnp.int32(-3), rid)
+        rlo = jnp.searchsorted(l_sorted, r_probe, side="left")
+        rhi = jnp.searchsorted(l_sorted, r_probe, side="right")
+        r_unmatched = rhi == rlo
+        urow, _, _ = _expand_rows(r_unmatched.astype(jnp.int32))
+        lrow = jnp.concatenate([lrow, jnp.full(urow.shape, -1, jnp.int32)])
+        rrow = jnp.concatenate([rrow, urow])
     return lrow, rrow
+
+
+def semi_anti_gather_map(
+    left_keys: Table, right_keys: Table, how: str = "semi"
+) -> jnp.ndarray:
+    """Left-semi / left-anti gather map over the left table (cudf
+    left_semi_join/left_anti_join surface): semi keeps left rows with at
+    least one right match, anti keeps rows with none. Null left keys
+    never match (semi drops them, anti keeps them — Spark IN / NOT
+    EXISTS plan semantics; NOT IN's null-aware variant is planned as a
+    separate filter by the engine)."""
+    if how not in ("semi", "anti"):
+        raise ValueError(f"unsupported semi/anti type {how!r}")
+    lid, rid = _factorize(left_keys, right_keys)
+    lnull = _any_null(left_keys)
+    rnull = _any_null(right_keys)
+    if rnull is not None:
+        rid = jnp.where(rnull, jnp.int32(-1), rid)
+    rid_sorted = jnp.sort(rid)
+    probe_id = lid if lnull is None else jnp.where(lnull, jnp.int32(-2), lid)
+    lo = jnp.searchsorted(rid_sorted, probe_id, side="left")
+    hi = jnp.searchsorted(rid_sorted, probe_id, side="right")
+    keep = (hi > lo) if how == "semi" else (hi == lo)
+    total = int(jnp.sum(keep))  # host sync: output size
+    return jnp.nonzero(keep, size=total)[0].astype(jnp.int32)
 
 
 def _joined_table(
@@ -121,3 +182,57 @@ def inner_join(left: Table, right: Table, on: Sequence[str]) -> Table:
 def left_join(left: Table, right: Table, on: Sequence[str]) -> Table:
     lmap, rmap = join_gather_maps(left.select(on), right.select(on), "left")
     return _joined_table(left, right, lmap, rmap, list(on), keep_right_on=False)
+
+
+def _coalesce_fixed(a: Column, b: Column, use_a: jnp.ndarray) -> Column:
+    """Row-wise COALESCE of two gathered key columns (full-join key
+    merge). Fixed-width only: string join keys in a full join are not
+    supported yet."""
+    if a.dtype.id == TypeId.STRING:
+        raise NotImplementedError("full_join with STRING keys is not supported yet")
+    n = len(a)
+    sel = use_a
+    if a.data.ndim == 2:  # DECIMAL128 limbs
+        sel = use_a[:, None]
+    data = jnp.where(sel, a.data, b.data)
+    av = a.validity if a.validity is not None else jnp.ones((n,), bool)
+    bv = b.validity if b.validity is not None else jnp.ones((n,), bool)
+    return Column(a.dtype, data=data, validity=jnp.where(use_a, av, bv))
+
+
+@op_boundary("full_join")
+def full_join(left: Table, right: Table, on: Sequence[str]) -> Table:
+    """Full outer join: every left row (null-extended right) plus every
+    unmatched right row (null-extended left, key columns coalesced from
+    the right side) — cudf full_join surface."""
+    lmap, rmap = join_gather_maps(left.select(on), right.select(on), "full")
+    use_left = lmap >= 0
+    cols: List[Column] = []
+    names: List[str] = []
+    for name, col in zip(left.names, left.columns):
+        g = gather_column(col, lmap, check_bounds=True)
+        if name in on:
+            rg = gather_column(right.column(name), rmap, check_bounds=True)
+            g = _coalesce_fixed(g, rg, use_left)
+        cols.append(g)
+        names.append(name)
+    for name, col in zip(right.names, right.columns):
+        if name in on:
+            continue
+        cols.append(gather_column(col, rmap, check_bounds=True))
+        names.append(name)
+    return Table(cols, names)
+
+
+@op_boundary("left_semi_join")
+def left_semi_join(left: Table, right: Table, on: Sequence[str]) -> Table:
+    """Left rows with at least one right match (Spark IN-subquery plan)."""
+    lmap = semi_anti_gather_map(left.select(on), right.select(on), "semi")
+    return gather(left, lmap)
+
+
+@op_boundary("left_anti_join")
+def left_anti_join(left: Table, right: Table, on: Sequence[str]) -> Table:
+    """Left rows with no right match (Spark NOT EXISTS plan)."""
+    lmap = semi_anti_gather_map(left.select(on), right.select(on), "anti")
+    return gather(left, lmap)

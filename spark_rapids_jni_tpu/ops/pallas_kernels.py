@@ -358,10 +358,13 @@ def pallas_groupby_sum_outer(
     int64-safe counts (f32 accumulator: exact below 2^24 rows/key).
 
     Returns (sums[num_keys] f32, counts[num_keys] i64); out-of-domain
-    keys are dropped. num_keys <= 65536 (VMEM lhs tile).
+    keys are dropped. num_keys <= 16384: at H = num_keys/128 the 8x
+    sublane unroll keeps ~8 [NT, 4H] bf16 lhs tiles live in VMEM, and
+    16384 (H=128 -> 4MB of lhs tiles) leaves headroom under the ~16MB
+    VMEM budget that 65536 (16MB of lhs tiles alone) does not.
     """
-    if num_keys > 65536:
-        raise ValueError("pallas_groupby_sum_outer supports num_keys <= 65536")
+    if num_keys > 16384:
+        raise ValueError("pallas_groupby_sum_outer supports num_keys <= 16384")
     return _outer_impl(keys, vals, int(num_keys), bool(interpret))
 
 

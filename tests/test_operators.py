@@ -15,7 +15,13 @@ from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.ops import copying, hashing, sort
 from spark_rapids_jni_tpu.ops.aggregate import groupby_aggregate
 from spark_rapids_jni_tpu.ops.expressions import col, lit
-from spark_rapids_jni_tpu.ops.join import inner_join, left_join
+from spark_rapids_jni_tpu.ops.join import (
+    full_join,
+    inner_join,
+    left_anti_join,
+    left_join,
+    left_semi_join,
+)
 
 
 def make_table(**cols):
@@ -244,6 +250,61 @@ def test_join_null_keys_never_match():
     out = inner_join(left, right, ["k"])
     assert out.num_rows == 1
     assert out.column("k").to_pylist() == [1]
+
+
+def test_full_join_matches_pandas():
+    lk, lv = [1, 2, 2, 3, None], [10, 20, 21, 30, 40]
+    rk, rv = [2, 2, 4, 1, None], [200, 201, 400, 100, 500]
+    left = make_table(k=(lk, dt.INT32), lv=(lv, dt.INT64))
+    right = make_table(k=(rk, dt.INT32), rv=(rv, dt.INT64))
+    out = full_join(left, right, ["k"])
+    # SQL full-outer semantics: null keys NEVER match (pandas outer
+    # merge matches NA==NA, so the null-key rows are oracled by hand)
+    df = pd.merge(
+        pd.DataFrame({"k": [k for k in lk if k is not None],
+                      "lv": [v for k, v in zip(lk, lv) if k is not None]}),
+        pd.DataFrame({"k": [k for k in rk if k is not None],
+                      "rv": [v for k, v in zip(rk, rv) if k is not None]}),
+        on="k",
+        how="outer",
+    )
+    exp_rows = [
+        (None if pd.isna(r.k) else int(r.k),
+         None if pd.isna(r.lv) else int(r.lv),
+         None if pd.isna(r.rv) else int(r.rv))
+        for r in df.itertuples()
+    ]
+    exp_rows += [(None, 40, None), (None, None, 500)]  # unmatched null keys
+    key = lambda t: tuple((x is None, x or 0) for x in t)
+    got = sorted(
+        zip(out.column("k").to_pylist(), out.column("lv").to_pylist(), out.column("rv").to_pylist()),
+        key=key,
+    )
+    assert got == sorted(exp_rows, key=key)
+
+
+def test_semi_anti_join():
+    left = make_table(k=([1, 2, 2, 3, None], dt.INT32), lv=([10, 20, 21, 30, 40], dt.INT64))
+    right = make_table(k=([2, 2, 5, None], dt.INT32), rv=([1, 2, 3, 4], dt.INT64))
+    semi = left_semi_join(left, right, ["k"])
+    # each matching left row appears ONCE despite duplicate right matches;
+    # null left keys never match
+    assert sorted(semi.column("lv").to_pylist()) == [20, 21]
+    anti = left_anti_join(left, right, ["k"])
+    # null left key has no match -> kept (NOT EXISTS semantics)
+    assert sorted(anti.column("lv").to_pylist()) == [10, 30, 40]
+
+
+def test_full_join_empty_sides():
+    left = make_table(k=([], dt.INT32), lv=([], dt.INT64))
+    right = make_table(k=([7], dt.INT32), rv=([70], dt.INT64))
+    out = full_join(left, right, ["k"])
+    assert out.column("k").to_pylist() == [7]
+    assert out.column("lv").to_pylist() == [None]
+    assert out.column("rv").to_pylist() == [70]
+    out2 = full_join(right, left, ["k"])
+    assert out2.column("k").to_pylist() == [7]
+    assert out2.column("rv").to_pylist() == [70]
 
 
 def test_join_string_keys():
