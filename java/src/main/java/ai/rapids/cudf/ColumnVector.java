@@ -1,0 +1,88 @@
+/*
+ * Owned native column (L4 tier): the `ai.rapids.cudf.ColumnVector`
+ * surface the contract classes return (reference RowConversion.java:35
+ * returns ColumnVector[]). Construction from host data goes through
+ * fromHostBuffers (Arrow-shaped host arrays); ops return handles wrapped
+ * by the package-private ctor, mirroring release_as_jlong's ownership
+ * transfer discipline (reference RowConversionJni.cpp:36).
+ */
+package ai.rapids.cudf;
+
+public final class ColumnVector extends ColumnView {
+
+  public ColumnVector(long handle) {
+    super(handle);
+  }
+
+  /**
+   * Build a fixed-width column from host buffers. {@code validity} is one
+   * byte per row (0 = null) or null for all-valid.
+   */
+  public static ColumnVector fromHostBuffers(
+      DType type, long rows, HostMemoryBuffer data, HostMemoryBuffer validity) {
+    long h =
+        createNative(
+            type.getNativeId(),
+            type.getScale(),
+            rows,
+            data == null ? 0 : data.getAddress(),
+            data == null ? 0 : data.getLength(),
+            validity == null ? 0 : validity.getAddress(),
+            0,
+            0,
+            0);
+    return new ColumnVector(h);
+  }
+
+  /**
+   * Build a STRING (or LIST&lt;INT8&gt;) column from host buffers:
+   * {@code offsets} holds rows+1 int32 entries, {@code chars} the payload.
+   */
+  public static ColumnVector fromHostStringBuffers(
+      DType type,
+      long rows,
+      HostMemoryBuffer offsets,
+      HostMemoryBuffer chars,
+      HostMemoryBuffer validity) {
+    long h =
+        createNative(
+            type.getNativeId(),
+            type.getScale(),
+            rows,
+            0,
+            0,
+            validity == null ? 0 : validity.getAddress(),
+            offsets.getAddress(),
+            chars == null ? 0 : chars.getAddress(),
+            chars == null ? 0 : chars.getLength());
+    return new ColumnVector(h);
+  }
+
+  /** Copy this column's fixed-width data into a fresh host buffer. */
+  public HostMemoryBuffer copyDataToHost() {
+    long bytes = dataBytesNative(nativeHandle);
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(bytes);
+    try {
+      copyDataNative(nativeHandle, buf.getAddress(), bytes);
+    } catch (RuntimeException | Error e) {
+      buf.close();
+      throw e;
+    }
+    return buf;
+  }
+
+  private static native long createNative(
+      int typeId,
+      int scale,
+      long rows,
+      long dataAddr,
+      long dataBytes,
+      long validityAddr,
+      long offsetsAddr,
+      long charsAddr,
+      long charsBytes);
+
+  private static native long dataBytesNative(long handle);
+
+  private static native void copyDataNative(long handle, long outAddr, long capacity);
+}

@@ -1,0 +1,58 @@
+/*
+ * Borrowed view over a native column handle (L4 tier): the
+ * `ai.rapids.cudf.ColumnView` surface the contract classes accept
+ * (reference RowConversion.java:137 takes ColumnView). The handle is an
+ * srjt column registry id (native/src/c_api.cc srjt_column_*), NOT a raw
+ * pointer — a use-after-close surfaces as a Java exception instead of a
+ * dangling dereference.
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.NativeDepsLoader;
+
+public class ColumnView implements AutoCloseable {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  protected long nativeHandle;
+
+  protected ColumnView(long handle) {
+    this.nativeHandle = handle;
+  }
+
+  public long getNativeView() {
+    return nativeHandle;
+  }
+
+  public DType getType() {
+    return DType.fromNative(typeNative(nativeHandle), scaleNative(nativeHandle));
+  }
+
+  public long getRowCount() {
+    return sizeNative(nativeHandle);
+  }
+
+  public boolean hasValidityVector() {
+    return hasValidityNative(nativeHandle);
+  }
+
+  @Override
+  public void close() {
+    if (nativeHandle != 0) {
+      closeNative(nativeHandle);
+      nativeHandle = 0;
+    }
+  }
+
+  private static native int typeNative(long handle);
+
+  private static native int scaleNative(long handle);
+
+  private static native long sizeNative(long handle);
+
+  private static native boolean hasValidityNative(long handle);
+
+  private static native void closeNative(long handle);
+}

@@ -1,0 +1,122 @@
+/*
+ * Logical column type (L4 tier, SURVEY §2.1/§2.8): the `ai.rapids.cudf.DType`
+ * surface the reference bundles from the cudf submodule (used by every
+ * contract class, e.g. reference RowConversion.java:137, CastStrings.java:35).
+ * Type ids match srjt::TypeId (native/src/columnar.h) and the Python
+ * columnar.dtype.TypeId.
+ */
+package ai.rapids.cudf;
+
+public final class DType {
+
+  public enum DTypeEnum {
+    EMPTY(0),
+    INT8(1),
+    INT16(2),
+    INT32(3),
+    INT64(4),
+    UINT8(5),
+    UINT16(6),
+    UINT32(7),
+    UINT64(8),
+    FLOAT32(9),
+    FLOAT64(10),
+    BOOL8(11),
+    TIMESTAMP_DAYS(12),
+    TIMESTAMP_SECONDS(13),
+    TIMESTAMP_MILLISECONDS(14),
+    TIMESTAMP_MICROSECONDS(15),
+    TIMESTAMP_NANOSECONDS(16),
+    STRING(23),
+    LIST(24),
+    DECIMAL32(26),
+    DECIMAL64(27),
+    DECIMAL128(28);
+
+    final int nativeId;
+
+    DTypeEnum(int nativeId) {
+      this.nativeId = nativeId;
+    }
+
+    public int getNativeId() {
+      return nativeId;
+    }
+  }
+
+  public static final DType INT8 = new DType(DTypeEnum.INT8, 0);
+  public static final DType INT16 = new DType(DTypeEnum.INT16, 0);
+  public static final DType INT32 = new DType(DTypeEnum.INT32, 0);
+  public static final DType INT64 = new DType(DTypeEnum.INT64, 0);
+  public static final DType UINT8 = new DType(DTypeEnum.UINT8, 0);
+  public static final DType UINT16 = new DType(DTypeEnum.UINT16, 0);
+  public static final DType UINT32 = new DType(DTypeEnum.UINT32, 0);
+  public static final DType UINT64 = new DType(DTypeEnum.UINT64, 0);
+  public static final DType FLOAT32 = new DType(DTypeEnum.FLOAT32, 0);
+  public static final DType FLOAT64 = new DType(DTypeEnum.FLOAT64, 0);
+  public static final DType BOOL8 = new DType(DTypeEnum.BOOL8, 0);
+  public static final DType STRING = new DType(DTypeEnum.STRING, 0);
+  public static final DType LIST = new DType(DTypeEnum.LIST, 0);
+
+  private final DTypeEnum id;
+  private final int scale;
+
+  private DType(DTypeEnum id, int scale) {
+    this.id = id;
+    this.scale = scale;
+  }
+
+  public static DType create(DTypeEnum id) {
+    return new DType(id, 0);
+  }
+
+  /** Decimal factory: scale follows the cudf convention (negative =
+   * digits right of the decimal point). */
+  public static DType create(DTypeEnum id, int scale) {
+    return new DType(id, scale);
+  }
+
+  public static DType fromNative(int nativeId, int scale) {
+    for (DTypeEnum e : DTypeEnum.values()) {
+      if (e.nativeId == nativeId) {
+        return new DType(e, scale);
+      }
+    }
+    throw new IllegalArgumentException("unknown native type id " + nativeId);
+  }
+
+  public DTypeEnum getTypeId() {
+    return id;
+  }
+
+  public int getNativeId() {
+    return id.nativeId;
+  }
+
+  public int getScale() {
+    return scale;
+  }
+
+  public boolean isDecimalType() {
+    return id == DTypeEnum.DECIMAL32 || id == DTypeEnum.DECIMAL64 || id == DTypeEnum.DECIMAL128;
+  }
+
+  @Override
+  public boolean equals(Object o) {
+    if (!(o instanceof DType)) {
+      return false;
+    }
+    DType d = (DType) o;
+    return d.id == id && d.scale == scale;
+  }
+
+  @Override
+  public int hashCode() {
+    return id.nativeId * 31 + scale;
+  }
+
+  @Override
+  public String toString() {
+    return id + (isDecimalType() ? "(scale=" + scale + ")" : "");
+  }
+}

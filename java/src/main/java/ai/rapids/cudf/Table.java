@@ -1,0 +1,65 @@
+/*
+ * Owned native table (L4 tier): the `ai.rapids.cudf.Table` surface the
+ * contract classes accept and return (reference RowConversion.java:35,
+ * DecimalUtils.java:35-38). The native table snapshots its input
+ * columns, so the caller keeps ownership of the ColumnVectors it passed.
+ */
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.NativeDepsLoader;
+
+public final class Table implements AutoCloseable {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long nativeHandle;
+
+  public Table(long handle) {
+    this.nativeHandle = handle;
+  }
+
+  public Table(ColumnVector... columns) {
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getNativeView();
+    }
+    this.nativeHandle = createNative(handles);
+  }
+
+  public long getNativeView() {
+    return nativeHandle;
+  }
+
+  public long getRowCount() {
+    return numRowsNative(nativeHandle);
+  }
+
+  public int getNumberOfColumns() {
+    return numColumnsNative(nativeHandle);
+  }
+
+  /** A fresh owned copy of column {@code i}; caller closes it. */
+  public ColumnVector getColumn(int i) {
+    return new ColumnVector(columnNative(nativeHandle, i));
+  }
+
+  @Override
+  public void close() {
+    if (nativeHandle != 0) {
+      closeNative(nativeHandle);
+      nativeHandle = 0;
+    }
+  }
+
+  private static native long createNative(long[] columnHandles);
+
+  private static native long numRowsNative(long handle);
+
+  private static native int numColumnsNative(long handle);
+
+  private static native long columnNative(long handle, int i);
+
+  private static native void closeNative(long handle);
+}

@@ -1,0 +1,26 @@
+/*
+ * Java API contract (L4 tier): ANSI cast failure carrying the first
+ * failing row index and the offending string. Mirror of reference
+ * CastException.java:25-39.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public class CastException extends RuntimeException {
+
+  private final int rowWithError;
+  private final String stringWithError;
+
+  public CastException(String stringWithError, int rowWithError) {
+    super("Error casting data on row " + rowWithError + ": " + stringWithError);
+    this.rowWithError = rowWithError;
+    this.stringWithError = stringWithError;
+  }
+
+  public int getRowWithError() {
+    return rowWithError;
+  }
+
+  public String getStringWithError() {
+    return stringWithError;
+  }
+}

@@ -1,0 +1,27 @@
+/*
+ * Java API contract (L4 tier, SURVEY §2.1): Spark-semantics string
+ * casts with ANSI mode. Mirrors reference CastStrings.java
+ * (toInteger :35) over the srjt C ABI; ANSI failures surface as
+ * CastException carrying the first failing row + value
+ * (reference CastStringJni.cpp:25-44 CATCH_CAST_EXCEPTION shape,
+ * bound in native/src/jni/srjt_jni.cc).
+ */
+package com.nvidia.spark.rapids.jni;
+
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.ColumnView;
+import ai.rapids.cudf.DType;
+
+public class CastStrings {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** String column -> integral column with Spark cast semantics. */
+  public static ColumnVector toInteger(ColumnView cv, boolean ansiMode, DType type) {
+    return new ColumnVector(toIntegerNative(cv.getNativeView(), ansiMode, type.getNativeId()));
+  }
+
+  private static native long toIntegerNative(long handle, boolean ansiMode, int typeId);
+}

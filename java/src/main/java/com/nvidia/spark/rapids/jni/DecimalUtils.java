@@ -1,0 +1,35 @@
+/*
+ * Java API contract (L4 tier, SURVEY §2.1): DECIMAL128 multiply/divide
+ * with Spark-compatible rounding and a per-row overflow flag. Mirrors
+ * reference DecimalUtils.java (multiply128 :40, divide128 :55; 2-column
+ * {BOOL8 overflow, DECIMAL128 result} return :35-38) over the srjt
+ * native engine (native/src/decimal128.cc), including the SPARK-40129
+ * double-rounding bug-compatibility.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import ai.rapids.cudf.ColumnView;
+import ai.rapids.cudf.Table;
+
+public class DecimalUtils {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Multiply with overflow detection: Table{overflow: BOOL8, product:
+   * DECIMAL128 at productScale}. */
+  public static Table multiply128(ColumnView a, ColumnView b, int productScale) {
+    return new Table(multiply128Native(a.getNativeView(), b.getNativeView(), productScale));
+  }
+
+  /** Divide with overflow detection: Table{overflow: BOOL8, quotient:
+   * DECIMAL128 at quotientScale}. Division by zero sets the flag. */
+  public static Table divide128(ColumnView a, ColumnView b, int quotientScale) {
+    return new Table(divide128Native(a.getNativeView(), b.getNativeView(), quotientScale));
+  }
+
+  private static native long multiply128Native(long a, long b, int productScale);
+
+  private static native long divide128Native(long a, long b, int quotientScale);
+}

@@ -1,0 +1,56 @@
+/*
+ * Java API contract (L4 tier, SURVEY §2.1): Table <-> JCUDF row-major
+ * blobs. Mirrors the reference RowConversion.java surface
+ * (convertToRows :35, convertFromRows :137; row format doc :44-117)
+ * over the srjt C ABI columnar engine (native/src/columnar.cc) instead
+ * of the cudf CUDA kernels. The JCUDF byte layout is identical
+ * (cross-checked byte-for-byte in tests/test_native_columnar.py).
+ *
+ * Divergence: one call produces ONE row batch; batches beyond the 2 GiB
+ * size_type limit must be split by the caller (the reference splits
+ * internally, row_conversion.cu:1465-1543).
+ */
+package com.nvidia.spark.rapids.jni;
+
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.ColumnView;
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.Table;
+
+public class RowConversion {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Table -> LIST&lt;INT8&gt; row blobs (tiled general path). */
+  public static ColumnVector[] convertToRows(Table table) {
+    long handle = convertToRowsNative(table.getNativeView());
+    return new ColumnVector[] {new ColumnVector(handle)};
+  }
+
+  /** Fixed-width-optimized variant (&lt;100 columns, &lt;=1KB rows —
+   * reference RowConversion.java:115-116); same output format. */
+  public static ColumnVector[] convertToRowsFixedWidthOptimized(Table table) {
+    return convertToRows(table);
+  }
+
+  /** LIST&lt;INT8&gt; rows + schema -> Table. */
+  public static Table convertFromRows(ColumnView rows, DType... schema) {
+    int[] typeIds = new int[schema.length];
+    int[] scales = new int[schema.length];
+    for (int i = 0; i < schema.length; i++) {
+      typeIds[i] = schema[i].getNativeId();
+      scales[i] = schema[i].getScale();
+    }
+    return new Table(convertFromRowsNative(rows.getNativeView(), typeIds, scales));
+  }
+
+  public static Table convertFromRowsFixedWidthOptimized(ColumnView rows, DType... schema) {
+    return convertFromRows(rows, schema);
+  }
+
+  private static native long convertToRowsNative(long tableHandle);
+
+  private static native long convertFromRowsNative(long rowsHandle, int[] typeIds, int[] scales);
+}

@@ -1,0 +1,36 @@
+/*
+ * Java API contract (L4 tier, SURVEY §2.1): DeltaLake-compatible
+ * interleaveBits for Z-order clustering. Mirrors reference ZOrder.java
+ * (:41, empty-input corner case handled Java-side :42-47) over the srjt
+ * native engine (native/src/columnar.cc interleave_bits).
+ */
+package com.nvidia.spark.rapids.jni;
+
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.HostMemoryBuffer;
+import ai.rapids.cudf.Table;
+
+public class ZOrder {
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static ColumnVector interleaveBits(int numRows, ColumnVector... columns) {
+    if (columns.length == 0) {
+      // reference handles the no-columns corner case Java-side
+      // (ZOrder.java:42-47): numRows empty lists
+      byte[] zeros = new byte[(numRows + 1) * 4];
+      try (HostMemoryBuffer offsets = HostMemoryBuffer.allocate(zeros.length)) {
+        offsets.setBytes(0, zeros, 0, zeros.length);
+        return ColumnVector.fromHostStringBuffers(DType.LIST, numRows, offsets, null, null);
+      }
+    }
+    try (Table t = new Table(columns)) {
+      return new ColumnVector(interleaveBitsNative(t.getNativeView()));
+    }
+  }
+
+  private static native long interleaveBitsNative(long tableHandle);
+}

@@ -166,3 +166,289 @@ SRJT_EXPORT int32_t srjt_snappy_uncompress(const uint8_t* src, int64_t src_len, 
       },
       -1));
 }
+
+// -- columnar engine ---------------------------------------------------------
+//
+// Column/table handle construction from host buffers + the executable
+// operator contract (RowConversion / CastStrings / ZOrder shapes). The
+// validity argument is one byte per row (0 = null); pass nullptr for an
+// all-valid column. A CastError in ANSI mode is reported as handle 0
+// with srjt_last_cast_row()/srjt_last_cast_string() populated — the
+// CATCH_CAST_EXCEPTION shape (reference CastStringJni.cpp:25-44).
+
+#include "columnar.h"
+
+namespace {
+
+// Column handles hold shared_ptr so tables can alias columns (and
+// srjt_table_column can hand out views) without O(bytes) deep copies.
+using ColumnRef = std::shared_ptr<srjt::NativeColumn>;
+
+srjt::HandleRegistry<ColumnRef>& columns() {
+  static srjt::HandleRegistry<ColumnRef> r;
+  return r;
+}
+
+int64_t put_column(std::shared_ptr<srjt::NativeColumn> c) {
+  return columns().put(std::make_unique<ColumnRef>(std::move(c)));
+}
+
+srjt::HandleRegistry<srjt::NativeTable>& tables() {
+  static srjt::HandleRegistry<srjt::NativeTable> r;
+  return r;
+}
+
+thread_local int64_t g_cast_error_row = -1;
+thread_local std::string g_cast_error_value;
+thread_local bool g_cast_error_pending = false;
+
+srjt::NativeColumn& col_ref(int64_t h) {
+  ColumnRef* c = columns().get(h);
+  if (c == nullptr) throw std::invalid_argument("invalid column handle");
+  return **c;
+}
+
+ColumnRef col_shared(int64_t h) {
+  ColumnRef* c = columns().get(h);
+  if (c == nullptr) throw std::invalid_argument("invalid column handle");
+  return *c;
+}
+
+srjt::NativeTable& table_ref(int64_t h) {
+  srjt::NativeTable* t = tables().get(h);
+  if (t == nullptr) throw std::invalid_argument("invalid table handle");
+  return *t;
+}
+
+template <typename F>
+int64_t guarded_cast(F&& f) {
+  g_cast_error_pending = false;
+  try {
+    return f();
+  } catch (const srjt::CastError& e) {
+    g_last_error = e.what();
+    g_cast_error_row = e.row;
+    g_cast_error_value = e.value;
+    g_cast_error_pending = true;
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return 0;
+  }
+}
+
+}  // namespace
+
+SRJT_EXPORT int64_t srjt_column_create(int32_t type_id, int32_t scale, int64_t size,
+                                       const uint8_t* data, int64_t data_bytes,
+                                       const uint8_t* validity, const int32_t* offsets,
+                                       const uint8_t* chars, int64_t chars_len) {
+  return guarded(
+      [&]() -> int64_t {
+        auto c = std::make_unique<srjt::NativeColumn>();
+        c->type = static_cast<srjt::TypeId>(type_id);
+        c->scale = scale;
+        c->size = size;
+        if (c->type == srjt::TypeId::STRING || c->type == srjt::TypeId::LIST) {
+          if (offsets == nullptr) throw std::invalid_argument("offsets required");
+          c->offsets.assign(offsets, offsets + size + 1);
+          if (chars_len > 0) c->chars.assign(chars, chars + chars_len);
+        } else {
+          int32_t w = srjt::type_size_bytes(c->type);
+          if (w == 0) throw std::invalid_argument("unsupported column type");
+          if (data_bytes != size * w) throw std::invalid_argument("data size mismatch");
+          if (data_bytes > 0) c->data.assign(data, data + data_bytes);
+        }
+        if (validity != nullptr) c->validity.assign(validity, validity + size);
+        return put_column(std::move(c));
+      },
+      0);
+}
+
+SRJT_EXPORT int32_t srjt_column_type(int64_t h) {
+  return static_cast<int32_t>(
+      guarded([&]() -> int64_t { return static_cast<int64_t>(col_ref(h).type); }, -1));
+}
+
+SRJT_EXPORT int32_t srjt_column_scale(int64_t h) {
+  return static_cast<int32_t>(
+      guarded([&]() -> int64_t { return col_ref(h).scale; }, 0));
+}
+
+SRJT_EXPORT int64_t srjt_column_size(int64_t h) {
+  return guarded([&]() -> int64_t { return col_ref(h).size; }, -1);
+}
+
+SRJT_EXPORT int64_t srjt_column_data_bytes(int64_t h) {
+  return guarded([&]() -> int64_t { return static_cast<int64_t>(col_ref(h).data.size()); },
+                 -1);
+}
+
+SRJT_EXPORT int64_t srjt_column_chars_bytes(int64_t h) {
+  return guarded([&]() -> int64_t { return static_cast<int64_t>(col_ref(h).chars.size()); },
+                 -1);
+}
+
+SRJT_EXPORT int32_t srjt_column_has_validity(int64_t h) {
+  return static_cast<int32_t>(
+      guarded([&]() -> int64_t { return col_ref(h).validity.empty() ? 0 : 1; }, -1));
+}
+
+SRJT_EXPORT int32_t srjt_column_copy_data(int64_t h, uint8_t* out, int64_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        auto& c = col_ref(h);
+        if (capacity < static_cast<int64_t>(c.data.size()))
+          throw std::invalid_argument("data buffer too small");
+        std::memcpy(out, c.data.data(), c.data.size());
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT int32_t srjt_column_copy_validity(int64_t h, uint8_t* out, int64_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        auto& c = col_ref(h);
+        if (capacity < c.size) throw std::invalid_argument("validity buffer too small");
+        if (c.validity.empty()) {
+          std::memset(out, 1, static_cast<size_t>(c.size));
+        } else {
+          std::memcpy(out, c.validity.data(), static_cast<size_t>(c.size));
+        }
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT int32_t srjt_column_copy_offsets(int64_t h, int32_t* out, int64_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        auto& c = col_ref(h);
+        if (capacity < static_cast<int64_t>(c.offsets.size()))
+          throw std::invalid_argument("offsets buffer too small");
+        std::memcpy(out, c.offsets.data(), c.offsets.size() * sizeof(int32_t));
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT int32_t srjt_column_copy_chars(int64_t h, uint8_t* out, int64_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        auto& c = col_ref(h);
+        if (capacity < static_cast<int64_t>(c.chars.size()))
+          throw std::invalid_argument("chars buffer too small");
+        std::memcpy(out, c.chars.data(), c.chars.size());
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT void srjt_column_close(int64_t h) { columns().release(h); }
+
+SRJT_EXPORT int64_t srjt_table_create(const int64_t* col_handles, int32_t ncols) {
+  return guarded(
+      [&]() -> int64_t {
+        auto t = std::make_unique<srjt::NativeTable>();
+        int64_t rows = -1;
+        for (int32_t i = 0; i < ncols; ++i) {
+          // shared, not copied: the table keeps the column alive even if
+          // the caller closes the column handle afterwards
+          ColumnRef c = col_shared(col_handles[i]);
+          if (rows < 0) {
+            rows = c->size;
+          } else if (c->size != rows) {
+            throw std::invalid_argument("table columns have mismatched row counts");
+          }
+          t->columns.push_back(std::move(c));
+        }
+        return tables().put(std::move(t));
+      },
+      0);
+}
+
+SRJT_EXPORT int32_t srjt_table_num_columns(int64_t h) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t { return static_cast<int64_t>(table_ref(h).columns.size()); }, -1));
+}
+
+SRJT_EXPORT int64_t srjt_table_num_rows(int64_t h) {
+  return guarded([&]() -> int64_t { return table_ref(h).num_rows(); }, -1);
+}
+
+SRJT_EXPORT int64_t srjt_table_column(int64_t h, int32_t i) {
+  return guarded(
+      [&]() -> int64_t {
+        auto& t = table_ref(h);
+        if (i < 0 || static_cast<size_t>(i) >= t.columns.size())
+          throw std::invalid_argument("column index out of range");
+        return put_column(t.columns[static_cast<size_t>(i)]);  // shared view
+      },
+      0);
+}
+
+SRJT_EXPORT void srjt_table_close(int64_t h) { tables().release(h); }
+
+// -- operator entries --------------------------------------------------------
+
+SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
+  return guarded(
+      [&]() -> int64_t { return put_column(srjt::convert_to_rows(table_ref(table_h))); },
+      0);
+}
+
+SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* type_ids,
+                                           const int32_t* scales, int32_t ncols) {
+  return guarded(
+      [&]() -> int64_t {
+        std::vector<srjt::TypeId> types;
+        std::vector<int32_t> scales_v;
+        for (int32_t i = 0; i < ncols; ++i) {
+          types.push_back(static_cast<srjt::TypeId>(type_ids[i]));
+          scales_v.push_back(scales == nullptr ? 0 : scales[i]);
+        }
+        return tables().put(srjt::convert_from_rows(col_ref(rows_col_h), types, scales_v));
+      },
+      0);
+}
+
+SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode,
+                                                int32_t out_type_id) {
+  return guarded_cast([&]() -> int64_t {
+    return put_column(srjt::string_to_integer(
+        col_ref(col_h), static_cast<srjt::TypeId>(out_type_id), ansi_mode != 0));
+  });
+}
+
+SRJT_EXPORT int32_t srjt_last_cast_error_pending() { return g_cast_error_pending ? 1 : 0; }
+
+SRJT_EXPORT int64_t srjt_last_cast_row() { return g_cast_error_row; }
+
+SRJT_EXPORT const char* srjt_last_cast_string() { return g_cast_error_value.c_str(); }
+
+SRJT_EXPORT int64_t srjt_zorder_interleave_bits(int64_t table_h) {
+  return guarded(
+      [&]() -> int64_t { return put_column(srjt::interleave_bits(table_ref(table_h))); },
+      0);
+}
+
+SRJT_EXPORT int64_t srjt_live_columnar_handles() {
+  return columns().live_count() + tables().live_count();
+}
+
+SRJT_EXPORT int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t product_scale) {
+  return guarded(
+      [&]() -> int64_t {
+        return tables().put(srjt::multiply_decimal128(col_ref(a_h), col_ref(b_h), product_scale));
+      },
+      0);
+}
+
+SRJT_EXPORT int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quotient_scale) {
+  return guarded(
+      [&]() -> int64_t {
+        return tables().put(srjt::divide_decimal128(col_ref(a_h), col_ref(b_h), quotient_scale));
+      },
+      0);
+}

@@ -1,0 +1,129 @@
+// Native columnar model + host execution engine (L1/L2 tier analog).
+//
+// The reference ships its columnar ops as CUDA kernels behind the
+// ai.rapids.cudf handle model (SURVEY §2.3); here the device path is
+// XLA/Pallas (Python-authored), and THIS engine provides the same
+// operator semantics natively on the host so the Java/JNI/C-ABI
+// contract is executable with no Python interpreter in the process —
+// the executor-side entry points the JVM calls (RowConversionJni.cpp,
+// CastStringJni.cpp shapes). A later round can swap these host
+// implementations for PJRT-loaded AOT XLA executables without touching
+// the ABI.
+//
+// Behavior contracts implemented (kept bit/byte-identical with the
+// Python ops, cross-checked in tests/test_native_columnar.py):
+// - JCUDF row layout (reference RowConversion.java:44-117,
+//   row_conversion.cu:1340-1378): C-struct alignment, 8-byte {off,len}
+//   string slots, validity bit col%8 of byte col/8, 8-byte row pad.
+// - string -> integer Spark semantics (cast_string.cu:46-240):
+//   whitespace set { \t\r\n}, optional sign, overflow fences,
+//   non-ANSI '.' truncation, trailing-whitespace region, ANSI
+//   first-error row + value.
+// - DeltaLake Z-order interleaveBits (zorder.cu:32-115).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace srjt {
+
+enum class TypeId : int32_t {
+  EMPTY = 0,
+  INT8 = 1,
+  INT16 = 2,
+  INT32 = 3,
+  INT64 = 4,
+  UINT8 = 5,
+  UINT16 = 6,
+  UINT32 = 7,
+  UINT64 = 8,
+  FLOAT32 = 9,
+  FLOAT64 = 10,
+  BOOL8 = 11,
+  TIMESTAMP_DAYS = 12,
+  TIMESTAMP_SECONDS = 13,
+  TIMESTAMP_MILLISECONDS = 14,
+  TIMESTAMP_MICROSECONDS = 15,
+  TIMESTAMP_NANOSECONDS = 16,
+  STRING = 23,
+  LIST = 24,
+  DECIMAL32 = 26,
+  DECIMAL64 = 27,
+  DECIMAL128 = 28,
+};
+
+int32_t type_size_bytes(TypeId t);  // 0 for variable-width
+bool type_is_fixed(TypeId t);
+bool type_is_integral(TypeId t);
+bool type_is_signed(TypeId t);
+
+struct NativeColumn {
+  TypeId type = TypeId::EMPTY;
+  int32_t scale = 0;   // decimal scale (cudf convention: negative = fraction digits)
+  int64_t size = 0;    // row count
+  std::vector<uint8_t> data;      // fixed-width values, row-contiguous
+  std::vector<uint8_t> validity;  // one byte per row (0/1); empty = all valid
+  std::vector<int32_t> offsets;   // STRING/LIST: size+1 entries
+  std::vector<uint8_t> chars;     // STRING: character bytes; LIST<INT8>: blob
+
+  bool valid_at(int64_t i) const {
+    return validity.empty() || validity[static_cast<size_t>(i)] != 0;
+  }
+  bool has_nulls() const;
+};
+
+struct NativeTable {
+  std::vector<std::shared_ptr<NativeColumn>> columns;
+  int64_t num_rows() const { return columns.empty() ? 0 : columns[0]->size; }
+};
+
+struct CastError : std::runtime_error {
+  int64_t row;
+  std::string value;
+  bool value_null;
+  CastError(int64_t r, std::string v, bool is_null)
+      : std::runtime_error("Error casting data on row " + std::to_string(r) + ": " + v),
+        row(r),
+        value(std::move(v)),
+        value_null(is_null) {}
+};
+
+// JCUDF row layout (mirrors ops/row_conversion.py compute_row_layout)
+struct RowLayout {
+  std::vector<int32_t> col_starts;
+  std::vector<int32_t> col_sizes;
+  int32_t validity_offset = 0;
+  int32_t fixed_end = 0;
+  int32_t row_size_fixed = 0;  // 8-aligned fixed row size
+  std::vector<int32_t> variable_cols;
+};
+
+RowLayout compute_row_layout(const std::vector<TypeId>& types);
+
+// Table -> one LIST<INT8> column of JCUDF rows (single batch; throws if
+// the blob would exceed the 2 GiB size_type limit).
+std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table);
+
+// LIST<INT8> rows + schema -> Table.
+std::unique_ptr<NativeTable> convert_from_rows(const NativeColumn& rows,
+                                               const std::vector<TypeId>& types,
+                                               const std::vector<int32_t>& scales);
+
+// Spark string->integer cast; throws CastError in ANSI mode.
+std::unique_ptr<NativeColumn> string_to_integer(const NativeColumn& col, TypeId out_type,
+                                                bool ansi_mode);
+
+// DeltaLake-compatible interleaveBits: LIST<UINT8> output.
+std::unique_ptr<NativeColumn> interleave_bits(const NativeTable& table);
+
+// DECIMAL128 multiply/divide with Spark-compatible rounding: returns a
+// 2-column table {BOOL8 overflow, DECIMAL128 result} (decimal128.cc).
+std::unique_ptr<NativeTable> multiply_decimal128(const NativeColumn& a, const NativeColumn& b,
+                                                 int32_t product_scale);
+std::unique_ptr<NativeTable> divide_decimal128(const NativeColumn& a, const NativeColumn& b,
+                                               int32_t quotient_scale);
+
+}  // namespace srjt

@@ -34,6 +34,32 @@ int64_t srjt_host_alloc(int64_t size, int64_t alignment);
 uint8_t* srjt_host_ptr(int64_t h);
 int64_t srjt_host_size(int64_t h);
 void srjt_host_free(int64_t h);
+// columnar engine (c_api.cc)
+int64_t srjt_column_create(int32_t type_id, int32_t scale, int64_t size, const uint8_t* data,
+                           int64_t data_bytes, const uint8_t* validity, const int32_t* offsets,
+                           const uint8_t* chars, int64_t chars_len);
+int32_t srjt_column_type(int64_t h);
+int32_t srjt_column_scale(int64_t h);
+int64_t srjt_column_size(int64_t h);
+int64_t srjt_column_data_bytes(int64_t h);
+int32_t srjt_column_has_validity(int64_t h);
+int32_t srjt_column_copy_data(int64_t h, uint8_t* out, int64_t capacity);
+void srjt_column_close(int64_t h);
+int64_t srjt_table_create(const int64_t* col_handles, int32_t ncols);
+int32_t srjt_table_num_columns(int64_t h);
+int64_t srjt_table_num_rows(int64_t h);
+int64_t srjt_table_column(int64_t h, int32_t i);
+void srjt_table_close(int64_t h);
+int64_t srjt_convert_to_rows(int64_t table_h);
+int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* type_ids,
+                               const int32_t* scales, int32_t ncols);
+int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode, int32_t out_type_id);
+int32_t srjt_last_cast_error_pending();
+int64_t srjt_last_cast_row();
+const char* srjt_last_cast_string();
+int64_t srjt_zorder_interleave_bits(int64_t table_h);
+int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t product_scale);
+int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quotient_scale);
 }
 
 namespace {
@@ -183,6 +209,180 @@ JNIEXPORT void JNICALL Java_ai_rapids_cudf_HostMemoryBuffer_getBytesNative(
     jlong len) {
   env->SetByteArrayRegion(dst, static_cast<jsize>(dst_offset), static_cast<jsize>(len),
                           reinterpret_cast<const jbyte*>(address + src_offset));
+}
+
+// --- ai.rapids.cudf.ColumnView / ColumnVector ----------------------------
+
+JNIEXPORT jint JNICALL Java_ai_rapids_cudf_ColumnView_typeNative(JNIEnv* env, jclass,
+                                                                 jlong handle) {
+  jint v = srjt_column_type(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT jint JNICALL Java_ai_rapids_cudf_ColumnView_scaleNative(JNIEnv*, jclass,
+                                                                  jlong handle) {
+  return srjt_column_scale(handle);
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnView_sizeNative(JNIEnv* env, jclass,
+                                                                  jlong handle) {
+  jlong v = srjt_column_size(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT jboolean JNICALL Java_ai_rapids_cudf_ColumnView_hasValidityNative(JNIEnv* env, jclass,
+                                                                            jlong handle) {
+  jint v = srjt_column_has_validity(handle);
+  if (v < 0) throw_last_error(env);
+  return v != 0 ? JNI_TRUE : JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnView_closeNative(JNIEnv*, jclass,
+                                                                  jlong handle) {
+  srjt_column_close(handle);
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnVector_createNative(
+    JNIEnv* env, jclass, jint type_id, jint scale, jlong rows, jlong data_addr,
+    jlong data_bytes, jlong validity_addr, jlong offsets_addr, jlong chars_addr,
+    jlong chars_bytes) {
+  int64_t h = srjt_column_create(
+      type_id, scale, rows, reinterpret_cast<const uint8_t*>(data_addr), data_bytes,
+      reinterpret_cast<const uint8_t*>(validity_addr),
+      reinterpret_cast<const int32_t*>(offsets_addr),
+      reinterpret_cast<const uint8_t*>(chars_addr), chars_bytes);
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnVector_dataBytesNative(JNIEnv* env, jclass,
+                                                                         jlong handle) {
+  jlong v = srjt_column_data_bytes(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyDataNative(
+    JNIEnv* env, jclass, jlong handle, jlong out_addr, jlong capacity) {
+  if (srjt_column_copy_data(handle, reinterpret_cast<uint8_t*>(out_addr), capacity) != 0) {
+    throw_last_error(env);
+  }
+}
+
+// --- ai.rapids.cudf.Table ------------------------------------------------
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_createNative(JNIEnv* env, jclass,
+                                                               jlongArray handles) {
+  jsize n = env->GetArrayLength(handles);
+  std::vector<int64_t> v(static_cast<size_t>(n));
+  env->GetLongArrayRegion(handles, 0, n, reinterpret_cast<jlong*>(v.data()));
+  int64_t h = srjt_table_create(v.data(), static_cast<int32_t>(n));
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_numRowsNative(JNIEnv* env, jclass,
+                                                                jlong handle) {
+  jlong v = srjt_table_num_rows(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT jint JNICALL Java_ai_rapids_cudf_Table_numColumnsNative(JNIEnv* env, jclass,
+                                                                  jlong handle) {
+  jint v = srjt_table_num_columns(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_columnNative(JNIEnv* env, jclass, jlong handle,
+                                                               jint i) {
+  int64_t h = srjt_table_column(handle, i);
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_Table_closeNative(JNIEnv*, jclass, jlong handle) {
+  srjt_table_close(handle);
+}
+
+// --- com.nvidia.spark.rapids.jni contract ops ----------------------------
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+    JNIEnv* env, jclass, jlong table_handle) {
+  int64_t h = srjt_convert_to_rows(table_handle);
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(
+    JNIEnv* env, jclass, jlong rows_handle, jintArray type_ids, jintArray scales) {
+  jsize n = env->GetArrayLength(type_ids);
+  std::vector<int32_t> ids(static_cast<size_t>(n)), sc(static_cast<size_t>(n));
+  env->GetIntArrayRegion(type_ids, 0, n, reinterpret_cast<jint*>(ids.data()));
+  env->GetIntArrayRegion(scales, 0, n, reinterpret_cast<jint*>(sc.data()));
+  int64_t h = srjt_convert_from_rows(rows_handle, ids.data(), sc.data(),
+                                     static_cast<int32_t>(n));
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_CastStrings_toIntegerNative(
+    JNIEnv* env, jclass, jlong handle, jboolean ansi_mode, jint type_id) {
+  int64_t h = srjt_cast_string_to_integer(handle, ansi_mode == JNI_TRUE ? 1 : 0, type_id);
+  if (h == 0) {
+    if (srjt_last_cast_error_pending() != 0) {
+      // CATCH_CAST_EXCEPTION shape (reference CastStringJni.cpp:25-44).
+      // The offending value is arbitrary bytes: sanitize to 7-bit ASCII
+      // before NewStringUTF (invalid modified-UTF-8 is JNI UB).
+      std::string safe = srjt_last_cast_string();
+      for (char& c : safe) {
+        if (static_cast<unsigned char>(c) > 0x7F || c == '\0') c = '?';
+      }
+      jclass ex = env->FindClass("com/nvidia/spark/rapids/jni/CastException");
+      if (ex != nullptr) {
+        jmethodID ctor = env->GetMethodID(ex, "<init>", "(Ljava/lang/String;I)V");
+        if (ctor != nullptr) {
+          jstring jstr = env->NewStringUTF(safe.c_str());
+          if (jstr != nullptr) {
+            jobject e = env->NewObject(ex, ctor, jstr,
+                                       static_cast<jint>(srjt_last_cast_row()));
+            if (e != nullptr) {
+              env->Throw(static_cast<jthrowable>(e));
+            }
+          }
+        }
+      }
+      if (env->ExceptionCheck()) {
+        return 0;  // CastException (or a JNI failure) is already pending
+      }
+    }
+    throw_last_error(env);
+  }
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_DecimalUtils_multiply128Native(
+    JNIEnv* env, jclass, jlong a, jlong b, jint product_scale) {
+  int64_t h = srjt_multiply_decimal128(a, b, product_scale);
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_DecimalUtils_divide128Native(
+    JNIEnv* env, jclass, jlong a, jlong b, jint quotient_scale) {
+  int64_t h = srjt_divide_decimal128(a, b, quotient_scale);
+  if (h == 0) throw_last_error(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_ZOrder_interleaveBitsNative(
+    JNIEnv* env, jclass, jlong table_handle) {
+  int64_t h = srjt_zorder_interleave_bits(table_handle);
+  if (h == 0) throw_last_error(env);
+  return h;
 }
 
 }  // extern "C"

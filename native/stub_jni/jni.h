@@ -43,6 +43,9 @@ using jintArray = jobject;
 using jlongArray = jobject;
 using jthrowable = jobject;
 
+class _jmethodID {};
+using jmethodID = _jmethodID*;
+
 struct JNIEnv {
   [[noreturn]] static void die() { ::abort(); }
 
@@ -59,6 +62,13 @@ struct JNIEnv {
   void GetByteArrayRegion(jbyteArray, jsize, jsize, jbyte*) { die(); }
   void SetByteArrayRegion(jbyteArray, jsize, jsize, const jbyte*) { die(); }
   void GetIntArrayRegion(jintArray, jsize, jsize, jint*) { die(); }
+  void GetLongArrayRegion(jlongArray, jsize, jsize, jlong*) { die(); }
+  jmethodID GetMethodID(jclass, const char*, const char*) { die(); }
+  jstring NewStringUTF(const char*) { die(); }
+  jobject NewObject(jclass, jmethodID, ...) { die(); }
+  jint Throw(jthrowable) { die(); }
+  jboolean ExceptionCheck() { die(); }
+  void ExceptionClear() { die(); }
 };
 
 #endif  // SRJT_STUB_JNI_H
