@@ -234,6 +234,9 @@ int64_t guarded_cast(F&& f) {
   } catch (const std::exception& e) {
     g_last_error = e.what();
     return 0;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return 0;
   }
 }
 

@@ -199,18 +199,40 @@ std::unique_ptr<NativeTable> convert_from_rows(const NativeColumn& rows,
     }
     table->columns.push_back(std::move(c));
   }
+  // Every read below is bounds-checked against the ACTUAL row extent:
+  // the blob is caller-supplied bytes (C ABI / JNI), so a short row or
+  // a garbage {off, len} slot must raise, not read out of bounds.
+  auto row_extent = [&](int64_t r) -> int64_t {
+    int64_t start = rows.offsets[static_cast<size_t>(r)];
+    int64_t end = rows.offsets[static_cast<size_t>(r) + 1];
+    if (start < 0 || end < start || end > static_cast<int64_t>(rows.chars.size())) {
+      throw std::runtime_error("corrupt row offsets in LIST<INT8> column");
+    }
+    if (end - start < layout.fixed_end) {
+      throw std::runtime_error("row shorter than the schema's fixed section");
+    }
+    return end - start;
+  };
   // two passes for strings: sizes then bytes
   for (int64_t r = 0; r < n; ++r) {
+    int64_t row_len = row_extent(r);
     const uint8_t* row = rows.chars.data() + rows.offsets[static_cast<size_t>(r)];
     for (size_t ci = 0; ci < types.size(); ++ci) {
       NativeColumn& c = *table->columns[ci];
       c.validity[static_cast<size_t>(r)] =
           (row[layout.validity_offset + ci / 8] >> (ci % 8)) & 1;
       if (types[ci] == TypeId::STRING) {
-        uint32_t len;
+        uint32_t off32, len;
+        std::memcpy(&off32, row + layout.col_starts[ci], 4);
         std::memcpy(&len, row + layout.col_starts[ci] + 4, 4);
-        c.offsets[static_cast<size_t>(r) + 1] =
-            c.offsets[static_cast<size_t>(r)] + static_cast<int32_t>(len);
+        if (static_cast<int64_t>(off32) + len > row_len) {
+          throw std::runtime_error("string slot points outside its row");
+        }
+        int64_t new_end = static_cast<int64_t>(c.offsets[static_cast<size_t>(r)]) + len;
+        if (new_end > MAX_BATCH_BYTES) {
+          throw std::runtime_error("string column exceeds 2GiB size_type limit");
+        }
+        c.offsets[static_cast<size_t>(r) + 1] = static_cast<int32_t>(new_end);
       } else {
         int32_t w = layout.col_sizes[ci];
         std::memcpy(c.data.data() + static_cast<int64_t>(r) * w,

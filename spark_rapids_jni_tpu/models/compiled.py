@@ -1,116 +1,103 @@
-"""Fused (single-XLA-program) TPC pipelines.
+"""Fused (single-XLA-program) TPC pipelines, built on the generic
+compiled-plan mechanism (spark_rapids_jni_tpu.pipeline).
 
-The operator-tier q1/q6 (models/tpch.py) compose public ops, each an
-independent dispatch — correct, but on a remote/TPU backend the per-op
-round-trips dominate. These variants trace the WHOLE query into one
-jitted program over the table's raw arrays: scan -> filter -> aggregate
-with no host sync except the final small result. This is the execution
-shape the plugin would use per ColumnarBatch (one compiled plan per
-schema), and the one the benchmarks measure.
-
-Numerical parity with the op-tier pipelines is pinned by tests.
+Round 1 hand-fused q1 and q6 with bespoke positional kernels; those are
+now ~10-line PlanSpecs lowered through ``CompiledPipeline`` — the same
+(plan, schema) -> one-XLA-program path the plugin execution model uses
+for every offloaded stage. Numerical parity with the op-tier pipelines
+(models/tpch.py) is pinned by tests.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Tuple
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Table
-from ..columnar import dtype as dt
-from ..ops import bitutils
+from ..ops.expressions import col, lit
+from ..pipeline import Agg, CompiledPipeline, GroupKey, PlanSpec, compile_plan
 from .tpch import D_1998_12_01, _D_1994_01_01, _D_1995_01_01
 
-__all__ = ["q6_fused", "q1_fused", "q6_kernel_args", "q1_kernel_args", "_q6_kernel", "_q1_kernel"]
+__all__ = ["q6_fused", "q1_fused", "q6_pipeline", "q1_pipeline"]
 
 
-def _f64(table: Table, name: str) -> jnp.ndarray:
-    return bitutils.float_view(table.column(name).data, dt.FLOAT64)
-
-
-@jax.jit
-def _q6_kernel(ship, disc, qty, price):
-    pred = (
-        (ship >= _D_1994_01_01)
-        & (ship < _D_1995_01_01)
-        & (disc >= 0.05)
-        & (disc <= 0.07)
-        & (qty < 24.0)
+def q6_pipeline() -> CompiledPipeline:
+    """TPC-H q6: filter + masked revenue sum, zero row materialization."""
+    return compile_plan(
+        PlanSpec(
+            filter=(
+                (col("l_shipdate") >= lit(np.int32(_D_1994_01_01)))
+                & (col("l_shipdate") < lit(np.int32(_D_1995_01_01)))
+                & (col("l_discount") >= lit(0.05))
+                & (col("l_discount") <= lit(0.07))
+                & (col("l_quantity") < lit(24.0))
+            ),
+            project=(("revenue", col("l_extendedprice") * col("l_discount")),),
+            aggregates=(Agg("revenue", "sum"),),
+        )
     )
-    return jnp.sum(jnp.where(pred, price * disc, 0.0))
 
 
-def q6_kernel_args(lineitem: Table) -> Tuple[jnp.ndarray, ...]:
-    """The (ship, disc, qty, price) arrays _q6_kernel consumes — the ONE
-    place the positional contract lives (benchmarks reuse it)."""
-    return (
-        lineitem.column("l_shipdate").data,
-        _f64(lineitem, "l_discount"),
-        _f64(lineitem, "l_quantity"),
-        _f64(lineitem, "l_extendedprice"),
-    )
+_Q6 = None
 
 
 def q6_fused(lineitem: Table) -> float:
-    """TPC-H q6 as one program: predicate + masked sum, no row
-    materialization at all (the filter never builds a filtered table)."""
-    return float(np.asarray(_q6_kernel(*q6_kernel_args(lineitem))))
+    global _Q6
+    if _Q6 is None:
+        _Q6 = q6_pipeline()
+    out = _Q6(lineitem)
+    return float(out.column("revenue_sum").to_pylist()[0] or 0.0)
 
 
-@partial(jax.jit, static_argnums=(7,))
-def _q1_kernel(ship, rf, ls, qty, price, disc, tax, cutoff: int):
-    keep = ship <= cutoff
-    # 3 returnflags x 2 linestatus = 6 static groups: direct-indexed
-    # segment reductions, no sort needed (the group domain is tiny and
-    # known — the plugin's dictionary-coded flags make this exact)
-    gid = jnp.where(keep, rf.astype(jnp.int32) * 2 + ls.astype(jnp.int32), 6)
-    num = 7  # 6 real + 1 trash segment for filtered rows
-
-    disc_price = price * (1.0 - disc)
-    charge = disc_price * (1.0 + tax)
-    one = jnp.ones_like(qty)
-
-    def seg(v):
-        return jax.ops.segment_sum(v, gid, num_segments=num)[:6]
-
-    qty_s, price_s, dp_s, ch_s, disc_s, n = (
-        seg(qty), seg(price), seg(disc_price), seg(charge), seg(disc), seg(one),
+def q1_pipeline(delta_days: int = 90) -> CompiledPipeline:
+    """TPC-H q1: filtered grouped sums/means over the 3x2 dictionary
+    domain of (returnflag, linestatus) — dense segments, no sort."""
+    cutoff = D_1998_12_01 - delta_days
+    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    charge = (col("l_extendedprice") * (lit(1.0) - col("l_discount"))) * (
+        lit(1.0) + col("l_tax")
     )
-    cnt = jnp.maximum(n, 1.0)
-    return qty_s, price_s, dp_s, ch_s, qty_s / cnt, price_s / cnt, disc_s / cnt, n
-
-
-def q1_kernel_args(lineitem: Table, delta_days: int = 90):
-    """The positional argument tuple _q1_kernel consumes (last element
-    is the static cutoff)."""
-    return (
-        lineitem.column("l_shipdate").data,
-        lineitem.column("l_returnflag").data,
-        lineitem.column("l_linestatus").data,
-        _f64(lineitem, "l_quantity"),
-        _f64(lineitem, "l_extendedprice"),
-        _f64(lineitem, "l_discount"),
-        _f64(lineitem, "l_tax"),
-        D_1998_12_01 - delta_days,
+    return compile_plan(
+        PlanSpec(
+            filter=col("l_shipdate") <= lit(np.int32(cutoff)),
+            project=(("disc_price", disc_price), ("charge", charge)),
+            group_by=(GroupKey("l_returnflag", 3), GroupKey("l_linestatus", 2)),
+            aggregates=(
+                Agg("l_quantity", "sum", "qty_sum"),
+                Agg("l_extendedprice", "sum", "price_sum"),
+                Agg("disc_price", "sum", "disc_price_sum"),
+                Agg("charge", "sum", "charge_sum"),
+                Agg("l_quantity", "mean", "qty_mean"),
+                Agg("l_extendedprice", "mean", "price_mean"),
+                Agg("l_discount", "mean", "disc_mean"),
+                Agg("l_quantity", "count_all", "count"),
+            ),
+        )
     )
+
+
+_Q1 = {}
 
 
 def q1_fused(lineitem: Table, delta_days: int = 90):
-    """TPC-H q1 as one program. Returns a dict of [6] arrays keyed like
-    the op-tier output (rows ordered by (returnflag, linestatus))."""
-    out = _q1_kernel(*q1_kernel_args(lineitem, delta_days))
-    qty_s, price_s, dp_s, ch_s, qty_m, price_m, disc_m, n = (np.asarray(a) for a in out)
-    return {
-        "qty_sum": qty_s,
-        "price_sum": price_s,
-        "disc_price_sum": dp_s,
-        "charge_sum": ch_s,
-        "qty_mean": qty_m,
-        "price_mean": price_m,
-        "disc_mean": disc_m,
-        "count": n.astype(np.int64),
-    }
+    """TPC-H q1 through the generic pipeline. Returns a dict of [6]
+    arrays ordered by (returnflag, linestatus), dense over the domain
+    (empty groups zero-filled), matching the round-1 contract."""
+    pipe = _Q1.get(delta_days)
+    if pipe is None:
+        pipe = _Q1[delta_days] = q1_pipeline(delta_days)
+    out = pipe(lineitem)
+    rf = np.asarray(out.column("l_returnflag").data)
+    ls = np.asarray(out.column("l_linestatus").data)
+    slot = rf * 2 + ls
+    res = {}
+    for name in (
+        "qty_sum", "price_sum", "disc_price_sum", "charge_sum",
+        "qty_mean", "price_mean", "disc_mean",
+    ):
+        dense = np.zeros(6, np.float64)
+        dense[slot] = [v or 0.0 for v in out.column(name).to_pylist()]
+        res[name] = dense
+    cnt = np.zeros(6, np.int64)
+    cnt[slot] = out.column("count").to_pylist()
+    res["count"] = cnt
+    return res

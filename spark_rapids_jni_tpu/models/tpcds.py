@@ -88,15 +88,42 @@ def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Tab
     j1 = _join_on_renamed(ss, dates_f, "ss_sold_date_sk", "d_date_sk", ["d_year"])
     j2 = _join_on_renamed(j1, item_f, "ss_item_sk", "i_item_sk", ["i_brand_id"])
 
-    keys = j2.select(["d_year", "i_brand_id"])
-    vals = j2.select(["ss_ext_sales_price"])
-    agg = groupby_aggregate(keys, vals, [("ss_ext_sales_price", "sum")])
+    # aggregation stage lowered through the generic compiled pipeline:
+    # both group columns are dictionary-coded with known bounds
+    # (d_year in [1998, 2003), i_brand_id in [0, 500))
+    agg = _q3_agg_pipeline()(j2)
+    agg = Table(
+        [
+            Column(dt.INT32, data=agg.column("year_idx").data + jnp.int32(1998)),
+            agg.column("i_brand_id"),
+            agg.column("ss_ext_sales_price_sum"),
+        ],
+        ["d_year", "i_brand_id", "ss_ext_sales_price_sum"],
+    )
     # ORDER BY d_year asc, sum desc, brand asc
     order_keys = Table(
         [agg.column("d_year"), agg.column("ss_ext_sales_price_sum"), agg.column("i_brand_id")],
         ["d_year", "s", "b"],
     )
     return sort_by_key(agg, order_keys, ascending=[True, False, True])
+
+
+_Q3_AGG = None
+
+
+def _q3_agg_pipeline():
+    global _Q3_AGG
+    if _Q3_AGG is None:
+        from ..pipeline import Agg, GroupKey, PlanSpec, compile_plan
+
+        _Q3_AGG = compile_plan(
+            PlanSpec(
+                project=(("year_idx", col("d_year") - lit(np.int32(1998))),),
+                group_by=(GroupKey("year_idx", 5), GroupKey("i_brand_id", 500)),
+                aggregates=(Agg("ss_ext_sales_price", "sum", "ss_ext_sales_price_sum"),),
+            )
+        )
+    return _Q3_AGG
 
 
 def _join_on_renamed(left: Table, right: Table, lkey: str, rkey: str, payload) -> Table:

@@ -270,3 +270,42 @@ def test_decimal128_native_null_and_divzero():
     assert got_res[0] == 0
     assert got_ovf[1] is None and got_res[1] is None  # null propagates
     assert got_ovf[2] is False
+
+
+def test_convert_from_rows_rejects_corrupt_blob():
+    import ctypes
+
+    lib = runtime.native_lib()
+    # a "row" of 4 bytes for a schema needing 13+ (INT64 + validity):
+    # must error, not read out of bounds
+    offs = np.asarray([0, 4], np.int32)
+    blob = np.zeros(4, np.uint8)
+    h = lib.srjt_column_create(
+        int(dt.LIST.id), 0, 1, None, 0, None,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 4,
+    )
+    assert h != 0
+    ids = (ctypes.c_int32 * 1)(int(dt.INT64.id))
+    scales = (ctypes.c_int32 * 1)(0)
+    out = lib.srjt_convert_from_rows(h, ids, scales, 1)
+    assert out == 0
+    assert b"shorter than" in lib.srjt_last_error()
+
+    # a string slot pointing outside its row must error too
+    row = np.zeros(16, np.uint8)
+    row[0:4] = np.frombuffer(np.uint32(9).tobytes(), np.uint8)     # offset
+    row[4:8] = np.frombuffer(np.uint32(4096).tobytes(), np.uint8)  # len: way past row end
+    row[8] |= 1  # valid
+    offs2 = np.asarray([0, 16], np.int32)
+    h2 = lib.srjt_column_create(
+        int(dt.LIST.id), 0, 1, None, 0, None,
+        offs2.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 16,
+    )
+    ids2 = (ctypes.c_int32 * 1)(int(dt.STRING.id))
+    out2 = lib.srjt_convert_from_rows(h2, ids2, scales, 1)
+    assert out2 == 0
+    assert b"outside its row" in lib.srjt_last_error()
+    lib.srjt_column_close(h)
+    lib.srjt_column_close(h2)

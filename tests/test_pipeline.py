@@ -1,0 +1,151 @@
+"""Generic compiled-pipeline tests: (plan, schema) -> one XLA program,
+pandas as the relational oracle. The TPC plans (models/compiled.py,
+models/tpcds.py q3) ride this mechanism and pin their own parity in
+test_models.py."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.expressions import col, lit
+from spark_rapids_jni_tpu.pipeline import Agg, GroupKey, PlanSpec, compile_plan
+
+
+def make_table(**cols):
+    names, columns = [], []
+    for name, (vals, d) in cols.items():
+        names.append(name)
+        columns.append(Column.from_pylist(vals, d))
+    return Table(columns, names)
+
+
+def test_grouped_filter_project_aggregate(rng):
+    n = 5000
+    k1 = rng.integers(0, 7, n).tolist()
+    k2 = rng.integers(0, 3, n).tolist()
+    x = [float(v) for v in rng.normal(size=n)]
+    y = [float(v) for v in rng.uniform(1, 2, n)]
+    t = make_table(k1=(k1, dt.INT32), k2=(k2, dt.INT32), x=(x, dt.FLOAT64), y=(y, dt.FLOAT64))
+
+    pipe = compile_plan(
+        PlanSpec(
+            filter=col("y") < lit(1.5),
+            project=(("xy", col("x") * col("y")),),
+            group_by=(GroupKey("k1", 7), GroupKey("k2", 3)),
+            aggregates=(
+                Agg("xy", "sum"),
+                Agg("x", "mean"),
+                Agg("x", "min"),
+                Agg("x", "max"),
+                Agg("x", "count"),
+            ),
+        )
+    )
+    out = pipe(t)
+
+    df = pd.DataFrame({"k1": k1, "k2": k2, "x": x, "y": y})
+    df = df[df.y < 1.5]
+    df["xy"] = df.x * df.y
+    exp = df.groupby(["k1", "k2"]).agg(
+        xy_sum=("xy", "sum"), x_mean=("x", "mean"), x_min=("x", "min"),
+        x_max=("x", "max"), x_count=("x", "count"),
+    ).reset_index().sort_values(["k1", "k2"])
+
+    got = sorted(
+        zip(
+            out.column("k1").to_pylist(), out.column("k2").to_pylist(),
+            out.column("xy_sum").to_pylist(), out.column("x_mean").to_pylist(),
+            out.column("x_min").to_pylist(), out.column("x_max").to_pylist(),
+            out.column("x_count").to_pylist(),
+        )
+    )
+    assert len(got) == len(exp)
+    for g, e in zip(got, exp.itertuples(index=False)):
+        assert g[0] == e.k1 and g[1] == e.k2
+        np.testing.assert_allclose(g[2:6], [e.xy_sum, e.x_mean, e.x_min, e.x_max], rtol=1e-9)
+        assert g[6] == e.x_count
+
+
+def test_null_values_drop_from_aggs():
+    t = make_table(k=([0, 0, 1, 1], dt.INT32), v=([1.0, None, None, None], dt.FLOAT64))
+    pipe = compile_plan(
+        PlanSpec(
+            group_by=(GroupKey("k", 2),),
+            aggregates=(Agg("v", "sum"), Agg("v", "count"), Agg("v", "count_all"), Agg("v", "min")),
+        )
+    )
+    out = pipe(t)
+    assert out.column("k").to_pylist() == [0, 1]
+    assert out.column("v_sum").to_pylist() == [1.0, None]  # all-null group -> null sum
+    assert out.column("v_count").to_pylist() == [1, 0]
+    assert out.column("v_count_all").to_pylist() == [2, 2]
+    assert out.column("v_min").to_pylist() == [1.0, None]
+
+
+def test_null_group_keys_drop_rows():
+    t = make_table(k=([0, None, 1], dt.INT32), v=([1.0, 2.0, 3.0], dt.FLOAT64))
+    pipe = compile_plan(
+        PlanSpec(group_by=(GroupKey("k", 2),), aggregates=(Agg("v", "sum"),))
+    )
+    out = pipe(t)
+    assert out.column("k").to_pylist() == [0, 1]
+    assert out.column("v_sum").to_pylist() == [1.0, 3.0]
+
+
+def test_global_aggregate():
+    t = make_table(v=([1.0, 2.0, 7.0], dt.FLOAT64), w=([1, 0, 1], dt.INT32))
+    pipe = compile_plan(
+        PlanSpec(
+            filter=col("w") == lit(np.int32(1)),
+            aggregates=(Agg("v", "sum"), Agg("v", "max"), Agg("v", "count_all")),
+        )
+    )
+    out = pipe(t)
+    assert out.num_rows == 1
+    assert out.column("v_sum").to_pylist() == [8.0]
+    assert out.column("v_max").to_pylist() == [7.0]
+    assert out.column("v_count_all").to_pylist() == [2]
+
+
+def test_empty_groups_compacted(rng):
+    # only 2 of 100 domain slots occupied: result has exactly 2 rows
+    t = make_table(k=([5, 5, 93], dt.INT32), v=([1.0, 2.0, 3.0], dt.FLOAT64))
+    pipe = compile_plan(PlanSpec(group_by=(GroupKey("k", 100),), aggregates=(Agg("v", "sum"),)))
+    out = pipe(t)
+    assert out.column("k").to_pylist() == [5, 93]
+    assert out.column("v_sum").to_pylist() == [3.0, 3.0]
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="aggregate"):
+        PlanSpec()
+    with pytest.raises(ValueError, match="unknown aggregate"):
+        PlanSpec(aggregates=(Agg("v", "median"),))
+
+
+def test_global_count_all_includes_null_values():
+    t = make_table(v=([1.0, None, 3.0], dt.FLOAT64))
+    pipe = compile_plan(PlanSpec(aggregates=(Agg("v", "count_all"), Agg("v", "count"))))
+    out = pipe(t)
+    assert out.column("v_count_all").to_pylist() == [3]
+    assert out.column("v_count").to_pylist() == [2]
+
+
+def test_grouped_minmax_keeps_infinities():
+    t = make_table(k=([0, 1], dt.INT32), v=([float("inf"), float("-inf")], dt.FLOAT64))
+    pipe = compile_plan(
+        PlanSpec(group_by=(GroupKey("k", 2),), aggregates=(Agg("v", "min"), Agg("v", "max")))
+    )
+    out = pipe(t)
+    assert out.column("v_min").to_pylist() == [float("inf"), float("-inf")]
+    assert out.column("v_max").to_pylist() == [float("inf"), float("-inf")]
+
+
+def test_out_of_domain_keys_raise():
+    t = make_table(k=([0, 7], dt.INT32), v=([1.0, 2.0], dt.FLOAT64))
+    pipe = compile_plan(PlanSpec(group_by=(GroupKey("k", 4),), aggregates=(Agg("v", "sum"),)))
+    with pytest.raises(ValueError, match="outside the declared bounded domain"):
+        pipe(t)
