@@ -88,13 +88,17 @@ def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Tab
     j1 = _join_on_renamed(ss, dates_f, "ss_sold_date_sk", "d_date_sk", ["d_year"])
     j2 = _join_on_renamed(j1, item_f, "ss_item_sk", "i_item_sk", ["i_brand_id"])
 
-    # aggregation stage lowered through the generic compiled pipeline:
-    # both group columns are dictionary-coded with known bounds
-    # (d_year in [1998, 2003), i_brand_id in [0, 500))
-    agg = _q3_agg_pipeline()(j2)
+    # aggregation stage lowered through the generic compiled pipeline;
+    # the bounded group-key domains come from the DIMENSION tables (tiny,
+    # so the host sync is cheap): d_year from date_dim, i_brand_id from
+    # item — not hard-coded, so any caller-supplied star schema works
+    year_lo = int(jnp.min(dates.column("d_year").data))
+    year_hi = int(jnp.max(dates.column("d_year").data))
+    n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
+    agg = _q3_agg_pipeline(year_lo, year_hi - year_lo + 1, n_brands)(j2)
     agg = Table(
         [
-            Column(dt.INT32, data=agg.column("year_idx").data + jnp.int32(1998)),
+            Column(dt.INT32, data=agg.column("year_idx").data + jnp.int32(year_lo)),
             agg.column("i_brand_id"),
             agg.column("ss_ext_sales_price_sum"),
         ],
@@ -108,22 +112,20 @@ def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Tab
     return sort_by_key(agg, order_keys, ascending=[True, False, True])
 
 
-_Q3_AGG = None
+import functools
 
 
-def _q3_agg_pipeline():
-    global _Q3_AGG
-    if _Q3_AGG is None:
-        from ..pipeline import Agg, GroupKey, PlanSpec, compile_plan
+@functools.lru_cache(maxsize=16)
+def _q3_agg_pipeline(year_lo: int, n_years: int, n_brands: int):
+    from ..pipeline import Agg, GroupKey, PlanSpec, compile_plan
 
-        _Q3_AGG = compile_plan(
-            PlanSpec(
-                project=(("year_idx", col("d_year") - lit(np.int32(1998))),),
-                group_by=(GroupKey("year_idx", 5), GroupKey("i_brand_id", 500)),
-                aggregates=(Agg("ss_ext_sales_price", "sum", "ss_ext_sales_price_sum"),),
-            )
+    return compile_plan(
+        PlanSpec(
+            project=(("year_idx", col("d_year") - lit(np.int32(year_lo))),),
+            group_by=(GroupKey("year_idx", n_years), GroupKey("i_brand_id", n_brands)),
+            aggregates=(Agg("ss_ext_sales_price", "sum", "ss_ext_sales_price_sum"),),
         )
-    return _Q3_AGG
+    )
 
 
 def _join_on_renamed(left: Table, right: Table, lkey: str, rkey: str, payload) -> Table:

@@ -17,7 +17,9 @@ from . import (  # noqa: F401
     hashing,
     join,
     limbs,
+    regex,
     row_conversion,
     sort,
+    utf8,
     zorder,
 )

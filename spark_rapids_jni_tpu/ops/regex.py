@@ -1,0 +1,816 @@
+"""TPU-native regex tier (cudf strings/regex replacement, SURVEY §2.8).
+
+The reference offloads Spark's RLIKE / regexp_extract / split to cudf's
+warp-per-string backtracking regex VM. A backtracking VM is the wrong
+shape for a TPU — data-dependent control flow per string kills XLA.
+This engine is compiled + table-driven instead:
+
+  host (per pattern, cached):
+    parse a regex SUBSET -> Thompson NFA -> subset-construction DFA over
+    codepoint *equivalence classes* (all class boundaries in the pattern
+    split [0, 0x110000) into a handful of intervals; a 0x110000-entry
+    int32 lookup maps codepoint -> class id).
+  device (per batch):
+    strings decode to a padded [N, L] int32 codepoint matrix
+    (ops/utf8.py), the DFA runs as ONE `lax.scan` over the L columns —
+    a [n_states * n_classes] table gather per step, no per-string
+    control flow.
+
+Three runtimes ride the same machinery:
+  - `matches_re` / `contains_re`: a single DFA run, O(N*L). Unanchored
+    search compiles the ".*pattern" DFA (the subset construction absorbs
+    the restart loop), so `contains` costs exactly one scan too.
+  - span finding (extract/split): an ALL-STARTS run — state column p
+    tracks the run anchored at codepoint p, so one scan yields every
+    (start, end) match pair. O(N*L^2) work but fully vectorized.
+  - leftmost-greedy capture groups: the pattern's top-level
+    concatenation is split into segments; a BACKWARD pass computes
+    suffix-matchability sets and a forward pass picks each segment's
+    greedy (or lazy) end consistent with the suffix — exact Java
+    semantics for top-level groups, with no backtracking.
+
+Subset: literals, '.', escapes, char classes (ranges, negation,
+\\d \\D \\w \\W \\s \\S), concatenation, alternation, groups
+(capturing / (?:...)), quantifiers * + ? {m} {m,} {m,n} with lazy '?'
+variants, anchors ^ $ at the pattern edges. Unsupported (raise
+ValueError): backreferences, lookaround, word boundaries, inline flags;
+nested or quantified capture groups cannot be *extracted* (matching
+still works). Alternation is matched longest-wins (DFA semantics), not
+PCRE ordered — documented divergence.
+
+Reference parity targets: cudf strings contains_re/matches_re/extract/
+split (SURVEY §2.8); Spark exprs RLike, RegExpExtract, StringSplit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import Column
+from ..columnar import dtype as dt
+from ..columnar.dtype import TypeId
+from ..utils.dispatch import op_boundary
+from .utf8 import MAX_CODEPOINT, decode_padded
+
+__all__ = [
+    "compile_pattern",
+    "contains_re",
+    "matches_re",
+    "extract_re",
+    "split_re",
+]
+
+_NCP = MAX_CODEPOINT + 1
+_MAX_DFA_STATES = 1024
+_MAX_REP = 64
+
+# ---------------------------------------------------------------------------
+# Parser: pattern -> AST
+# AST nodes (plain tuples):
+#   ("class", ((lo, hi), ...))       inclusive codepoint intervals
+#   ("cat", (child, ...))
+#   ("alt", (child, ...))
+#   ("rep", child, m, n, greedy)     n=None means unbounded
+#   ("group", index, child)          capturing group, 1-based index
+# ---------------------------------------------------------------------------
+
+_D = ((ord("0"), ord("9")),)
+_W = ((ord("0"), ord("9")), (ord("A"), ord("Z")), (ord("_"), ord("_")), (ord("a"), ord("z")))
+_S = tuple(sorted((ord(c), ord(c)) for c in " \t\n\r\f\v"))
+
+
+def _negate(intervals) -> Tuple[Tuple[int, int], ...]:
+    out, prev = [], 0
+    for lo, hi in sorted(intervals):
+        if lo > prev:
+            out.append((prev, lo - 1))
+        prev = max(prev, hi + 1)
+    if prev <= MAX_CODEPOINT:
+        out.append((prev, MAX_CODEPOINT))
+    return tuple(out)
+
+
+_DOT = _negate(((ord("\n"), ord("\n")),))  # '.' = any char except \n (no DOTALL)
+_ANY = ((0, MAX_CODEPOINT),)
+
+_ESCAPE_CLASSES = {
+    "d": _D,
+    "D": _negate(_D),
+    "w": _W,
+    "W": _negate(_W),
+    "s": _S,
+    "S": _negate(_S),
+}
+_ESCAPE_LITERALS = {
+    "n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v",
+    "0": "\0", "a": "\a", "b": "\b", "e": "\x1b",
+}
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.ngroups = 0
+        self.anchor_start = False
+        self.anchor_end = False
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        if self.i >= len(self.p):
+            raise ValueError(f"unexpected end of pattern /{self.p}/")
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self):
+        if self.peek() == "^":
+            self.take()
+            self.anchor_start = True
+        ast = self.alt()
+        if self.i < len(self.p):
+            raise ValueError(f"unexpected {self.p[self.i]!r} at {self.i} in /{self.p}/")
+        return ast
+
+    def alt(self):
+        branches = [self.cat()]
+        while self.peek() == "|":
+            self.take()
+            branches.append(self.cat())
+        return branches[0] if len(branches) == 1 else ("alt", tuple(branches))
+
+    def cat(self):
+        items: list = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                break
+            if c == "$":
+                if self.i == len(self.p) - 1:
+                    self.take()
+                    self.anchor_end = True
+                    break
+                raise ValueError("'$' supported only at pattern end")
+            if c == "^":
+                raise ValueError("'^' supported only at pattern start")
+            items.append(self.quantified())
+        return ("cat", tuple(items))
+
+    def quantified(self):
+        atom = self.atom()
+        c = self.peek()
+        if c in ("*", "+", "?"):
+            self.take()
+            m, n = {"*": (0, None), "+": (1, None), "?": (0, 1)}[c]
+        elif c == "{":
+            m, n = self.brace()
+        else:
+            return atom
+        greedy = True
+        if self.peek() == "?":
+            self.take()
+            greedy = False
+        if _contains_group(atom) and (m, n) != (1, 1):
+            # a quantified capture group's spans can't be recovered by
+            # the segment decomposition; matching still works with the
+            # group markers dropped (extract of that index will raise)
+            atom = _strip_groups(atom)
+        return ("rep", atom, m, n, greedy)
+
+    def brace(self):
+        self.take()  # '{'
+        start = self.i
+        while self.peek() is not None and self.peek() != "}":
+            self.take()
+        if self.peek() != "}":
+            raise ValueError("unterminated {…} quantifier")
+        body = self.p[start : self.i]
+        self.take()
+        parts = body.split(",")
+        try:
+            if len(parts) == 1:
+                m = n = int(parts[0])
+            elif len(parts) == 2:
+                m = int(parts[0])
+                n = int(parts[1]) if parts[1] else None
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(f"bad quantifier {{{body}}}") from None
+        if m < 0 or m > _MAX_REP or (n is not None and (n > _MAX_REP or n < m)):
+            raise ValueError(f"repetition bounds out of [0, {_MAX_REP}] (or n<m) in {{{body}}}")
+        return m, n
+
+    def atom(self):
+        c = self.take()
+        if c == "(":
+            capturing = True
+            if self.peek() == "?":
+                self.take()
+                nxt = self.take()
+                if nxt == ":":
+                    capturing = False
+                else:
+                    raise ValueError(f"unsupported group (?{nxt}…) — only (?:…)")
+            if capturing:
+                self.ngroups += 1
+                idx = self.ngroups
+            inner = self.alt()
+            if self.peek() != ")":
+                raise ValueError("unbalanced '('")
+            self.take()
+            return ("group", idx, inner) if capturing else inner
+        if c == "[":
+            return self.char_class()
+        if c == ".":
+            return ("class", _DOT)
+        if c == "\\":
+            return self.escape(in_class=False)
+        if c in "*+?{":
+            raise ValueError(f"dangling quantifier {c!r}")
+        return ("class", ((ord(c), ord(c)),))
+
+    def escape(self, in_class: bool):
+        if self.peek() is None:
+            raise ValueError("trailing backslash")
+        e = self.take()
+        if e in _ESCAPE_CLASSES:
+            ivs = _ESCAPE_CLASSES[e]
+            return ivs if in_class else ("class", tuple(ivs))
+        # \b is backspace inside a class, word boundary (unsupported) outside
+        if e in _ESCAPE_LITERALS and (in_class or e != "b"):
+            ch = _ESCAPE_LITERALS[e]
+            iv = ((ord(ch), ord(ch)),)
+            return iv if in_class else ("class", iv)
+        if e == "x":
+            h = self.take() + self.take()
+            iv = ((int(h, 16), int(h, 16)),)
+            return iv if in_class else ("class", iv)
+        if e == "u":
+            h = "".join(self.take() for _ in range(4))
+            iv = ((int(h, 16), int(h, 16)),)
+            return iv if in_class else ("class", iv)
+        if e.isalnum():
+            raise ValueError(f"unsupported escape \\{e}")
+        iv = ((ord(e), ord(e)),)
+        return iv if in_class else ("class", iv)
+
+    def char_class(self):
+        negated = False
+        if self.peek() == "^":
+            self.take()
+            negated = True
+        intervals: list = []
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise ValueError("unterminated character class")
+            if c == "]" and not first:
+                self.take()
+                break
+            first = False
+            self.take()
+            if c == "\\":
+                ivs = self.escape(in_class=True)
+                if len(ivs) > 1 or ivs[0][0] != ivs[0][1]:
+                    intervals.extend(ivs)
+                    continue
+                lo = ivs[0][0]
+            else:
+                lo = ord(c)
+            if self.peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
+                self.take()
+                hc = self.take()
+                if hc == "\\":
+                    ivs = self.escape(in_class=True)
+                    if len(ivs) != 1 or ivs[0][0] != ivs[0][1]:
+                        raise ValueError("bad range end in character class")
+                    hi = ivs[0][0]
+                else:
+                    hi = ord(hc)
+                if hi < lo:
+                    raise ValueError("reversed range in character class")
+                intervals.append((lo, hi))
+            else:
+                intervals.append((lo, lo))
+        ivs = tuple(sorted(intervals))
+        return ("class", _negate(ivs) if negated else ivs)
+
+
+def _contains_group(ast) -> bool:
+    if ast[0] == "group":
+        return True
+    if ast[0] in ("cat", "alt"):
+        return any(_contains_group(c) for c in ast[1])
+    if ast[0] == "rep":
+        return _contains_group(ast[1])
+    return False
+
+
+def _strip_groups(ast):
+    if ast[0] == "group":
+        return _strip_groups(ast[2])
+    if ast[0] in ("cat", "alt"):
+        return (ast[0], tuple(_strip_groups(c) for c in ast[1]))
+    if ast[0] == "rep":
+        return ("rep", _strip_groups(ast[1]), *ast[2:])
+    return ast
+
+
+# ---------------------------------------------------------------------------
+# NFA (Thompson) -> DFA (subset construction over equivalence classes)
+# ---------------------------------------------------------------------------
+
+
+class _NFA:
+    def __init__(self):
+        self.eps: List[List[int]] = []
+        self.trans: List[List[Tuple[Tuple[Tuple[int, int], ...], int]]] = []
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+    def add(self, ast) -> Tuple[int, int]:
+        kind = ast[0]
+        if kind == "class":
+            s, t = self.new_state(), self.new_state()
+            self.trans[s].append((ast[1], t))
+            return s, t
+        if kind == "group":
+            return self.add(ast[2])
+        if kind == "cat":
+            s = t = self.new_state()
+            for child in ast[1]:
+                cs, ct = self.add(child)
+                self.eps[t].append(cs)
+                t = ct
+            return s, t
+        if kind == "alt":
+            s, t = self.new_state(), self.new_state()
+            for child in ast[1]:
+                cs, ct = self.add(child)
+                self.eps[s].append(cs)
+                self.eps[ct].append(t)
+            return s, t
+        if kind == "rep":
+            _, child, m, n, _greedy = ast
+            s = t = self.new_state()
+            for _ in range(m):
+                cs, ct = self.add(child)
+                self.eps[t].append(cs)
+                t = ct
+            if n is None:
+                cs, ct = self.add(child)
+                end = self.new_state()
+                self.eps[t].append(cs)
+                self.eps[ct].append(cs)
+                self.eps[t].append(end)
+                self.eps[ct].append(end)
+                return s, end
+            tails = [t]
+            for _ in range(n - m):
+                cs, ct = self.add(child)
+                self.eps[t].append(cs)
+                t = ct
+                tails.append(t)
+            end = self.new_state()
+            for x in tails:
+                self.eps[x].append(end)
+            return s, end
+        raise AssertionError(f"unknown AST node {kind}")
+
+    def closure(self, states) -> frozenset:
+        seen = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for t in self.eps[s]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+
+class CompiledPattern:
+    """Host-side compiled DFA + lazily-uploaded device tables."""
+
+    def __init__(self, pattern, trans, accept, class_of, anchor_start,
+                 anchor_end, ast, ngroups):
+        self.pattern = pattern
+        self.trans = trans          # np [S, C] int32
+        self.accept = accept        # np [S] bool
+        self.class_of = class_of    # np [_NCP] int32
+        self.anchor_start = anchor_start
+        self.anchor_end = anchor_end
+        self.ast = ast
+        self.ngroups = ngroups
+        self._device = None
+
+    @property
+    def n_states(self) -> int:
+        return self.trans.shape[0]
+
+    @property
+    def n_classes(self) -> int:
+        return self.trans.shape[1]
+
+    def device_tables(self):
+        if self._device is None:
+            self._device = (
+                jnp.asarray(self.trans.reshape(-1)),
+                jnp.asarray(self.accept),
+                jnp.asarray(self.class_of),
+            )
+        return self._device
+
+
+def _compile_ast(ast, anchor_start=False, anchor_end=False, pattern="", ngroups=0) -> CompiledPattern:
+    # 1) codepoint equivalence classes
+    bounds = {0, _NCP}
+
+    def walk(a):
+        if a[0] == "class":
+            for lo, hi in a[1]:
+                bounds.add(lo)
+                bounds.add(hi + 1)
+        elif a[0] in ("cat", "alt"):
+            for c in a[1]:
+                walk(c)
+        elif a[0] == "rep":
+            walk(a[1])
+        elif a[0] == "group":
+            walk(a[2])
+
+    walk(ast)
+    cuts = sorted(b for b in bounds if 0 <= b <= _NCP)
+    n_classes = len(cuts) - 1
+    class_of = np.zeros(_NCP, np.int32)
+    for ci in range(n_classes):
+        class_of[cuts[ci] : cuts[ci + 1]] = ci
+    reps = np.asarray(cuts[:-1], np.int64)  # representative cp per class
+
+    # 2) NFA
+    nfa = _NFA()
+    start, accept_nfa = nfa.add(ast)
+
+    def class_mask(intervals) -> np.ndarray:
+        m = np.zeros(n_classes, bool)
+        for lo, hi in intervals:
+            m |= (reps >= lo) & (reps <= hi)
+        return m
+
+    trans_masks = [
+        [(class_mask(ivs), t) for ivs, t in nfa.trans[s]] for s in range(len(nfa.trans))
+    ]
+
+    # 3) subset construction
+    start_set = nfa.closure([start])
+    ids = {start_set: 0}
+    order = [start_set]
+    rows: List[np.ndarray] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        row = np.zeros(n_classes, np.int32)
+        for ci in range(n_classes):
+            targets = set()
+            for s in cur:
+                for mask, t in trans_masks[s]:
+                    if mask[ci]:
+                        targets.add(t)
+            nxt = nfa.closure(targets) if targets else frozenset()
+            if nxt not in ids:
+                if len(ids) >= _MAX_DFA_STATES:
+                    raise ValueError(
+                        f"pattern /{pattern}/ exceeds {_MAX_DFA_STATES} DFA states"
+                    )
+                ids[nxt] = len(ids)
+                order.append(nxt)
+            row[ci] = ids[nxt]
+        rows.append(row)
+        i += 1
+    trans = np.stack(rows)
+    accept = np.array([accept_nfa in st for st in order], bool)
+    return CompiledPattern(pattern, trans, accept, class_of, anchor_start,
+                           anchor_end, ast, ngroups)
+
+
+@functools.lru_cache(maxsize=256)
+def compile_pattern(pattern: str) -> CompiledPattern:
+    """Parse + compile the ANCHORED pattern DFA (cached per process,
+    like the plugin's cudf regex prog cache)."""
+    p = _Parser(pattern)
+    ast = p.parse()
+    return _compile_ast(ast, p.anchor_start, p.anchor_end, pattern, p.ngroups)
+
+
+@functools.lru_cache(maxsize=256)
+def _search_pattern(pattern: str) -> CompiledPattern:
+    """The ".*pattern" DFA for unanchored search: the subset
+    construction absorbs the restart loop, so `contains` is a single
+    forward run instead of an all-starts matrix."""
+    p = _Parser(pattern)
+    ast = _strip_groups(p.parse())
+    if not p.anchor_start:
+        ast = ("cat", (("rep", ("class", _ANY), 0, None, True), ast))
+    return _compile_ast(ast, p.anchor_start, p.anchor_end, pattern, 0)
+
+
+# ---------------------------------------------------------------------------
+# Device runtimes
+# ---------------------------------------------------------------------------
+
+
+def _check_string(col: Column) -> None:
+    if col.dtype.id != TypeId.STRING:
+        raise ValueError("regex op on non-string column")
+
+
+def _codepoints(col: Column):
+    from .strings import to_padded
+
+    padded, lens = to_padded(col)
+    cp, cp_lens, byte_off = decode_padded(padded, lens)
+    return cp, cp_lens, byte_off
+
+
+def _forward_run(prog: CompiledPattern, cp, cp_lens, sticky: bool):
+    """One DFA pass. sticky=False: return accept[state after the full
+    string] (full/suffix match). sticky=True: latch accept at any prefix
+    position (substring search with a ".*"-prefixed DFA)."""
+    trans_flat, accept, class_of = prog.device_tables()
+    C = prog.n_classes
+    n, L = cp.shape
+    cls = class_of[jnp.clip(cp, 0, _NCP - 1)]
+
+    def body(carry, c):
+        state, hit = carry
+        j, cls_j = c
+        nxt = trans_flat[(state * C + cls_j).astype(jnp.int32)]
+        state2 = jnp.where(j < cp_lens, nxt, state)
+        hit2 = hit | (accept[state2] & (j < cp_lens))
+        return (state2, hit2), None
+
+    init = (jnp.zeros((n,), jnp.int32), jnp.broadcast_to(accept[0], (n,)))
+    (state, hit), _ = lax.scan(
+        body, init, (jnp.arange(L, dtype=jnp.int32), cls.T)
+    )
+    return hit if sticky else accept[state]
+
+
+def _all_starts(prog: CompiledPattern, cp, cp_lens, endmask):
+    """All-starts DFA run. Returns (matched [N, L+1], first_end,
+    last_end) over start positions p in [0, L]; ends are codepoint
+    indices, -1 where no (mask-consistent) accept was seen.
+
+    endmask: optional [N, L+1] bool of permitted END positions; a '$'
+    anchor additionally restricts ends to len.
+    """
+    trans_flat, accept, class_of = prog.device_tables()
+    n, L = cp.shape
+    P = L + 1
+    C = prog.n_classes
+    cls = class_of[jnp.clip(cp, 0, _NCP - 1)]
+
+    em = endmask
+    if prog.anchor_end:
+        e = jnp.arange(P, dtype=jnp.int32)[None, :]
+        anchor = e == cp_lens[:, None]
+        em = anchor if em is None else (em & anchor)
+    if em is None:
+        em = jnp.ones((n, P), bool)
+
+    parr = jnp.arange(P, dtype=jnp.int32)[None, :]
+    start_ok = parr <= cp_lens[:, None]
+    S0 = jnp.zeros((n, P), jnp.int32)
+    acc0 = jnp.broadcast_to(jnp.asarray(bool(prog.accept[0])), (n, P)) & start_ok & em
+    first0 = jnp.where(acc0, parr, -1)
+    last0 = jnp.where(acc0, parr, -1)
+
+    def body(carry, c):
+        S, matched, first, last = carry
+        j, cls_j, em_j1 = c  # em_j1 = endmask at end position j+1, [N]
+        active = (parr <= j) & (j < cp_lens[:, None])
+        nxt = trans_flat[(S * C + cls_j[:, None]).astype(jnp.int32)]
+        S2 = jnp.where(active, nxt, S)
+        acc = accept[S2] & active & em_j1[:, None]
+        first2 = jnp.where(acc & (first < 0), j + 1, first)
+        last2 = jnp.where(acc, j + 1, last)
+        return (S2, matched | acc, first2, last2), None
+
+    (S, matched, first, last), _ = lax.scan(
+        body,
+        (S0, acc0, first0, last0),
+        (jnp.arange(L, dtype=jnp.int32), cls.T, em[:, 1:].T),
+    )
+    return matched, first, last
+
+
+@op_boundary("strings.contains_re")
+def contains_re(col: Column, pattern: str) -> Column:
+    """Spark RLIKE: true iff the pattern matches anywhere in the string."""
+    _check_string(col)
+    prog = _search_pattern(pattern)
+    cp, cp_lens, _ = _codepoints(col)
+    # with a '$' anchor the sticky latch is wrong (the match must END at
+    # len) — use the final state of the ".*pattern" run instead
+    hit = _forward_run(prog, cp, cp_lens, sticky=not prog.anchor_end)
+    return Column(dt.BOOL8, data=hit.astype(jnp.uint8), validity=col.validity)
+
+
+@op_boundary("strings.matches_re")
+def matches_re(col: Column, pattern: str) -> Column:
+    """Full-string match (cudf matches_re; Spark LIKE-via-regex path)."""
+    _check_string(col)
+    prog = compile_pattern(pattern)
+    cp, cp_lens, _ = _codepoints(col)
+    ok = _forward_run(prog, cp, cp_lens, sticky=False)
+    return Column(dt.BOOL8, data=ok.astype(jnp.uint8), validity=col.validity)
+
+
+def _top_segments(prog: CompiledPattern):
+    """Split the top-level concatenation into (ast, group_index_or_None)
+    segments for span recovery."""
+    ast = prog.ast
+    items = ast[1] if ast[0] == "cat" else (ast,)
+    segs = []
+    for it in items:
+        if it[0] == "group":
+            if _contains_group(it[2]):
+                raise ValueError("nested capture groups unsupported in extract")
+            segs.append((it[2], it[1]))
+        else:
+            if _contains_group(it):
+                raise ValueError(
+                    "capture groups must be top-level concatenation members for extract"
+                )
+            segs.append((_strip_groups(it), None))
+    return segs
+
+
+def _substr_by_cp_span(col: Column, byte_off, begin_cp, end_cp, valid) -> Column:
+    """Slice each row to the byte span of codepoints [begin, end);
+    invalid rows become '' (validity handled by the caller)."""
+    from .strings import from_padded, to_padded
+
+    padded, _lens = to_padded(col)
+    n, L = padded.shape
+    P = byte_off.shape[1]
+    b0 = jnp.take_along_axis(byte_off, jnp.clip(begin_cp, 0, P - 1)[:, None], axis=1)[:, 0]
+    b1 = jnp.take_along_axis(byte_off, jnp.clip(end_cp, 0, P - 1)[:, None], axis=1)[:, 0]
+    out_lens = jnp.where(valid, jnp.maximum(b1 - b0, 0), 0).astype(jnp.int32)
+    j = jnp.arange(L, dtype=jnp.int32)[None, :]
+    src = jnp.clip(b0[:, None] + j, 0, L - 1)
+    out = jnp.where(j < out_lens[:, None], jnp.take_along_axis(padded, src, axis=1), 0)
+    return from_padded(out, out_lens, col.validity)
+
+
+@op_boundary("strings.extract_re")
+def extract_re(col: Column, pattern: str, group: int = 1) -> Column:
+    """Spark regexp_extract(col, pattern, group): the capture group's
+    text for the LEFTMOST match; '' when the pattern does not match
+    (Spark semantics — null only for null input). group=0 = whole match.
+
+    Exact leftmost-greedy (or lazy) spans via the forward-backward
+    segment resolution; alternation inside a segment is longest-wins.
+    """
+    _check_string(col)
+    prog = compile_pattern(pattern)
+    if group < 0 or group > prog.ngroups:
+        raise IndexError(f"group {group} out of range (pattern has {prog.ngroups})")
+    cp, cp_lens, byte_off = _codepoints(col)
+    n, L = cp.shape
+    P = L + 1
+
+    segs = _top_segments(prog)
+    if group > 0 and not any(g == group for _, g in segs):
+        raise ValueError(f"group {group} is quantified/nested — spans unrecoverable")
+    seg_progs = [
+        _compile_ast(ast, anchor_end=(prog.anchor_end and i == len(segs) - 1))
+        for i, (ast, _) in enumerate(segs)
+    ]
+
+    # backward: suffix_ok[i][:, p] = segments i..k-1 can match from p;
+    # cache each segment's (first, last) consistent ends for the
+    # forward pass (same endmask, so the scans are shared).
+    e = jnp.arange(P, dtype=jnp.int32)[None, :]
+    in_range = e <= cp_lens[:, None]
+    suffix_ok: List = [None] * (len(segs) + 1)
+    suffix_ok[len(segs)] = (
+        (e == cp_lens[:, None]) if prog.anchor_end else in_range
+    )
+    ends_by_seg: List = [None] * len(segs)
+    for i in range(len(segs) - 1, -1, -1):
+        m_i, f_i, l_i = _all_starts(seg_progs[i], cp, cp_lens, endmask=suffix_ok[i + 1])
+        suffix_ok[i] = m_i & in_range
+        ends_by_seg[i] = (f_i, l_i)
+
+    # leftmost match start = first p where the whole chain can match
+    ok = suffix_ok[0]
+    if prog.anchor_start:
+        ok = ok & (e == 0)
+    has = jnp.any(ok, axis=1)
+    m_start = jnp.argmax(ok, axis=1).astype(jnp.int32)
+
+    # forward: chain greedy/lazy consistent ends
+    pos = m_start
+    spans = {}
+    for i, (ast, gi) in enumerate(segs):
+        while ast[0] == "cat" and len(ast[1]) == 1:  # unwrap 1-item groups
+            ast = ast[1][0]
+        greedy = not (ast[0] == "rep" and ast[4] is False)
+        f_i, l_i = ends_by_seg[i]
+        pick = l_i if greedy else f_i
+        nxt = jnp.take_along_axis(pick, jnp.clip(pos, 0, P - 1)[:, None], axis=1)[:, 0]
+        nxt = jnp.maximum(nxt, pos)  # -1 guard (rows with no match)
+        if gi is not None:
+            spans[gi] = (pos, nxt)
+        pos = nxt
+
+    begin, end_ = (m_start, pos) if group == 0 else spans[group]
+    return _substr_by_cp_span(col, byte_off, begin, end_, has)
+
+
+@op_boundary("strings.split_re")
+def split_re(col: Column, pattern: str, limit: int = -1) -> List[Column]:
+    """Spark split(str, regex, limit) — Java String.split semantics:
+    limit > 0: at most `limit` tokens, last token = unsplit remainder;
+    limit = -1 (Spark default): all tokens, trailing empties kept;
+    limit = 0: all tokens, trailing empties removed.
+    A zero-width separator match at position 0 is skipped (Java 8+).
+
+    Returns a cudf-split-style list of K string columns; row r's token t
+    is null for t >= that row's token count.
+    """
+    _check_string(col)
+    prog = compile_pattern(pattern)
+    cp, cp_lens, byte_off = _codepoints(col)
+    n, L = cp.shape
+    P = L + 1
+    parr = jnp.arange(P, dtype=jnp.int32)[None, :]
+
+    matched, _, last_end = _all_starts(prog, cp, cp_lens, endmask=None)
+    hit = matched & (parr <= cp_lens[:, None])
+    sep_end = jnp.maximum(last_end, parr)  # greedy end per start
+
+    # next separator-match start at/after q: suffix-min over hit starts
+    INF = jnp.int32(P + 1)
+    starts = jnp.where(hit, parr, INF)
+    nm = lax.associative_scan(jnp.minimum, starts, reverse=True, axis=1)
+    nm = jnp.concatenate([nm, jnp.full((n, 1), INF)], axis=1)  # index q may be P
+
+    K = max(min(limit if limit > 0 else L + 1, L + 1), 1)
+
+    def next_match(search):
+        ms = jnp.take_along_axis(nm, jnp.clip(search, 0, P)[:, None], axis=1)[:, 0]
+        me = jnp.take_along_axis(sep_end, jnp.clip(ms, 0, P - 1)[:, None], axis=1)[:, 0]
+        return ms, me
+
+    def body(carry, t):
+        pos, search, done = carry
+        ms, me = next_match(search)
+        # Java 8: a zero-width match at the very beginning is skipped
+        skip0 = (ms == 0) & (me <= ms) & (pos == 0)
+        ms2, me2 = next_match(jnp.where(skip0, jnp.ones_like(search), search))
+        zero_w = me2 <= ms2
+        found = (ms2 <= cp_lens) & ~done
+        is_last = jnp.asarray(t == K - 1) if limit > 0 else jnp.asarray(False)
+        take_rest = (~found) | is_last
+        tok_b = pos
+        tok_e = jnp.where(take_rest, cp_lens, ms2)
+        tok_valid = ~done
+        new_pos = jnp.where(take_rest, cp_lens, jnp.where(zero_w, ms2, me2))
+        new_search = jnp.where(take_rest, INF, jnp.where(zero_w, ms2 + 1, me2))
+        return (new_pos, new_search, done | take_rest), (tok_b, tok_e, tok_valid)
+
+    init = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32), jnp.zeros((n,), bool))
+    _, (tb, te, tv) = lax.scan(body, init, jnp.arange(K))
+    tb, te, tv = tb.T, te.T, tv.T  # [N, K]
+
+    counts = jnp.sum(tv, axis=1).astype(jnp.int32)
+    if limit == 0:
+        # drop trailing empty tokens; an empty INPUT still yields one
+        # empty token (Java "".split(x) == [""])
+        nonempty = tv & (te > tb)
+        any_ne = jnp.any(nonempty, axis=1)
+        last_ne = (K - 1 - jnp.argmax(nonempty[:, ::-1], axis=1)).astype(jnp.int32)
+        counts = jnp.where(any_ne, last_ne + 1, jnp.where(cp_lens == 0, 1, 0))
+    k_out = max(int(jnp.max(counts)) if n else 1, 1)
+
+    cols: List[Column] = []
+    for t in range(k_out):
+        valid_t = counts > t
+        out = _substr_by_cp_span(col, byte_off, tb[:, t], te[:, t], valid_t)
+        v = valid_t if col.validity is None else (valid_t & col.validity)
+        cols.append(Column(dt.STRING, validity=v, offsets=out.offsets, chars=out.chars))
+    return cols

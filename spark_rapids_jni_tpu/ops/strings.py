@@ -81,24 +81,51 @@ def length(col: Column) -> Column:
     return Column(dt.INT32, data=lens, validity=col.validity)
 
 
-def _case_map(col: Column, offset: int, lo: int, hi: int) -> Column:
+def _case_map_ascii(col: Column, offset: int, lo: int, hi: int) -> Column:
     padded, lens = to_padded(col)
     in_range = (padded >= lo) & (padded <= hi)
     out = jnp.where(in_range, padded + jnp.uint8(offset), padded)
     return from_padded(out, lens, col.validity)
 
 
+def _case_map_unicode(col: Column, to_upper: bool) -> Column:
+    """UTF-8-aware 1:1 case map over codepoints (BMP table; multi-char
+    special casings identity-mapped — same core restriction as cudf's
+    to_upper/to_lower). Re-encodes because cased pairs can change UTF-8
+    length (e.g. U+023A <-> U+2C65 is 2 vs 3 bytes)."""
+    from .utf8 import case_table, decode_padded, encode_padded
+
+    padded, lens = to_padded(col)
+    cp, cp_lens, _ = decode_padded(padded, lens)
+    tab = case_table(to_upper)
+    mapped = jnp.where(cp < 0x10000, tab[jnp.clip(cp, 0, 0xFFFF)], cp)
+    out, out_lens = encode_padded(mapped, cp_lens)
+    return from_padded(out, out_lens, col.validity)
+
+
+def _is_ascii(col: Column) -> bool:
+    if col.chars.shape[0] == 0:
+        return True
+    return bool(jnp.all(col.chars < 0x80))
+
+
 @op_boundary("strings.upper")
 def upper(col: Column) -> Column:
-    """ASCII uppercase (cudf to_upper has the same ASCII-only core)."""
+    """Spark upper(): Unicode 1:1 case map; pure-ASCII batches take the
+    branchless byte path (one data-dependent host check, same class of
+    sync as the padded-width allocation)."""
     _check_string(col)
-    return _case_map(col, -32 & 0xFF, ord("a"), ord("z"))
+    if _is_ascii(col):
+        return _case_map_ascii(col, -32 & 0xFF, ord("a"), ord("z"))
+    return _case_map_unicode(col, to_upper=True)
 
 
 @op_boundary("strings.lower")
 def lower(col: Column) -> Column:
     _check_string(col)
-    return _case_map(col, 32, ord("A"), ord("Z"))
+    if _is_ascii(col):
+        return _case_map_ascii(col, 32, ord("A"), ord("Z"))
+    return _case_map_unicode(col, to_upper=False)
 
 
 @op_boundary("strings.substring")

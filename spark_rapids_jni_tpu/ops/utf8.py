@@ -1,0 +1,157 @@
+"""Vectorized UTF-8 codec: padded byte matrices <-> codepoint matrices.
+
+The regex and Unicode-case tiers operate on CODEPOINTS (like cudf's
+regex engine, which works on code points over its char-utf8 iterators),
+not raw bytes — '.' must match one character, char classes are
+codepoint ranges, and case mapping is a codepoint relation. This module
+converts the string tier's padded [N, L] uint8 matrices (ops/strings.py
+``to_padded``) into padded [N, Lc] int32 codepoint matrices and back,
+fully vectorized (no per-string loops — the XLA formulation of the
+reference's warp-per-string byte walking).
+
+Malformed UTF-8 is tolerated garbage-in/garbage-out (continuation bytes
+without a lead decode as replacement-free salvage values), matching the
+"bytes are bytes" stance of the JCUDF transcode tier.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["decode_padded", "encode_padded", "utf8_nbytes"]
+
+MAX_CODEPOINT = 0x10FFFF
+
+
+def decode_padded(padded: jnp.ndarray, lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """[N, L] uint8 + [N] byte lengths -> (cp [N, L] int32 left-compacted,
+    cp_lens [N] int32, byte_off [N, L+1] int32).
+
+    ``cp[i, k]`` is the k-th codepoint of row i (positions >= cp_lens[i]
+    are 0). ``byte_off[i, k]`` is the byte offset where codepoint k
+    starts; entries at/after cp_lens[i] equal the row's byte length, so
+    a codepoint span [a, b) maps to the byte span
+    [byte_off[i, a], byte_off[i, b]).
+    """
+    n, L = padded.shape
+    if n == 0 or L == 0:
+        z2 = jnp.zeros((n, max(L, 1)), jnp.int32)
+        return z2, jnp.zeros((n,), jnp.int32), jnp.zeros((n, max(L, 1) + 1), jnp.int32)
+
+    b = padded.astype(jnp.int32)
+    j = jnp.arange(L, dtype=jnp.int32)[None, :]
+    inb = j < lens[:, None]
+    is_cont = (b & 0xC0) == 0x80
+    lead = inb & ~is_cont
+
+    def nxt(k):
+        src = jnp.clip(j + k, 0, L - 1)
+        return jnp.take_along_axis(b, jnp.broadcast_to(src, b.shape), axis=1) & 0x3F
+
+    b1, b2, b3 = nxt(1), nxt(2), nxt(3)
+    cp1 = b
+    cp2 = ((b & 0x1F) << 6) | b1
+    cp3 = ((b & 0x0F) << 12) | (b1 << 6) | b2
+    cp4 = ((b & 0x07) << 18) | (b1 << 12) | (b2 << 6) | b3
+    cp = jnp.where(
+        b < 0x80,
+        cp1,
+        jnp.where(b < 0xE0, cp2, jnp.where(b < 0xF0, cp3, cp4)),
+    )
+    cp = jnp.clip(cp, 0, MAX_CODEPOINT)
+
+    # Left-compact lead positions: k-th lead of row i lands in column k.
+    k_idx = jnp.cumsum(lead.astype(jnp.int32), axis=1) - 1
+    cp_lens = jnp.sum(lead, axis=1).astype(jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    dest = jnp.clip(k_idx, 0, L - 1)
+    cp_out = jnp.zeros((n, L), jnp.int32).at[
+        jnp.broadcast_to(rows, (n, L)), dest
+    ].add(jnp.where(lead, cp, 0))
+    byte_pos = jnp.zeros((n, L), jnp.int32).at[
+        jnp.broadcast_to(rows, (n, L)), dest
+    ].add(jnp.where(lead, j, 0))
+
+    # byte_off: [N, L+1]; columns >= cp_len take the row's byte length.
+    col = jnp.arange(L + 1, dtype=jnp.int32)[None, :]
+    byte_off = jnp.concatenate([byte_pos, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    byte_off = jnp.where(col >= cp_lens[:, None], lens[:, None].astype(jnp.int32), byte_off)
+    return cp_out, cp_lens, byte_off
+
+
+def utf8_nbytes(cp: jnp.ndarray) -> jnp.ndarray:
+    """Encoded length (1..4) of each codepoint."""
+    return (
+        1
+        + (cp >= 0x80).astype(jnp.int32)
+        + (cp >= 0x800).astype(jnp.int32)
+        + (cp >= 0x10000).astype(jnp.int32)
+    )
+
+
+def encode_padded(cp: jnp.ndarray, cp_lens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[N, Lc] int32 codepoints + [N] counts -> ([N, Lb] uint8, [N] byte
+    lengths). Lb is sized to the batch max (one host sync, the standard
+    output-allocation sync)."""
+    n, Lc = cp.shape
+    k = jnp.arange(Lc, dtype=jnp.int32)[None, :]
+    inb = k < cp_lens[:, None]
+    nb = jnp.where(inb, utf8_nbytes(cp), 0)
+    lens = jnp.sum(nb, axis=1).astype(jnp.int32)
+    if n == 0:
+        return jnp.zeros((0, 1), jnp.uint8), lens
+    Lb = max(int(jnp.max(lens)), 1)
+    start = jnp.cumsum(nb, axis=1) - nb  # exclusive prefix
+
+    b0 = jnp.where(
+        nb == 1,
+        cp,
+        jnp.where(
+            nb == 2,
+            0xC0 | (cp >> 6),
+            jnp.where(nb == 3, 0xE0 | (cp >> 12), 0xF0 | (cp >> 18)),
+        ),
+    )
+    b1 = jnp.where(
+        nb == 2,
+        0x80 | (cp & 0x3F),
+        jnp.where(nb == 3, 0x80 | ((cp >> 6) & 0x3F), 0x80 | ((cp >> 12) & 0x3F)),
+    )
+    b2 = jnp.where(nb == 3, 0x80 | (cp & 0x3F), 0x80 | ((cp >> 6) & 0x3F))
+    b3 = 0x80 | (cp & 0x3F)
+
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, Lc))
+    out = jnp.zeros((n, Lb), jnp.int32)
+    for t, bt in enumerate((b0, b1, b2, b3)):
+        keep = inb & (nb > t)
+        dest = jnp.clip(start + t, 0, Lb - 1)
+        out = out.at[rows, dest].add(jnp.where(keep, bt, 0))
+    return out.astype(jnp.uint8), lens
+
+
+def _build_case_table(upper: bool) -> np.ndarray:
+    """BMP 1:1 case-map table (codepoint -> codepoint). Multi-char
+    special casings (ß->SS, ...) map to identity — the cudf to_upper
+    core has the same 1:1 restriction. Supplementary-plane case pairs
+    (Deseret etc.) are identity-mapped; documented limitation."""
+    tab = np.arange(0x10000, dtype=np.int32)
+    for c in range(0x10000):
+        if 0xD800 <= c <= 0xDFFF:
+            continue
+        m = chr(c).upper() if upper else chr(c).lower()
+        if len(m) == 1 and ord(m) < 0x10000:
+            tab[c] = ord(m)
+    return tab
+
+
+_CASE_TABLES: dict = {}
+
+
+def case_table(upper: bool) -> jnp.ndarray:
+    key = bool(upper)
+    if key not in _CASE_TABLES:
+        _CASE_TABLES[key] = jnp.asarray(_build_case_table(upper))
+    return _CASE_TABLES[key]

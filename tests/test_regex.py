@@ -1,0 +1,222 @@
+"""Regex tier tests — Python `re` as the oracle.
+
+Oracle caveats (documented divergences in ops/regex.py):
+- alternation is longest-wins (DFA), not PCRE-ordered: boolean results
+  (contains/matches) always agree with `re`; extraction tests avoid
+  ambiguous ordered alternations.
+- split follows JAVA String.split (Spark's engine), which differs from
+  Python re.split only on zero-width matches and limit handling; tests
+  map Java limit -> Python maxsplit where they agree and pin the Java
+  behaviors directly where they don't.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops import regex as rx
+
+from test_strings import got_strings
+
+
+def col(vals):
+    return Column.from_pylist(vals, dt.STRING)
+
+
+def bools(c):
+    data = np.asarray(c.data).astype(bool)
+    valid = None if c.validity is None else np.asarray(c.validity)
+    return [None if valid is not None and not valid[i] else bool(data[i]) for i in range(len(data))]
+
+
+CORPUS = [
+    "hello world",
+    "",
+    "abc123def",
+    "2024-01-31",
+    "not a date",
+    "aaa",
+    "ab",
+    "xyz  tail   ",
+    "foo@bar.com",
+    "line\nbreak",
+    "ça için naïve Ünïcode",
+    "ΑΒΓ αβγ",
+    "123",
+    "a1b2c3",
+    "....",
+    "a-b-c-d",
+    None,
+]
+
+CONTAINS_PATTERNS = [
+    r"\d+",
+    r"[a-c]+",
+    r"^a",
+    r"\d$",
+    r"hello|tail",
+    r"a.c",
+    r"[^a-z ]",
+    r"(ab)+",
+    r"a{2,3}",
+    r"\s\s",
+    r"b?c",
+    r"ç",
+    r"[Α-Ω]+",
+]
+
+
+@pytest.mark.parametrize("pattern", CONTAINS_PATTERNS)
+def test_contains_re(pattern):
+    got = bools(rx.contains_re(col(CORPUS), pattern))
+    want = [None if s is None else bool(re.search(pattern, s)) for s in CORPUS]
+    assert got == want, pattern
+
+
+MATCH_PATTERNS = [
+    r"\d{4}-\d{2}-\d{2}",
+    r"[a-z ]+",
+    r".*",
+    r"a*",
+    r"(?:ab|aaa)",
+    r"\w+@\w+\.com",
+    r"a[\d-]*b.*",
+]
+
+
+@pytest.mark.parametrize("pattern", MATCH_PATTERNS)
+def test_matches_re(pattern):
+    got = bools(rx.matches_re(col(CORPUS), pattern))
+    want = [None if s is None else bool(re.fullmatch(pattern, s)) for s in CORPUS]
+    assert got == want, pattern
+
+
+EXTRACT_CASES = [
+    # (pattern, group) — chosen unambiguous under longest-wins alternation
+    (r"(\d+)", 1),
+    (r"(\d+)", 0),
+    (r"([a-z]+)(\d+)", 1),
+    (r"([a-z]+)(\d+)", 2),
+    (r"(\d{4})-(\d{2})-(\d{2})", 2),
+    (r"(\w+)@(\w+)", 2),
+    (r"a(.*)c", 1),
+    (r"a(.*?)c", 1),
+    (r"(a+)", 1),
+    (r" (\S+) ", 1),
+    (r"([^-]+)-([^-]+)", 2),
+]
+
+
+@pytest.mark.parametrize("pattern,group", EXTRACT_CASES)
+def test_extract_re(pattern, group):
+    got = got_strings(rx.extract_re(col(CORPUS), pattern, group))
+    want = []
+    for s in CORPUS:
+        if s is None:
+            want.append(None)
+            continue
+        m = re.search(pattern, s)
+        want.append(m.group(group) if m else "")  # Spark: '' on no match
+    assert got == want, (pattern, group)
+
+
+def test_extract_greedy_vs_lazy():
+    c = col(["<a><b><c>"])
+    assert got_strings(rx.extract_re(c, r"<(.*)>", 1)) == ["a><b><c"]
+    assert got_strings(rx.extract_re(c, r"<(.*?)>", 1)) == ["a"]
+
+
+def test_extract_leftmost():
+    c = col(["x12 y34"])
+    assert got_strings(rx.extract_re(c, r"(\d+)", 1)) == ["12"]
+
+
+def test_extract_rejects_nested_groups():
+    with pytest.raises(ValueError):
+        rx.extract_re(col(["ab"]), r"((a)b)", 2)
+    with pytest.raises(ValueError):
+        rx.extract_re(col(["abab"]), r"(ab)+", 1)
+
+
+def test_unsupported_constructs_raise():
+    for pat in [r"(?=x)a", r"\1", r"\bword", r"a{1000}"]:
+        with pytest.raises((ValueError, IndexError)):
+            rx.compile_pattern(pat)
+
+
+SPLIT_CASES = [
+    # (values, pattern, limit)
+    (["a,b,c", "a,b,", ",a", "", "abc", ",,", None], ",", -1),
+    (["a,b,c", "a,,b"], ",", 2),
+    (["a1b22c333d", "no digits"], r"\d+", -1),
+    (["a b  c   d", " lead", "trail "], r"\s+", -1),
+    (["a-b_c-d"], r"[-_]", -1),
+    (["2024-01-31", "x"], "-", 3),
+]
+
+
+@pytest.mark.parametrize("vals,pattern,limit", SPLIT_CASES)
+def test_split_re_vs_java_semantics(vals, pattern, limit):
+    cols = rx.split_re(col(vals), pattern, limit)
+    toks = [got_strings(c) for c in cols]
+    for i, s in enumerate(vals):
+        got = [t[i] for t in toks]
+        if s is None:
+            assert all(g is None for g in got)
+            continue
+        # Java semantics via Python re (agrees for non-zero-width seps):
+        if limit > 0:
+            want = re.split(pattern, s, maxsplit=limit - 1)
+        else:
+            want = re.split(pattern, s)
+        got_trim = [g for g in got if g is not None]
+        assert got_trim == want, (s, pattern, limit, got_trim, want)
+
+
+def test_split_limit0_drops_trailing_empties():
+    cols = rx.split_re(col(["a,b,,", "x", ""]), ",", 0)
+    toks = [got_strings(c) for c in cols]
+    rows = [[t[i] for t in toks if t[i] is not None] for i in range(3)]
+    assert rows[0] == ["a", "b"]
+    assert rows[1] == ["x"]
+    assert rows[2] == [""]  # Java: "".split(x) == [""]
+
+
+def test_split_zero_width_at_start_skipped():
+    # Java 8: "abc".split("") -> ["a", "b", "c"]
+    cols = rx.split_re(col(["abc"]), "x*", -1)
+    toks = [got_strings(c)[0] for c in cols]
+    toks = [t for t in toks if t is not None]
+    assert toks[0] != ""  # no empty leading token
+
+
+def test_unicode_patterns_on_unicode_text():
+    c = col(["ça va", "naïve", "ascii only", None])
+    got = bools(rx.contains_re(c, r"[çï]"))
+    assert got == [True, True, False, None]
+    # '.' counts CODEPOINTS, not bytes
+    got2 = bools(rx.matches_re(col(["ça"]), r"^.{2}$"))
+    assert got2 == [True]
+
+
+def test_validity_propagates():
+    c = col(["abc", None, "def"])
+    out = rx.contains_re(c, "b")
+    assert bools(out) == [True, None, False]
+
+
+def test_large_batch_smoke(rng):
+    import string
+
+    vals = [
+        "".join(rng.choice(list(string.ascii_lowercase + "0123456789 ")) for _ in range(int(rng.integers(0, 30))))
+        for _ in range(500)
+    ]
+    pattern = r"[a-f]+\d"
+    got = bools(rx.contains_re(col(vals), pattern))
+    want = [bool(re.search(pattern, s)) for s in vals]
+    assert got == want

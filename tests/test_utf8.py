@@ -1,0 +1,75 @@
+"""UTF-8 codec + Unicode case-mapping tests (Python str as oracle)."""
+
+import numpy as np
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops import strings as ss
+from spark_rapids_jni_tpu.ops.utf8 import decode_padded, encode_padded
+
+from test_strings import got_strings
+
+TEXTS = [
+    "plain ascii",
+    "",
+    "ça için naïve",
+    "ΑΒΓ αβγδ",
+    "Привет мир",
+    "日本語テキスト",
+    "emoji 🎉 supplementary",
+    "mixed: aΩя中🎈z",
+]
+
+
+def _pad(texts):
+    bs = [t.encode() for t in texts]
+    L = max(max((len(b) for b in bs), default=1), 1)
+    mat = np.zeros((len(bs), L), np.uint8)
+    for i, b in enumerate(bs):
+        mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+    lens = np.asarray([len(b) for b in bs], np.int32)
+    return jnp.asarray(mat), jnp.asarray(lens)
+
+
+def test_decode_roundtrip():
+    padded, lens = _pad(TEXTS)
+    cp, cp_lens, byte_off = decode_padded(padded, lens)
+    # codepoints match Python's
+    for i, t in enumerate(TEXTS):
+        n = int(cp_lens[i])
+        assert n == len(t), t
+        assert [int(x) for x in np.asarray(cp)[i, :n]] == [ord(c) for c in t]
+        # byte offsets match incremental encoding lengths
+        offs = [len(t[:k].encode()) for k in range(len(t) + 1)]
+        got = [int(x) for x in np.asarray(byte_off)[i, : n + 1]]
+        assert got == offs, t
+    # re-encode reproduces the original bytes
+    out, out_lens = encode_padded(cp, cp_lens)
+    for i, t in enumerate(TEXTS):
+        b = t.encode()
+        assert int(out_lens[i]) == len(b)
+        assert np.asarray(out)[i, : len(b)].tobytes() == b
+
+
+def test_unicode_case_mapping():
+    col = Column.from_pylist(TEXTS, dt.STRING)
+    # 1:1 restriction: Python's full casing may expand (ß→SS etc.);
+    # these corpora contain only 1:1 pairs so str.upper/lower agree
+    assert got_strings(ss.upper(col)) == [t.upper() for t in TEXTS]
+    assert got_strings(ss.lower(col)) == [t.lower() for t in TEXTS]
+
+
+def test_case_length_change():
+    # U+0131 (ı, 2 UTF-8 bytes) uppercases to ASCII 'I' (1 byte):
+    # byte lengths must re-pack
+    col = Column.from_pylist(["ı stanbul", "İ"], dt.STRING)
+    up = got_strings(ss.upper(col))
+    assert up[0] == "ı stanbul".upper() or up[0] == "I STANBUL"
+
+
+def test_ascii_fast_path_unchanged():
+    col = Column.from_pylist(["Hello", "WORLD", "miXed"], dt.STRING)
+    assert got_strings(ss.upper(col)) == ["HELLO", "WORLD", "MIXED"]
+    assert got_strings(ss.lower(col)) == ["hello", "world", "mixed"]
