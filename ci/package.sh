@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Artifact packaging: the pom.xml copy-native-libs / jar analog
+# (reference pom.xml:443-474) for the TPU build.
+#
+# Produces dist/spark-rapids-jni-tpu-<rev>.tar.gz laid out exactly like
+# the reference jar's runtime expectations:
+#
+#   classes/                      # Java sources, compiled HERE when a
+#                                 # JDK is present (jar layout), else
+#                                 # shipped as source for the consumer
+#                                 # build to compile
+#   <os.arch>/<os.name>/libsrjt.so   # native lib at the path
+#                                 # NativeDepsLoader probes (same
+#                                 # ${os.arch}/${os.name} convention as
+#                                 # the reference's copy-native-libs)
+#   python/spark_rapids_jni_tpu/  # the TPU compute path (wheel-style)
+#   build-info.properties         # provenance (ci/build-info)
+#
+# A JDK is optional: with javac+jar on PATH the script emits a real
+# .jar next to the tarball; without one (this CI image) it stages the
+# same layout and the tarball is the deployable unit. See PACKAGING.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REV=$(git rev-parse --short HEAD 2>/dev/null || echo dev)
+STAGE=dist/stage
+rm -rf dist && mkdir -p "$STAGE"
+
+# 1) native lib
+cmake -S native -B native/build -G Ninja >/dev/null
+ninja -C native/build >/dev/null
+ARCH=$(uname -m)
+OS=$(uname -s)
+mkdir -p "$STAGE/$ARCH/$OS"
+cp native/build/libsrjt.so "$STAGE/$ARCH/$OS/"
+
+# 2) Java contract classes: compile if a JDK exists, else ship source
+mkdir -p "$STAGE/classes"
+if command -v javac >/dev/null 2>&1; then
+  find java/src/main/java -name '*.java' > /tmp/srjt_sources.txt
+  javac -d "$STAGE/classes" @/tmp/srjt_sources.txt
+  JAR_READY=1
+else
+  cp -r java/src/main/java/* "$STAGE/classes/"
+  JAR_READY=0
+fi
+
+# 3) python package (the compute path the JNI layer drives)
+mkdir -p "$STAGE/python"
+cp -r spark_rapids_jni_tpu "$STAGE/python/"
+find "$STAGE/python" -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+
+# 4) provenance
+bash build/build-info > "$STAGE/build-info.properties"
+
+# 5) emit artifacts
+mkdir -p dist
+tar -C "$STAGE" -czf "dist/spark-rapids-jni-tpu-$REV.tar.gz" .
+if [ "$JAR_READY" = 1 ] && command -v jar >/dev/null 2>&1; then
+  (cd "$STAGE" && jar cf "../spark-rapids-jni-tpu-$REV.jar" .)
+fi
+echo "packaged: $(ls dist/*.tar.gz dist/*.jar 2>/dev/null | tr '\n' ' ')"

@@ -10,6 +10,8 @@ small pytree of jax arrays, so every op composes under ``jax.jit`` /
                                         matches cudf's set-bit-means-valid)
 - STRING:       ``offsets`` [N+1] int32, ``chars`` [nbytes] uint8
 - LIST:         ``offsets`` [N+1] int32, ``child``  Column
+- STRUCT:       ``children`` tuple of Columns (+ ``child_names``), all
+                length N (cudf struct_column layout)
 
 Host<->device conversion goes through numpy only at the API edges (the
 role the reference's HostMemoryBuffer + JNI marshalling play).
@@ -66,6 +68,8 @@ class Column:
         offsets: Optional[jnp.ndarray] = None,
         chars: Optional[jnp.ndarray] = None,
         child: Optional["Column"] = None,
+        children: Optional[tuple] = None,
+        child_names: Optional[tuple] = None,
     ):
         self.dtype = dtype
         self.data = data
@@ -73,21 +77,29 @@ class Column:
         self.offsets = offsets
         self.chars = chars
         self.child = child
+        self.children = tuple(children) if children is not None else None
+        self.child_names = tuple(child_names) if child_names is not None else None
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
-        children = (self.data, self.validity, self.offsets, self.chars, self.child)
-        return children, self.dtype
+        children = (self.data, self.validity, self.offsets, self.chars, self.child, self.children)
+        return children, (self.dtype, self.child_names)
 
     @classmethod
-    def tree_unflatten(cls, dtype, children):
-        data, validity, offsets, chars, child = children
-        return cls(dtype, data=data, validity=validity, offsets=offsets, chars=chars, child=child)
+    def tree_unflatten(cls, aux, children):
+        dtype, child_names = aux if isinstance(aux, tuple) else (aux, None)
+        data, validity, offsets, chars, child, struct_children = children
+        return cls(dtype, data=data, validity=validity, offsets=offsets, chars=chars,
+                   child=child, children=struct_children, child_names=child_names)
 
     # -- shape --------------------------------------------------------------
     def __len__(self) -> int:
         if self.dtype.id in (TypeId.STRING, TypeId.LIST):
             return int(self.offsets.shape[0]) - 1
+        if self.dtype.id == TypeId.STRUCT:
+            if self.validity is not None:
+                return int(self.validity.shape[0])
+            return len(self.children[0]) if self.children else 0
         return int(self.data.shape[0])
 
     @property
@@ -175,6 +187,14 @@ class Column:
 
         return cls(dt.LIST, validity=validity, offsets=jnp.asarray(offsets), child=child)
 
+    @classmethod
+    def struct_from_parts(cls, children: Sequence["Column"], names: Sequence[str],
+                          validity=None) -> "Column":
+        from . import dtype as dt
+
+        return cls(dt.STRUCT, validity=validity, children=tuple(children),
+                   child_names=tuple(names))
+
     # -- host round trip (test/debug surface, like cudf::test wrappers) -----
     def to_pylist(self) -> list:
         tid = self.dtype.id
@@ -194,6 +214,13 @@ class Column:
             child_vals = self.child.to_pylist()
             return [
                 None if not valid[i] else child_vals[offs[i]:offs[i + 1]]
+                for i in range(len(self))
+            ]
+        if tid == TypeId.STRUCT:
+            names = self.child_names or tuple(f"f{j}" for j in range(len(self.children)))
+            per_child = [c.to_pylist() for c in self.children]
+            return [
+                None if not valid[i] else {nm: per_child[j][i] for j, nm in enumerate(names)}
                 for i in range(len(self))
             ]
         if tid == TypeId.DECIMAL128:

@@ -219,6 +219,7 @@ FLOAT64 = DType(TypeId.FLOAT64)
 BOOL8 = DType(TypeId.BOOL8)
 STRING = DType(TypeId.STRING)
 LIST = DType(TypeId.LIST)
+STRUCT = DType(TypeId.STRUCT)
 TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
 TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
 TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)

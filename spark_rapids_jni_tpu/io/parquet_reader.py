@@ -1,22 +1,40 @@
 """Parquet data decode: column chunks -> device Columns.
 
 Replaces the capability the reference inherits from cudf's GPU parquet
-decode (SURVEY §2.8). Round-1 scope: flat schemas, PLAIN +
-PLAIN_DICTIONARY/RLE_DICTIONARY encodings, RLE/bit-packed definition
-levels, data page v1/v2, UNCOMPRESSED/SNAPPY/ZSTD/GZIP codecs
-(decompression via pyarrow's bundled codecs — the analog of the
-reference statically linking libsnappy et al).
+decode (SURVEY §2.8). Scope: nested schemas (lists / structs / maps,
+arbitrary depth), PLAIN + PLAIN_DICTIONARY/RLE_DICTIONARY encodings,
+RLE/bit-packed levels, data page v1/v2, UNCOMPRESSED/SNAPPY/ZSTD/GZIP
+codecs (snappy through the native tier when built, else pyarrow's
+bundled codecs — the analog of the reference statically linking
+libsnappy et al).
 
-Decode runs host-side in numpy and lands device-resident ``Column``s —
-the same host->device split as the reference's CPU thrift + GPU decode,
-with the device-side decode kernel left as a later optimization.
+TPU-first decode split (the cudf GPU-decode analog, reshaped for XLA):
+- **Bulk value bytes run on device.** PLAIN fixed-width pages upload
+  zero-copy and bitcast; dictionary *indices* expand on device from a
+  host-parsed run directory (the sequential varint headers are O(#runs),
+  the O(#values) bit extraction is one vectorized gather+shift); the
+  dictionary gather, null scatter, and all string character movement
+  are device gathers.
+- **Level streams (1-3 bits/value) decode host-side** via vectorized
+  numpy unpackbits: they are metadata, the nested-assembly offset math
+  consumes them on the host anyway, and at <=3 bits/value they are two
+  orders of magnitude smaller than the data they describe.
+- **Nested assembly is vectorized numpy** (Dremel record shredding
+  inverse): per-level slot selection + cumsum/searchsorted offset
+  construction — no per-row Python.
+
+Reference parity anchors: schema shapes handled here mirror the pruning
+matrix in NativeParquetJni.cpp:245-361 (lists, structs, maps,
+single-child tails); cudf reads the same shapes on GPU.
 """
 
 from __future__ import annotations
 
 import struct
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Column, Table
@@ -57,6 +75,14 @@ _CODECS = {0: None, 1: "snappy", 2: "gzip", 4: "brotli", 5: "lz4", 6: "zstd", 7:
 
 # converted types
 _C_UTF8 = 0
+_C_MAP = 1
+_C_MAP_KEY_VALUE = 2
+_C_LIST = 3
+
+# repetition
+_R_REQUIRED = 0
+_R_OPTIONAL = 1
+_R_REPEATED = 2
 
 # PageHeader field ids
 _PH_TYPE = 1
@@ -121,7 +147,8 @@ def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> by
 
 
 def _read_rle_bitpacked(data: bytes, bit_width: int, num_values: int) -> np.ndarray:
-    """Decode the RLE/bit-packed hybrid encoding into int32 values."""
+    """Host decode of the RLE/bit-packed hybrid into int32 values
+    (vectorized per run via unpackbits). Used for level streams."""
     out = np.empty(num_values, dtype=np.int32)
     pos = 0
     filled = 0
@@ -142,7 +169,6 @@ def _read_rle_bitpacked(data: bytes, bit_width: int, num_values: int) -> np.ndar
                 break
             shift += 7
         if header & 1:
-            # bit-packed run: (header >> 1) groups of 8 values
             groups = header >> 1
             count = groups * 8
             nbytes = groups * bit_width
@@ -156,7 +182,6 @@ def _read_rle_bitpacked(data: bytes, bit_width: int, num_values: int) -> np.ndar
             out[filled : filled + take] = decoded[:take]
             filled += take
         else:
-            # rle run
             count = header >> 1
             raw = data[pos : pos + byte_width]
             pos += byte_width
@@ -167,46 +192,226 @@ def _read_rle_bitpacked(data: bytes, bit_width: int, num_values: int) -> np.ndar
     return out
 
 
-def _read_plain(data: bytes, ptype: int, num: int, type_length: int = 0):
-    if ptype == _T_INT32:
-        return np.frombuffer(data, dtype=np.int32, count=num), 4 * num
-    if ptype == _T_INT64:
-        return np.frombuffer(data, dtype=np.int64, count=num), 8 * num
-    if ptype == _T_FLOAT:
-        return np.frombuffer(data, dtype=np.float32, count=num), 4 * num
-    if ptype == _T_DOUBLE:
-        return np.frombuffer(data, dtype=np.float64, count=num), 8 * num
+def _parse_rle_runs(data: bytes, bit_width: int, num_values: int):
+    """Host parse of ONLY the run directory (O(#runs), not O(#values)).
+    Returns (first, is_packed, payload): for an RLE run `payload` is the
+    literal value; for a bit-packed run it is the absolute BIT offset of
+    the run's first value inside `data`."""
+    first: List[int] = []
+    packed: List[bool] = []
+    payload: List[int] = []
+    pos = 0
+    filled = 0
+    if bit_width == 0:
+        return (np.asarray([0], np.int64), np.asarray([False]), np.asarray([0], np.int64))
+    byte_width = (bit_width + 7) // 8
+    while filled < num_values:
+        header = 0
+        shift = 0
+        while True:
+            if pos >= len(data):
+                raise ParquetReadError("rle: truncated varint")
+            b = data[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        if header & 1:
+            groups = header >> 1
+            count = groups * 8
+            first.append(filled)
+            packed.append(True)
+            payload.append(pos * 8)
+            pos += groups * bit_width
+        else:
+            count = header >> 1
+            first.append(filled)
+            packed.append(False)
+            payload.append(int.from_bytes(data[pos : pos + byte_width], "little"))
+            pos += byte_width
+        filled += count
+    return (
+        np.asarray(first, np.int64),
+        np.asarray(packed, bool),
+        np.asarray(payload, np.int64),
+    )
+
+
+def _rle_expand_device(data: bytes, bit_width: int, num_values: int) -> jnp.ndarray:
+    """Device expansion of an RLE/bit-packed stream: one searchsorted to
+    map value index -> run, one 5-byte window gather + shift for packed
+    runs. All O(num_values) work is vectorized device code."""
+    first, packed, payload = _parse_rle_runs(data, bit_width, num_values)
+    buf = np.frombuffer(data, np.uint8)
+    buf = np.concatenate([buf, np.zeros(8, np.uint8)])  # window slack
+    b = jnp.asarray(buf).astype(jnp.int64)
+    first_d = jnp.asarray(first)
+    packed_d = jnp.asarray(packed)
+    payload_d = jnp.asarray(payload)
+
+    i = jnp.arange(num_values, dtype=jnp.int64)
+    run_of = jnp.searchsorted(first_d, i, side="right") - 1
+    k = i - first_d[run_of]
+    bitpos = payload_d[run_of] + k * bit_width
+    byte0 = bitpos >> 3
+    w = (
+        b[byte0]
+        | (b[byte0 + 1] << 8)
+        | (b[byte0 + 2] << 16)
+        | (b[byte0 + 3] << 24)
+        | (b[byte0 + 4] << 32)
+    )
+    val_packed = (w >> (bitpos & 7)) & ((1 << bit_width) - 1)
+    return jnp.where(packed_d[run_of], val_packed, payload_d[run_of]).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# byte-array (string) helpers
+# ---------------------------------------------------------------------------
+
+
+def _byte_array_lens(page: bytes) -> np.ndarray:
+    """Walk a PLAIN BYTE_ARRAY page: [u32 len][bytes]... -> lengths.
+    Sequential by nature; the native tier does the walk in C when built."""
+    from .. import runtime
+
+    if runtime.native_available() and hasattr(runtime, "byte_array_lens"):
+        return runtime.byte_array_lens(page)
+    lens: List[int] = []
+    pos = 0
+    n = len(page)
+    while pos + 4 <= n:
+        (ln,) = struct.unpack_from("<I", page, pos)
+        if pos + 4 + ln > n:
+            break
+        lens.append(ln)
+        pos += 4 + ln
+    return np.asarray(lens, np.int32)
+
+
+def _byte_array_chars_device(page: bytes, lens: np.ndarray) -> jnp.ndarray:
+    """Strip the u32 length prefixes on device: ragged gather from the
+    uploaded page buffer."""
+    from ..ops.bitutils import ragged_positions
+
+    starts = np.zeros(len(lens), np.int64)
+    if len(lens):
+        np.cumsum(lens[:-1] + 4, out=starts[1:])
+        starts += 4  # skip each value's own length prefix
+    buf = jnp.asarray(np.frombuffer(page, np.uint8))
+    lens_d = jnp.asarray(lens)
+    _, row_of, pos, total = ragged_positions(lens_d)
+    if total == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    starts_d = jnp.asarray(starts)
+    return buf[starts_d[row_of] + pos]
+
+
+# ---------------------------------------------------------------------------
+# decoded value segments
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Values:
+    """Decoded present values of one chunk: device-resident."""
+
+    kind: str  # "fixed" | "bytes"
+    data: Optional[jnp.ndarray] = None      # fixed: [n_present] storage dtype
+    lens: Optional[jnp.ndarray] = None      # bytes: [n_present] int32
+    chars: Optional[jnp.ndarray] = None     # bytes: [total] uint8
+
+    @staticmethod
+    def concat(parts: List["_Values"]) -> "_Values":
+        if not parts:
+            return _Values("fixed", data=jnp.zeros((0,), jnp.int32))
+        if parts[0].kind == "fixed":
+            return _Values("fixed", data=jnp.concatenate([p.data for p in parts]))
+        return _Values(
+            "bytes",
+            lens=jnp.concatenate([p.lens for p in parts]),
+            chars=jnp.concatenate([p.chars for p in parts]),
+        )
+
+
+_NP_STORE = {
+    _T_INT32: np.int32,
+    _T_INT64: np.int64,
+    _T_FLOAT: np.float32,
+    _T_DOUBLE: np.float64,
+    _T_BOOLEAN: np.uint8,
+}
+
+
+def _plain_fixed_device(page: bytes, ptype: int, n_present: int) -> _Values:
+    np_dt = _NP_STORE[ptype]
     if ptype == _T_BOOLEAN:
         bits = np.unpackbits(
-            np.frombuffer(data, dtype=np.uint8, count=(num + 7) // 8), bitorder="little"
-        )[:num]
-        return bits.astype(np.uint8), (num + 7) // 8
-    if ptype == _T_BYTE_ARRAY:
-        vals = []
-        pos = 0
-        for _ in range(num):
-            (ln,) = struct.unpack_from("<I", data, pos)
-            pos += 4
-            vals.append(data[pos : pos + ln])
-            pos += ln
-        return vals, pos
-    raise ParquetReadError(f"unsupported physical type {ptype}")
+            np.frombuffer(page, np.uint8, count=(n_present + 7) // 8), bitorder="little"
+        )[:n_present].astype(np.uint8)
+        return _Values("fixed", data=jnp.asarray(bits))
+    arr = np.frombuffer(page, dtype=np_dt, count=n_present)
+    if ptype == _T_DOUBLE:
+        arr = arr.view(np.uint64)  # FLOAT64 storage convention (bit lanes)
+    return _Values("fixed", data=jnp.asarray(arr))
+
+
+class _Dictionary:
+    """Device-resident dictionary page."""
+
+    def __init__(self, page: bytes, ptype: int, n: int):
+        self.ptype = ptype
+        if ptype == _T_BYTE_ARRAY:
+            lens = _byte_array_lens(page)[:n]
+            if len(lens) < n:
+                raise ParquetReadError("dictionary page truncated")
+            self.lens = jnp.asarray(lens)
+            offs = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=offs[1:])
+            self.offs = jnp.asarray(offs)
+            self.chars = _byte_array_chars_device(page, lens)
+        elif ptype in _NP_STORE:
+            arr = np.frombuffer(page, dtype=_NP_STORE[ptype], count=n)
+            if ptype == _T_DOUBLE:
+                arr = arr.view(np.uint64)
+            self.data = jnp.asarray(arr)
+        else:
+            raise ParquetReadError(f"unsupported dictionary type {ptype}")
+
+    def take(self, idx: jnp.ndarray) -> _Values:
+        from ..ops.bitutils import ragged_positions
+
+        if self.ptype != _T_BYTE_ARRAY:
+            return _Values("fixed", data=self.data[idx])
+        lens = self.lens[idx]
+        _, row_of, pos, total = ragged_positions(lens)
+        if total == 0:
+            return _Values("bytes", lens=lens, chars=jnp.zeros((0,), jnp.uint8))
+        chars = self.chars[self.offs[idx[row_of]] + pos]
+        return _Values("bytes", lens=lens, chars=chars)
+
+
+# ---------------------------------------------------------------------------
+# chunk decode: pages -> (defs, reps, values)
+# ---------------------------------------------------------------------------
 
 
 class _ChunkDecoder:
-    def __init__(self, file_bytes: bytes, chunk: tc.ThriftStruct, max_def: int):
+    def __init__(self, file_bytes: bytes, chunk: tc.ThriftStruct, max_def: int, max_rep: int):
         md = chunk.get(_CC_META_DATA)
         self.ptype = md.get(_CMD_TYPE)
         self.codec = _CODECS.get(md.get(_CMD_CODEC, 0))
         self.num_values = md.get(_CMD_NUM_VALUES, 0)
         self.max_def = max_def
+        self.max_rep = max_rep
         start = md.get(_CMD_DATA_PAGE_OFFSET, 0)
         dict_off = md.get(_CMD_DICT_PAGE_OFFSET)
         if dict_off is not None and dict_off < start:
             start = dict_off
         self.data = file_bytes
         self.pos = start
-        self.dictionary = None
+        self.dictionary: Optional[_Dictionary] = None
 
     def _read_page_header(self) -> tc.ThriftStruct:
         r = tc._Reader(self.data, self.pos)
@@ -214,10 +419,13 @@ class _ChunkDecoder:
         self.pos = r.pos
         return hdr
 
-    def decode(self) -> Tuple[object, np.ndarray]:
-        """Returns (values, def_levels) concatenated across pages."""
-        vals_parts: List = []
+    def decode(self) -> Tuple[np.ndarray, Optional[np.ndarray], _Values]:
+        """Returns (def_levels, rep_levels_or_None, values) concatenated
+        across the chunk's pages. Levels host (assembly metadata),
+        values device."""
+        vals_parts: List[_Values] = []
         defs_parts: List[np.ndarray] = []
+        reps_parts: List[np.ndarray] = []
         remaining = self.num_values
         while remaining > 0:
             hdr = self._read_page_header()
@@ -230,7 +438,7 @@ class _ChunkDecoder:
             if ptype_page == _P_DICTIONARY:
                 page = _decompress(raw, self.codec, uncomp_size)
                 n = hdr.get(_PH_DICT).get(_DPH_NUM_VALUES)
-                self.dictionary, _ = _read_plain(page, self.ptype, n)
+                self.dictionary = _Dictionary(page, self.ptype, n)
                 continue
 
             if ptype_page == _P_DATA:
@@ -239,6 +447,13 @@ class _ChunkDecoder:
                 enc = dph.get(_DPH_ENCODING)
                 page = _decompress(raw, self.codec, uncomp_size)
                 off = 0
+                reps = None
+                if self.max_rep > 0:
+                    (ln,) = struct.unpack_from("<I", page, off)
+                    off += 4
+                    bw = max(self.max_rep.bit_length(), 1)
+                    reps = _read_rle_bitpacked(page[off : off + ln], bw, n)
+                    off += ln
                 if self.max_def > 0:
                     (ln,) = struct.unpack_from("<I", page, off)
                     off += 4
@@ -246,21 +461,25 @@ class _ChunkDecoder:
                     defs = _read_rle_bitpacked(page[off : off + ln], bw, n)
                     off += ln
                 else:
-                    defs = np.ones(n, dtype=np.int32)
+                    defs = np.full(n, self.max_def, dtype=np.int32)
             elif ptype_page == _P_DATA_V2:
                 dph = hdr.get(_PH_DATA_V2)
                 n = dph.get(_DPH2_NUM_VALUES)
                 enc = dph.get(_DPH2_ENCODING)
                 def_bytes = dph.get(_DPH2_DEF_BYTES, 0)
                 rep_bytes = dph.get(_DPH2_REP_BYTES, 0)
-                if rep_bytes:
-                    raise ParquetReadError("nested columns not supported yet")
-                levels = raw[: def_bytes + rep_bytes]  # v2 levels are never compressed
+                levels = raw[: def_bytes + rep_bytes]  # v2 levels never compressed
+                reps = None
+                if self.max_rep > 0 and rep_bytes:
+                    bw = max(self.max_rep.bit_length(), 1)
+                    reps = _read_rle_bitpacked(levels[:rep_bytes], bw, n)
+                elif self.max_rep > 0:
+                    reps = np.zeros(n, dtype=np.int32)
                 if self.max_def > 0 and def_bytes:
                     bw = max(self.max_def.bit_length(), 1)
-                    defs = _read_rle_bitpacked(levels[rep_bytes:], bw, n)
+                    defs = _read_rle_bitpacked(levels[rep_bytes : rep_bytes + def_bytes], bw, n)
                 else:
-                    defs = np.ones(n, dtype=np.int32)
+                    defs = np.full(n, self.max_def, dtype=np.int32)
                 body = raw[def_bytes + rep_bytes :]
                 compressed_flag = dph.get(_DPH2_COMPRESSED, True)
                 page = (
@@ -274,135 +493,350 @@ class _ChunkDecoder:
 
             n_present = int(np.count_nonzero(defs == self.max_def)) if self.max_def else n
             if enc == _E_RLE and self.ptype == _T_BOOLEAN:
-                # v2 boolean values: u32 length + RLE/bit-packed, bit width 1
+                # v2 boolean values: u32 length + RLE/bit-packed, width 1
                 (ln,) = struct.unpack_from("<I", page, off)
-                vals = _read_rle_bitpacked(page[off + 4 : off + 4 + ln], 1, n_present).astype(
-                    np.uint8
-                )
+                bits = _read_rle_bitpacked(page[off + 4 : off + 4 + ln], 1, n_present)
+                vals = _Values("fixed", data=jnp.asarray(bits.astype(np.uint8)))
             elif enc == _E_PLAIN:
-                vals, _ = _read_plain(page[off:], self.ptype, n_present)
+                body = page[off:]
+                if self.ptype == _T_BYTE_ARRAY:
+                    lens = _byte_array_lens(body)[:n_present]
+                    if len(lens) < n_present:
+                        raise ParquetReadError("byte-array page truncated")
+                    vals = _Values(
+                        "bytes",
+                        lens=jnp.asarray(lens),
+                        chars=_byte_array_chars_device(body, lens),
+                    )
+                else:
+                    vals = _plain_fixed_device(body, self.ptype, n_present)
             elif enc in (_E_PLAIN_DICTIONARY, _E_RLE_DICTIONARY):
                 if self.dictionary is None:
                     raise ParquetReadError("dictionary page missing")
                 bw = page[off]
-                idx = _read_rle_bitpacked(page[off + 1 :], bw, n_present)
-                if self.ptype == _T_BYTE_ARRAY:
-                    vals = [self.dictionary[i] for i in idx]
-                else:
-                    vals = np.asarray(self.dictionary)[idx]
+                idx = _rle_expand_device(page[off + 1 :], bw, n_present)
+                vals = self.dictionary.take(idx)
             else:
                 raise ParquetReadError(f"unsupported encoding {enc}")
 
             vals_parts.append(vals)
             defs_parts.append(defs)
+            if reps is not None:
+                reps_parts.append(reps)
             remaining -= n
 
         defs = np.concatenate(defs_parts) if defs_parts else np.zeros(0, np.int32)
-        if self.ptype == _T_BYTE_ARRAY:
-            values: List[bytes] = []
-            for v in vals_parts:
-                values.extend(v)
-            return values, defs
-        values = np.concatenate(vals_parts) if vals_parts else np.zeros(0, np.int32)
-        return values, defs
+        reps = np.concatenate(reps_parts) if reps_parts else None
+        return defs, reps, _Values.concat(vals_parts)
 
 
-def _leaf_schema_elements(meta: tc.ThriftStruct):
-    """Flat-schema leaves with their max definition level (root's children)."""
-    schema = meta.get(_FMD_SCHEMA).values
-    root_n = schema[0].get(_SE_NUM_CHILDREN, 0)
-    if len(schema) != root_n + 1:
-        raise ParquetReadError("nested schemas not supported yet")
-    leaves = []
-    for e in schema[1:]:
-        name = e.get(_SE_NAME, b"").decode()
-        optional = e.get(_SE_REPETITION, 0) == 1
-        leaves.append((name, e, 1 if optional else 0))
-    return leaves
+# ---------------------------------------------------------------------------
+# schema tree -> logical tree
+# ---------------------------------------------------------------------------
 
 
-def _to_column(name: str, elem: tc.ThriftStruct, values, defs, max_def: int) -> Column:
-    present = defs == max_def if max_def else np.ones(len(defs), bool)
-    n = len(defs)
-    validity = None if present.all() else present
-    ptype = elem.get(_SE_TYPE)
-    conv = elem.get(_SE_CONVERTED_TYPE)
+@dataclass
+class _SchemaElem:
+    name: str
+    repetition: int
+    ptype: Optional[int]
+    converted: Optional[int]
+    num_children: int
+    children: List["_SchemaElem"] = field(default_factory=list)
+    raw: Optional[tc.ThriftStruct] = None
 
-    if ptype == _T_BYTE_ARRAY:
-        # scatter present byte strings into full row set
-        full: List[bytes] = [b""] * n
-        j = 0
-        for i in range(n):
-            if present[i]:
-                full[i] = values[j]
-                j += 1
-        lens = np.fromiter((len(b) for b in full), dtype=np.int32, count=n)
-        offsets = np.zeros(n + 1, dtype=np.int32)
-        np.cumsum(lens, out=offsets[1:])
-        chars = np.frombuffer(b"".join(full), dtype=np.uint8).copy()
-        import jax.numpy as jnp
 
-        return Column(
-            dt.STRING,
-            validity=None if validity is None else jnp.asarray(validity),
-            offsets=jnp.asarray(offsets),
-            chars=jnp.asarray(chars),
+def _parse_schema(meta: tc.ThriftStruct) -> _SchemaElem:
+    flat = meta.get(_FMD_SCHEMA).values
+    pos = 0
+
+    def walk() -> _SchemaElem:
+        nonlocal pos
+        e = flat[pos]
+        pos += 1
+        node = _SchemaElem(
+            name=e.get(_SE_NAME, b"").decode(),
+            repetition=e.get(_SE_REPETITION, 0),
+            ptype=e.get(_SE_TYPE),
+            converted=e.get(_SE_CONVERTED_TYPE),
+            num_children=e.get(_SE_NUM_CHILDREN, 0) or 0,
+            raw=e,
         )
+        for _ in range(node.num_children):
+            node.children.append(walk())
+        return node
+
+    root = walk()
+    if pos != len(flat):
+        raise ParquetReadError("malformed schema tree")
+    return root
+
+
+@dataclass
+class _LLeaf:
+    name: str
+    elem: _SchemaElem
+    max_def: int
+    max_rep: int
+    leaf_index: int = -1
+
+
+@dataclass
+class _LStruct:
+    name: str
+    max_def: int
+    nullable: bool
+    children: List[object]
+
+
+@dataclass
+class _LList:
+    name: str
+    nullable: bool      # null iff def < elem_def - 1 (when nullable)
+    elem_def: int       # def level at which an element slot exists
+    rep: int            # rep level of the repeated node
+    element: object
+
+
+def _build_logical(elem: _SchemaElem, d: int, r: int, counter: List[int]):
+    """Schema element -> logical node, threading (max_def, max_rep)."""
+    if elem.repetition == _R_REPEATED:
+        # implicit (2-level / legacy) list: `repeated X x` == non-null
+        # list of required X
+        d_e, r_e = d + 1, r + 1
+        inner = _SchemaElem(elem.name, _R_REQUIRED, elem.ptype, elem.converted,
+                            elem.num_children, elem.children, elem.raw)
+        element = _build_logical(inner, d_e, r_e, counter)
+        return _LList(elem.name, nullable=False, elem_def=d_e, rep=r_e, element=element)
+
+    nullable = elem.repetition == _R_OPTIONAL
+    d2 = d + 1 if nullable else d
+
+    if elem.num_children == 0:
+        leaf = _LLeaf(elem.name, elem, max_def=d2, max_rep=r)
+        leaf.leaf_index = counter[0]
+        counter[0] += 1
+        return leaf
+
+    conv = elem.converted
+    ch = elem.children
+    if conv == _C_LIST and len(ch) == 1 and ch[0].repetition == _R_REPEATED:
+        rg = ch[0]
+        d_e, r_e = d2 + 1, r + 1
+        if rg.num_children == 0:
+            # legacy 2-level list: repeated primitive directly
+            inner = _SchemaElem(rg.name, _R_REQUIRED, rg.ptype, rg.converted, 0, [], rg.raw)
+            element = _build_logical(inner, d_e, r_e, counter)
+        elif rg.num_children == 1:
+            # standard 3-level: repeated group wraps the element
+            element = _build_logical(rg.children[0], d_e, r_e, counter)
+        else:
+            # legacy: repeated group with several fields == list<struct>
+            element = _LStruct(
+                rg.name, max_def=d_e, nullable=False,
+                children=[_build_logical(c, d_e, r_e, counter) for c in rg.children],
+            )
+        return _LList(elem.name, nullable=nullable, elem_def=d_e, rep=r_e, element=element)
+
+    if conv in (_C_MAP, _C_MAP_KEY_VALUE) and len(ch) == 1 and ch[0].repetition == _R_REPEATED:
+        kv = ch[0]
+        d_e, r_e = d2 + 1, r + 1
+        element = _LStruct(
+            kv.name, max_def=d_e, nullable=False,
+            children=[_build_logical(c, d_e, r_e, counter) for c in kv.children],
+        )
+        return _LList(elem.name, nullable=nullable, elem_def=d_e, rep=r_e, element=element)
+
+    return _LStruct(
+        elem.name, max_def=d2, nullable=nullable,
+        children=[_build_logical(c, d2, r, counter) for c in ch],
+    )
+
+
+def _leaves_of(lnode) -> List[_LLeaf]:
+    if isinstance(lnode, _LLeaf):
+        return [lnode]
+    if isinstance(lnode, _LList):
+        return _leaves_of(lnode.element)
+    return [lf for c in lnode.children for lf in _leaves_of(c)]
+
+
+# ---------------------------------------------------------------------------
+# nested assembly (Dremel inverse), vectorized numpy for the level math
+# ---------------------------------------------------------------------------
+
+
+def _range_counts(mask: np.ndarray, slot_idx: np.ndarray) -> np.ndarray:
+    """Per slot j (range [slot_idx[j], slot_idx[j+1]) over the stream),
+    the number of True entries of `mask` inside the range."""
+    P = np.zeros(len(mask) + 1, np.int64)
+    np.cumsum(mask, out=P[1:])
+    bounds = np.append(slot_idx, len(mask))
+    return (P[bounds[1:]] - P[bounds[:-1]]).astype(np.int32)
+
+
+def _leaf_column(leaf: _LLeaf, defs: np.ndarray, idx: np.ndarray, values: _Values) -> Column:
+    """Scatter the chunk's present values into the leaf's slot set."""
+    n = len(idx)
+    present = defs[idx] == leaf.max_def
+    all_valid = bool(present.all())
+    validity = None if all_valid else jnp.asarray(present)
+
+    ptype = leaf.elem.ptype
+    if ptype == _T_BYTE_ARRAY:
+        present_d = jnp.asarray(present)
+        pos = jnp.cumsum(present_d.astype(jnp.int32)) - 1
+        if values.lens.shape[0] == 0:
+            lens_slot = jnp.zeros((n,), jnp.int32)
+        else:
+            lens_slot = jnp.where(present_d, values.lens[jnp.clip(pos, 0, None)], 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens_slot, dtype=jnp.int32)]
+        )
+        # present slots appear in value order, so chars need no reorder
+        return Column(dt.STRING, validity=validity, offsets=offsets, chars=values.chars)
 
     np_map = {
-        _T_INT32: (np.int32, dt.INT32),
-        _T_INT64: (np.int64, dt.INT64),
-        _T_FLOAT: (np.float32, dt.FLOAT32),
-        _T_DOUBLE: (np.float64, dt.FLOAT64),
-        _T_BOOLEAN: (np.uint8, dt.BOOL8),
+        _T_INT32: dt.INT32,
+        _T_INT64: dt.INT64,
+        _T_FLOAT: dt.FLOAT32,
+        _T_DOUBLE: dt.FLOAT64,
+        _T_BOOLEAN: dt.BOOL8,
     }
     if ptype not in np_map:
         raise ParquetReadError(f"unsupported type {ptype}")
-    np_dt, col_dt = np_map[ptype]
-    full_arr = np.zeros(n, dtype=np_dt)
-    full_arr[present] = values
-    return Column.from_numpy(full_arr, col_dt, validity=None if validity is None else validity)
+    col_dt = np_map[ptype]
+    data = values.data
+    if all_valid and len(data) == n:
+        return Column(col_dt, data=data, validity=None)
+    present_d = jnp.asarray(present)
+    pos = jnp.clip(jnp.cumsum(present_d.astype(jnp.int32)) - 1, 0, None)
+    if data.shape[0] == 0:
+        full = jnp.zeros((n,), data.dtype if data.size else jnp.int32)
+    else:
+        full = jnp.where(present_d, data[pos], jnp.zeros((), data.dtype))
+    return Column(col_dt, data=full, validity=validity)
+
+
+def _assemble(lnode, streams: Dict[int, Tuple[np.ndarray, Optional[np.ndarray], np.ndarray, _Values]]) -> Column:
+    """streams: leaf_index -> (defs, reps, slot_idx, values)."""
+    if isinstance(lnode, _LLeaf):
+        defs, _reps, idx, values = streams[lnode.leaf_index]
+        return _leaf_column(lnode, defs, idx, values)
+
+    if isinstance(lnode, _LStruct):
+        # struct validity from any descendant stream (consistent at
+        # shared ancestor levels)
+        first_leaf = _leaves_of(lnode)[0]
+        defs, _r, idx, _v = streams[first_leaf.leaf_index]
+        validity = None
+        if lnode.nullable:
+            present = defs[idx] >= lnode.max_def
+            if not present.all():
+                validity = jnp.asarray(present)
+        children = [_assemble(c, {
+            lf.leaf_index: streams[lf.leaf_index] for lf in _leaves_of(c)
+        }) for c in lnode.children]
+        names = [c.name for c in lnode.children]
+        return Column.struct_from_parts(children, names, validity=validity)
+
+    assert isinstance(lnode, _LList)
+    first_leaf = _leaves_of(lnode)[0]
+    defs0, reps0, idx0, _v0 = streams[first_leaf.leaf_index]
+    if reps0 is None:
+        raise ParquetReadError("list column without repetition levels")
+    elem_mask0 = (reps0 <= lnode.rep) & (defs0 >= lnode.elem_def)
+    counts = _range_counts(elem_mask0, idx0)
+    offsets = np.zeros(len(idx0) + 1, np.int32)
+    np.cumsum(counts, out=offsets[1:])
+    validity = None
+    if lnode.nullable:
+        present = defs0[idx0] >= lnode.elem_def - 1
+        if not present.all():
+            validity = jnp.asarray(present)
+
+    # element slot positions per descendant stream
+    child_streams = {}
+    for lf in _leaves_of(lnode.element):
+        defs, reps, _idx, vals = streams[lf.leaf_index]
+        em = (reps <= lnode.rep) & (defs >= lnode.elem_def)
+        child_streams[lf.leaf_index] = (defs, reps, np.flatnonzero(em), vals)
+    child = _assemble(lnode.element, child_streams)
+    return Column.list_from_parts(jnp.asarray(offsets), child, validity=validity)
+
+
+# ---------------------------------------------------------------------------
+# read_table
+# ---------------------------------------------------------------------------
 
 
 @op_boundary("read_table")
 def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
-    """Read a flat-schema parquet file into a device Table."""
+    """Read a parquet file into a device Table. `columns` selects
+    TOP-LEVEL fields by name; nested fields come whole (lists, structs,
+    maps as LIST<STRUCT<key,value>> — the cudf representation)."""
     if file_bytes[:4] != b"PAR1" or file_bytes[-4:] != b"PAR1":
         raise ParquetReadError("not a parquet file")
     (flen,) = struct.unpack("<I", file_bytes[-8:-4])
     meta = tc.read_struct(file_bytes[-8 - flen : -8])
 
-    leaves = _leaf_schema_elements(meta)
+    root = _parse_schema(meta)
+    counter = [0]
+    fields = [(c.name, _build_logical(c, 0, 0, counter)) for c in root.children]
+    n_leaves = counter[0]
+
     if columns is not None:
-        name_set = set(columns)
-        sel = [(i, leaf) for i, leaf in enumerate(leaves) if leaf[0] in name_set]
+        keep = set(columns)
+        sel_fields = [(nm, f) for nm, f in fields if nm in keep]
+        missing = keep - {nm for nm, _ in sel_fields}
+        if missing:
+            raise ParquetReadError(f"columns not in schema: {sorted(missing)}")
     else:
-        sel = list(enumerate(leaves))
+        sel_fields = fields
 
-    rgs = meta.get(_FMD_ROW_GROUPS).values
-    out_cols: Dict[str, Tuple[List, List, tc.ThriftStruct, int]] = {}
-    order: List[str] = []
-    for i, (name, elem, max_def) in sel:
-        vparts: List = []
-        dparts: List[np.ndarray] = []
+    needed_leaves: Dict[int, _LLeaf] = {}
+    for _nm, f in sel_fields:
+        for lf in _leaves_of(f):
+            needed_leaves[lf.leaf_index] = lf
+
+    rgs_field = meta.get(_FMD_ROW_GROUPS)
+    rgs = rgs_field.values if rgs_field is not None else []
+    # decode each needed leaf chunk across row groups, then concatenate
+    streams: Dict[int, Tuple[np.ndarray, Optional[np.ndarray], np.ndarray, _Values]] = {}
+    for li, leaf in needed_leaves.items():
+        d_parts: List[np.ndarray] = []
+        r_parts: List[np.ndarray] = []
+        v_parts: List[_Values] = []
+        has_reps = leaf.max_rep > 0
         for rg in rgs:
-            chunk = rg.get(_RG_COLUMNS).values[i]
-            dec = _ChunkDecoder(file_bytes, chunk, max_def)
-            vals, defs = dec.decode()
-            vparts.append(vals)
-            dparts.append(defs)
-        if elem.get(_SE_TYPE) == _T_BYTE_ARRAY:
-            values: List[bytes] = []
-            for v in vparts:
-                values.extend(v)
+            chunks = rg.get(_RG_COLUMNS).values
+            if li >= len(chunks):
+                raise ParquetReadError("row group missing column chunk")
+            dec = _ChunkDecoder(file_bytes, chunks[li], leaf.max_def, leaf.max_rep)
+            defs, reps, vals = dec.decode()
+            d_parts.append(defs)
+            if has_reps:
+                r_parts.append(
+                    reps if reps is not None else np.zeros(len(defs), np.int32)
+                )
+            v_parts.append(vals)
+        defs = np.concatenate(d_parts) if d_parts else np.zeros(0, np.int32)
+        reps = np.concatenate(r_parts) if r_parts else None
+        if reps is None and has_reps:
+            # zero-row-group files: nested leaves still assemble (empty)
+            reps = np.zeros(len(defs), np.int32)
+        vals = _Values.concat(v_parts)
+        # top-level slots: record starts (rep == 0); flat: every entry
+        if reps is not None:
+            idx = np.flatnonzero(reps == 0)
         else:
-            values = np.concatenate(vparts) if vparts else np.zeros(0, np.int32)
-        defs = np.concatenate(dparts) if dparts else np.zeros(0, np.int32)
-        out_cols[name] = (values, defs, elem, max_def)
-        order.append(name)
+            idx = np.arange(len(defs), dtype=np.int64)
+        streams[li] = (defs, reps, idx, vals)
 
-    cols = [
-        _to_column(name, out_cols[name][2], out_cols[name][0], out_cols[name][1], out_cols[name][3])
-        for name in order
-    ]
-    return Table(cols, names=order)
+    out_cols: List[Column] = []
+    names: List[str] = []
+    for nm, f in sel_fields:
+        sub = {lf.leaf_index: streams[lf.leaf_index] for lf in _leaves_of(f)}
+        out_cols.append(_assemble(f, sub))
+        names.append(nm)
+    return Table(out_cols, names=names)

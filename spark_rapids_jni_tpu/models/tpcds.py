@@ -212,3 +212,55 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
         "total_shipping_cost": float(np.asarray(jnp.sum(ship))),
         "total_net_profit": float(np.asarray(jnp.sum(prof))),
     }
+
+
+def q95_distributed(tables: Dict[str, Table], mesh, ship_lo: int = 400, ship_hi: int = 460) -> dict:
+    """q95 on the Table-level distributed operators (parallel/table_ops):
+    the same plan as ``q95`` with every exchange-bearing step — both
+    groupbys and both semi-joins — running as shuffled shard_map programs
+    over the mesh. Filters and the tiny post-aggregation arithmetic stay
+    local, exactly like Spark keeps narrow transformations pipelined.
+    Must produce results identical to single-chip ``q95``."""
+    from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+
+    ws = tables["web_sales"]
+
+    per_order, ovf = distributed_groupby_table(
+        ws, ["ws_order_number"],
+        [("ws_warehouse_sk", "min", "ws_warehouse_sk_min"),
+         ("ws_warehouse_sk", "max", "ws_warehouse_sk_max")],
+        mesh,
+    )
+    if ovf:
+        raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
+    ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
+
+    wr = tables["web_returns"]
+    wr_keys = Table(wr.select(["wr_order_number"]).columns, ["ws_order_number"])
+
+    pred = (
+        (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
+        & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
+    ).evaluate(ws)
+    ws1 = copying.apply_boolean_mask(ws, pred)
+    ws1, o1 = distributed_join_table(ws1, ws_wh, on=["ws_order_number"], mesh=mesh, how="left_semi")
+    ws1, o2 = distributed_join_table(ws1, wr_keys, on=["ws_order_number"], mesh=mesh, how="left_semi")
+    if o1 or o2:
+        raise RuntimeError("join capacity overflow — raise capacity")
+
+    per, o3 = distributed_groupby_table(
+        ws1, ["ws_order_number"],
+        [("ws_ext_ship_cost", "sum", "ws_ext_ship_cost_sum"),
+         ("ws_net_profit", "sum", "ws_net_profit_sum")],
+        mesh,
+    )
+    if o3:
+        raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    ship = bitutils.float_view(per.column("ws_ext_ship_cost_sum").data, dt.FLOAT64)
+    prof = bitutils.float_view(per.column("ws_net_profit_sum").data, dt.FLOAT64)
+    return {
+        "order_count": int(per.num_rows),
+        "total_shipping_cost": float(np.asarray(jnp.sum(ship))),
+        "total_net_profit": float(np.asarray(jnp.sum(prof))),
+    }

@@ -137,6 +137,14 @@ class _Parser:
         ast = self.alt()
         if self.i < len(self.p):
             raise ValueError(f"unexpected {self.p[self.i]!r} at {self.i} in /{self.p}/")
+        if (self.anchor_start or self.anchor_end) and ast[0] == "alt":
+            # flags anchor the WHOLE pattern; with a top-level alternation
+            # Java scopes them to one branch — refuse rather than silently
+            # anchoring every branch (group the alternation to anchor all)
+            raise ValueError(
+                "anchors with top-level alternation unsupported — "
+                "group the alternation: ^(?:a|b)$"
+            )
         return ast
 
     def alt(self):

@@ -314,8 +314,18 @@ def _to_rows_strings(
     fixed = _fixed_section(layout, cols, slot_vals, layout.fixed_end)
 
     blob = jnp.zeros((total_bytes,), dtype=jnp.uint8)
-    fixed_idx = row_offsets[:, None] + jnp.arange(layout.fixed_end, dtype=jnp.int64)[None, :]
-    blob = blob.at[fixed_idx.reshape(-1)].set(fixed.reshape(-1), mode="drop")
+    # scatter the fixed section in row chunks: the [rows, fixed_end]
+    # index matrix is O(total fixed bytes) — materialized whole it is a
+    # multi-GB HLO temp at the 155-col x 1M mixed axis (compile-time
+    # OOM); ~64MB of indices per scatter keeps the temp bounded
+    chunk = max(1, (64 << 20) // max(layout.fixed_end, 1))
+    span = jnp.arange(layout.fixed_end, dtype=jnp.int64)[None, :]
+    for r0 in range(0, n, chunk):
+        r1 = min(r0 + chunk, n)
+        fixed_idx = row_offsets[r0:r1, None] + span
+        blob = blob.at[fixed_idx.reshape(-1)].set(
+            fixed[r0:r1].reshape(-1), mode="drop"
+        )
 
     for k, col in enumerate(var_cols):
         nchars = int(col.chars.shape[0])

@@ -16,4 +16,5 @@ from . import (  # noqa: F401
     mesh,
     shuffle,
     sort_distributed,
+    table_ops,
 )

@@ -140,8 +140,9 @@ def exchange_by_key(
     for c in table.columns:
         if c.dtype.id in (TypeId.STRING, TypeId.LIST):
             raise ValueError(
-                "exchange_by_key moves fixed-width payloads; dictionary-encode "
-                "strings before the exchange"
+                "exchange_by_key moves fixed-width payloads; use "
+                "parallel.table_ops.exchange_table, which dictionary-encodes "
+                "string columns automatically"
             )
     dest = hash_partition_map([table.column(c) for c in key_cols], mesh.shape[axis])
     arrays: List[jnp.ndarray] = []

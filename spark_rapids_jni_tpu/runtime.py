@@ -163,6 +163,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_multiply_decimal128.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
     lib.srjt_divide_decimal128.restype = ctypes.c_int64
     lib.srjt_divide_decimal128.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+    lib.srjt_byte_array_lens.restype = ctypes.c_int64
+    lib.srjt_byte_array_lens.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
     return lib
 
 
@@ -187,6 +189,29 @@ def native_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return native_lib() is not None
+
+
+def byte_array_lens(page: bytes):
+    """Walk a parquet PLAIN BYTE_ARRAY page in C: per-value lengths.
+    The upper bound on values is size/4 (each costs a 4-byte prefix)."""
+    import numpy as np
+
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    cap = max(len(page) // 4 + 1, 1)
+    out = np.empty(cap, np.int32)
+    # borrow the bytes object's buffer (C side only reads) — no memcpy
+    src = ctypes.cast(ctypes.c_char_p(page), ctypes.POINTER(ctypes.c_uint8))
+    n = lib.srjt_byte_array_lens(
+        src,
+        len(page),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cap,
+    )
+    if n < 0:
+        raise RuntimeError("byte_array_lens: capacity overflow")
+    return out[:n].copy()
 
 
 def _raise_last(lib) -> None:

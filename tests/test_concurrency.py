@@ -1,0 +1,101 @@
+"""PTDS-analog concurrency tests (SURVEY §2.9): N executor task threads
+drive interleaved ops through ``bind_executor`` concurrently — the
+scenario the reference pays real engineering for (PTDS build flag,
+pom.xml:80 / CMakeLists.txt:189-193 in the reference). Asserts:
+
+- isolation: each thread's results are correct for ITS inputs (no
+  cross-thread corruption through the shared runtime),
+- binding: each thread computes on the device its executor id maps to,
+- completion: no deadlock/livelock under interleaving (join with
+  timeout),
+- reentrancy: nested bind_executor restores the outer binding.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops import strings as ss
+from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+from spark_rapids_jni_tpu.ops.hashing import hash_partition_map
+from spark_rapids_jni_tpu.parallel.device import bind_executor, current_device, device_for_executor
+
+N_THREADS = 8
+ITERS = 12
+
+
+def _worker(executor_id: int, results, errors):
+    try:
+        rng = np.random.default_rng(1000 + executor_id)
+        with bind_executor(executor_id) as dev:
+            assert current_device() == dev
+            acc = []
+            for it in range(ITERS):
+                n = 512 + 64 * executor_id
+                keys = jnp.asarray(rng.integers(0, 32, n), jnp.int64)
+                vals = jnp.asarray(rng.integers(0, 100, n), jnp.int64).astype(jnp.float32)
+                # interleave three op families to shake the dispatch path
+                sums, _counts = groupby_sum_bounded(keys, vals, 32)
+                pmap = hash_partition_map(
+                    [Column(dt.INT64, data=keys)], 4
+                )
+                sc = ss.upper(Column.from_pylist([f"t{executor_id}_{it}"], dt.STRING))
+                # device placement check: results computed under the binding
+                assert sums.devices() == {dev}
+                want = np.bincount(
+                    np.asarray(keys), weights=np.asarray(vals), minlength=32
+                ).astype(np.float32)
+                np.testing.assert_allclose(np.asarray(sums), want, rtol=1e-6)
+                acc.append(float(np.asarray(sums).sum()) + int(np.asarray(pmap)[0]))
+                assert sc.to_pylist() == [f"T{executor_id}_{it}"]
+            results[executor_id] = acc
+    except Exception as e:  # noqa: BLE001 — surface on the main thread
+        errors[executor_id] = e
+
+
+def test_concurrent_executor_threads_isolated():
+    results: dict = {}
+    errors: dict = {}
+    threads = [
+        threading.Thread(target=_worker, args=(i, results, errors)) for i in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "worker thread deadlocked"
+    assert not errors, f"worker failures: {errors}"
+    assert set(results) == set(range(N_THREADS))
+    # each thread's reduction must equal a single-threaded replay
+    replay: dict = {}
+    errors2: dict = {}
+    for i in range(N_THREADS):
+        _worker(i, replay, errors2)
+    assert not errors2
+    for i in range(N_THREADS):
+        assert results[i] == replay[i], f"thread {i} results differ under concurrency"
+
+
+def test_bind_executor_reentrant_restores():
+    devs = jax.local_devices()
+    with bind_executor(0) as d0:
+        assert current_device() == d0
+        with bind_executor(1) as d1:
+            assert current_device() == d1
+            if len(devs) > 1:
+                assert d1 != d0
+        assert current_device() == d0
+    assert current_device() == devs[0]
+
+
+def test_device_mapping_round_robin():
+    devs = jax.local_devices()
+    seen = [device_for_executor(i) for i in range(2 * len(devs))]
+    for i, d in enumerate(seen):
+        assert d == devs[i % len(devs)]

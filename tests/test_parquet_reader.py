@@ -87,3 +87,116 @@ def test_empty_table():
     t = pa.table({"a": pa.array([], pa.int32())})
     got = read_table(write(t))
     assert got.num_rows == 0
+
+
+# ---------------------------------------------------------------------------
+# nested schemas (lists / structs / maps) vs the pyarrow oracle
+# ---------------------------------------------------------------------------
+
+
+def test_list_of_int():
+    t = pa.table({
+        "l": pa.array([[1, 2, 3], [], None, [4], [None, 5]], pa.list_(pa.int64())),
+    })
+    check_roundtrip(t)
+    check_roundtrip(t, use_dictionary=False)
+    check_roundtrip(t, data_page_version="2.0")
+
+
+def test_list_of_strings():
+    t = pa.table({
+        "l": pa.array([["a", "bb"], None, [], ["", None, "ccc"]], pa.list_(pa.string())),
+    })
+    check_roundtrip(t)
+
+
+def test_struct_flat():
+    t = pa.table({
+        "s": pa.array(
+            [{"a": 1, "b": "x"}, None, {"a": None, "b": "z"}, {"a": 4, "b": None}],
+            pa.struct([("a", pa.int32()), ("b", pa.string())]),
+        ),
+    })
+    check_roundtrip(t)
+
+
+def test_struct_of_list():
+    t = pa.table({
+        "s": pa.array(
+            [{"v": [1, 2]}, {"v": None}, None, {"v": []}, {"v": [None, 3]}],
+            pa.struct([("v", pa.list_(pa.int64()))]),
+        ),
+    })
+    check_roundtrip(t)
+
+
+def test_list_of_struct():
+    t = pa.table({
+        "l": pa.array(
+            [[{"a": 1}, {"a": None}], [], None, [{"a": 7}]],
+            pa.list_(pa.struct([("a", pa.int64())])),
+        ),
+    })
+    check_roundtrip(t)
+
+
+def test_list_of_list():
+    t = pa.table({
+        "ll": pa.array(
+            [[[1], [2, 3]], [], None, [None, [4, None]], [[]]],
+            pa.list_(pa.list_(pa.int32())),
+        ),
+    })
+    check_roundtrip(t)
+
+
+def test_map_column():
+    t = pa.table({
+        "m": pa.array(
+            [[("k1", 1), ("k2", 2)], [], None, [("k3", None)]],
+            pa.map_(pa.string(), pa.int64()),
+        ),
+    })
+    got = read_table(write(t))
+    # maps land as LIST<STRUCT<key, value>> (the cudf representation)
+    want = [
+        None if row is None else [{"key": k, "value": v} for k, v in row]
+        for row in t.column("m").to_pylist()
+    ]
+    assert got.column("m").to_pylist() == want
+
+
+def test_deep_nesting_row_groups(rng):
+    rows = []
+    for i in range(700):
+        r = int(rng.integers(0, 6))
+        if r == 0:
+            rows.append(None)
+        else:
+            rows.append(
+                [
+                    {
+                        "tags": None if rng.integers(0, 5) == 0 else [
+                            f"t{int(x)}" for x in rng.integers(0, 9, int(rng.integers(0, 3)))
+                        ],
+                        "n": None if rng.integers(0, 5) == 0 else int(rng.integers(0, 100)),
+                    }
+                    for _ in range(int(rng.integers(0, 3)))
+                ]
+            )
+    typ = pa.list_(pa.struct([("tags", pa.list_(pa.string())), ("n", pa.int64())]))
+    t = pa.table({"events": pa.array(rows, typ), "id": pa.array(range(700), pa.int64())})
+    data = write(t, row_group_size=128)
+    got = read_table(data)
+    assert got.column("events").to_pylist() == t.column("events").to_pylist()
+    assert got.column("id").to_pylist() == t.column("id").to_pylist()
+
+
+def test_nested_next_to_flat_selection():
+    t = pa.table({
+        "flat": pa.array([1, 2, 3], pa.int32()),
+        "l": pa.array([[1], [], [2, 3]], pa.list_(pa.int32())),
+    })
+    got = read_table(write(t), columns=["l"])
+    assert got.names == ["l"]
+    assert got.column("l").to_pylist() == t.column("l").to_pylist()
