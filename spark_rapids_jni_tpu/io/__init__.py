@@ -1,5 +1,5 @@
 """Host-side IO tier: parquet footer service (pure CPU, like the
-reference's NativeParquetJni.cpp) and parquet data decode feeding device
-columns."""
+reference's NativeParquetJni.cpp) and parquet/ORC data decode feeding
+device columns."""
 
-from . import parquet_footer  # noqa: F401
+from . import orc_reader, parquet_footer  # noqa: F401

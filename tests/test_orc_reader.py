@@ -1,0 +1,125 @@
+"""ORC decode tests: pyarrow.orc-written files as the oracle."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+orc = pytest.importorskip("pyarrow.orc")
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.io.orc_reader import OrcReadError, read_table
+
+
+def write(table, **kw):
+    buf = io.BytesIO()
+    orc.write_table(table, buf, **kw)
+    return buf.getvalue()
+
+
+def check_roundtrip(pa_table, **kw):
+    data = write(pa_table, **kw)
+    got = read_table(data)
+    for name in pa_table.column_names:
+        expected = pa_table.column(name).to_pylist()
+        actual = got.column(name).to_pylist()
+        typ = pa_table.schema.field(name).type
+        if pa.types.is_floating(typ):
+            for e, a in zip(expected, actual):
+                assert (e is None) == (a is None)
+                if e is not None:
+                    assert a == e or abs(e - a) < 1e-6
+        elif pa.types.is_date(typ):
+            import datetime
+
+            epoch = datetime.date(1970, 1, 1)
+            for e, a in zip(expected, actual):
+                assert (e is None) == (a is None)
+                if e is not None:
+                    assert a == (e - epoch).days
+        else:
+            assert actual == expected, f"column {name}"
+
+
+BASIC = pa.table({
+    "i32": pa.array([1, -2, 3, None, 5], pa.int32()),
+    "i64": pa.array([2**40, None, -7, 0, 9], pa.int64()),
+    "i8": pa.array([1, None, -8, 127, -128], pa.int8()),
+    "f32": pa.array([1.5, 2.5, None, -0.25, 0.0], pa.float32()),
+    "f64": pa.array([1e300, None, -2.25, 0.5, 3.125], pa.float64()),
+    "s": pa.array(["hello", "", None, "spark", "tpu"], pa.string()),
+    "b": pa.array([True, False, None, True, False], pa.bool_()),
+})
+
+
+@pytest.mark.parametrize("codec", ["uncompressed", "zlib", "snappy", "zstd"])
+def test_roundtrip_codecs(codec):
+    check_roundtrip(BASIC, compression=codec)
+
+
+def test_large_int_runs_and_literals(rng):
+    n = 20000
+    t = pa.table({
+        # monotonic -> delta encoding; repeats -> short-repeat; random -> direct/patched
+        "mono": pa.array(np.arange(n, dtype=np.int64) * 3 + 7),
+        "rep": pa.array(np.repeat(rng.integers(-50, 50, 200), 100).astype(np.int32)),
+        "rand": pa.array(rng.integers(-(2**40), 2**40, n).astype(np.int64)),
+        "skew": pa.array(
+            np.where(rng.integers(0, 100, n) == 0,
+                     rng.integers(0, 2**50, n),
+                     rng.integers(0, 100, n)).astype(np.int64)
+        ),  # outliers force PATCHED_BASE
+    })
+    check_roundtrip(t)
+
+
+def test_strings_direct_and_dictionary(rng):
+    n = 5000
+    # low-cardinality -> dictionary encoding; high-cardinality -> direct
+    t = pa.table({
+        "dict": pa.array([f"cat_{int(x)}" for x in rng.integers(0, 20, n)]),
+        "direct": pa.array([f"row_{i}_{int(rng.integers(0, 1 << 30))}" for i in range(n)]),
+    })
+    check_roundtrip(t)
+
+
+def test_multiple_stripes(rng):
+    n = 150000
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+        "y": pa.array([f"k{int(v) % 37}" for v in rng.integers(0, 1000, n)]),
+    })
+    data = write(t, stripe_size=64 * 1024)
+    got = read_table(data)
+    assert got.column("x").to_pylist() == t.column("x").to_pylist()
+    assert got.column("y").to_pylist() == t.column("y").to_pylist()
+
+
+def test_date_column():
+    import datetime
+
+    d = datetime.date
+    t = pa.table({"d": pa.array([d(1970, 1, 1), d(2024, 2, 29), None, d(1969, 12, 31)])})
+    check_roundtrip(t)
+
+
+def test_column_selection():
+    got = read_table(write(BASIC), columns=["s", "i32"])
+    assert got.names == ["i32", "s"]
+    assert got.column("s").to_pylist() == BASIC.column("s").to_pylist()
+
+
+def test_all_nulls_and_empty():
+    t = pa.table({"n": pa.array([None, None, None], pa.int32())})
+    got = read_table(write(t))
+    assert got.column("n").to_pylist() == [None, None, None]
+    t2 = pa.table({"a": pa.array([], pa.int64())})
+    got2 = read_table(write(t2))
+    assert got2.num_rows == 0
+
+
+def test_nested_raises():
+    t = pa.table({"l": pa.array([[1, 2]], pa.list_(pa.int64()))})
+    with pytest.raises(OrcReadError):
+        read_table(write(t))
