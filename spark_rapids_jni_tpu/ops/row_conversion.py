@@ -318,7 +318,7 @@ def _to_rows_strings(
     # index matrix is O(total fixed bytes) — materialized whole it is a
     # multi-GB HLO temp at the 155-col x 1M mixed axis (compile-time
     # OOM); ~64MB of indices per scatter keeps the temp bounded
-    chunk = max(1, (64 << 20) // max(layout.fixed_end, 1))
+    chunk = max(1, (64 << 20) // 8 // max(layout.fixed_end, 1))  # bytes of i64 indices
     span = jnp.arange(layout.fixed_end, dtype=jnp.int64)[None, :]
     for r0 in range(0, n, chunk):
         r1 = min(r0 + chunk, n)

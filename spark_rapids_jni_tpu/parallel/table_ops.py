@@ -202,24 +202,28 @@ def exchange_table(
     if capacity is None:
         capacity = default_capacity(per_shard, n_parts)
 
-    # route on the already-encoded data lanes (+ validity as a lane so
-    # null keys co-locate); null rows' garbage data is masked to 0 so
-    # every null key hashes identically
-    key_lanes = []
+    # keys are derived INSIDE the body from the payload lanes at these
+    # positions (no duplicate key operands through shard_map); null
+    # rows' garbage data is masked to 0 so every null key hashes
+    # identically, and the validity lane joins the hash chain so null
+    # keys co-locate
+    key_pos = []
     for k in key_cols:
         ki = table.names.index(k)
-        data = lanes[lane_pos[ki]]
-        if has_v[ki]:
-            validity = lanes[lane_pos[ki] + 1]
-            key_lanes.append(jnp.where(validity, data, jnp.zeros((), data.dtype)))
-            key_lanes.append(validity.astype(jnp.int32))
-        else:
-            key_lanes.append(data)
+        key_pos.append((lane_pos[ki], lane_pos[ki] + 1 if has_v[ki] else None))
 
     def body(*arrs):
-        nk = len(key_lanes)
-        ks, pres, payload = arrs[:nk], arrs[nk], arrs[nk + 1 :]
-        dest = _hash_dest_multi(list(ks), n_parts)
+        pres, payload = arrs[0], arrs[1:]
+        ks = []
+        for dpos, vpos in key_pos:
+            data = payload[dpos]
+            if vpos is not None:
+                validity = payload[vpos]
+                ks.append(jnp.where(validity, data, jnp.zeros((), data.dtype)))
+                ks.append(validity.astype(jnp.int32))
+            else:
+                ks.append(data)
+        dest = _hash_dest_multi(ks, n_parts)
         a2a = lambda x: lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
         outs = []
         ovf = jnp.zeros((), bool)
@@ -236,10 +240,10 @@ def exchange_table(
     f = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec,) * (len(key_lanes) + 1 + len(lanes)),
+        in_specs=(spec,) * (1 + len(lanes)),
         out_specs=(spec,) * (len(lanes) + 2),
     )
-    *received, recv_mask, ovf = f(*key_lanes, present, *lanes)
+    *received, recv_mask, ovf = f(present, *lanes)
 
     # compact received slots (host boundary of the eager op tier)
     keep = np.asarray(recv_mask)
@@ -268,7 +272,10 @@ def _float_lane(col: Column) -> jnp.ndarray:
 
 def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capacity: int):
     """Static-shape multi-aggregate groupby (shard-local). Returns
-    (key_arrays[capacity], agg_arrays, group_valid, overflow)."""
+    (key_arrays[capacity], agg_arrays, agg_valid_arrays, group_valid,
+    overflow). An aggregate over a group whose values are ALL null is
+    itself null (Spark) — agg_valid carries that; count is the
+    exception (0, always valid)."""
     order = jnp.lexsort(tuple(reversed(list(key_arrays))) + (~present,))
     ks = [k[order] for k in key_arrays]
     ps = present[order]
@@ -283,9 +290,11 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
     seg = jnp.where(ps, jnp.clip(seg, 0, capacity - 1), capacity)
 
     aggs = []
+    agg_valid = []
     for v, how, vp in zip(val_arrays, hows, val_present):
         vs = v[order]
         vps = (ps & vp[order]) if vp is not None else ps
+        cnt = jax.ops.segment_sum(vps.astype(jnp.int64), seg, num_segments=capacity + 1)[:capacity]
         if how in ("sum", "mean"):
             x = jnp.where(vps, vs, 0)
             if jnp.issubdtype(x.dtype, jnp.integer):
@@ -294,15 +303,12 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
             if how == "sum":
                 aggs.append(s)
             else:
-                cnt = jax.ops.segment_sum(
-                    vps.astype(jnp.int64), seg, num_segments=capacity + 1
-                )[:capacity]
                 fdt = jnp.float64 if bitutils.backend_has_f64() else jnp.float32
                 aggs.append(s.astype(fdt) / jnp.maximum(cnt, 1).astype(fdt))
+            agg_valid.append(cnt > 0)
         elif how == "count":
-            aggs.append(
-                jax.ops.segment_sum(vps.astype(jnp.int64), seg, num_segments=capacity + 1)[:capacity]
-            )
+            aggs.append(cnt)
+            agg_valid.append(jnp.ones((capacity,), bool))
         elif how in ("min", "max"):
             if jnp.issubdtype(vs.dtype, jnp.integer):
                 fill = jnp.iinfo(vs.dtype).max if how == "min" else jnp.iinfo(vs.dtype).min
@@ -311,6 +317,7 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
             x = jnp.where(vps, vs, fill)
             f = jax.ops.segment_min if how == "min" else jax.ops.segment_max
             aggs.append(f(x, seg, num_segments=capacity + 1)[:capacity])
+            agg_valid.append(cnt > 0)
         else:
             raise ValueError(f"unknown agg {how!r} (supported: {_AGG_HOWS})")
 
@@ -319,7 +326,7 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
         for k, kk in zip(key_arrays, ks)
     ]
     group_valid = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-    return out_keys, aggs, group_valid, overflow
+    return out_keys, aggs, agg_valid, group_valid, overflow
 
 
 @op_boundary("distributed_groupby_table")
@@ -441,21 +448,27 @@ def _groupby_once(
                 j += 1
             else:
                 vp_full.append(None)
-        gks, gas, gv, ovf2 = _shard_groupby_aggs(kr, vr, hows, mr, vp_full, cap_g)
-        return tuple(gk[None] for gk in gks) + tuple(a[None] for a in gas) + (gv[None], (ovf | ovf2)[None])
+        gks, gas, gavs, gv, ovf2 = _shard_groupby_aggs(kr, vr, hows, mr, vp_full, cap_g)
+        return (
+            tuple(gk[None] for gk in gks)
+            + tuple(a[None] for a in gas)
+            + tuple(av[None] for av in gavs)
+            + (gv[None], (ovf | ovf2)[None])
+        )
 
     spec = P(axis)
     f = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec,) * (n_keys + 1 + n_vals + len(valid_lanes)),
-        out_specs=(spec,) * (n_keys + n_vals + 2),
+        out_specs=(spec,) * (n_keys + 2 * n_vals + 2),
     )
     outs = f(*key_lanes, present, *val_lanes, *valid_lanes)
     gks = outs[:n_keys]
     gas = outs[n_keys : n_keys + n_vals]
-    gv = np.asarray(outs[n_keys + n_vals]).reshape(-1)
-    ovf = bool(np.asarray(outs[n_keys + n_vals + 1]).any())
+    gavs = outs[n_keys + n_vals : n_keys + 2 * n_vals]
+    gv = np.asarray(outs[n_keys + 2 * n_vals]).reshape(-1)
+    ovf = bool(np.asarray(outs[n_keys + 2 * n_vals + 1]).any())
 
     sel = jnp.asarray(np.flatnonzero(gv))
     cols: List[Column] = []
@@ -470,19 +483,21 @@ def _groupby_once(
             li += 1
         cols.append(_rebuild(meta, data, validity))
         names.append(kname)
-    for (oname, how), g, (vname, _h, _o) in zip(out_meta, gas, aggs):
+    for (oname, how), g, gav, (vname, _h, _o) in zip(out_meta, gas, gavs, aggs):
         arr = jnp.asarray(g).reshape(-1)[sel]
+        av = jnp.asarray(gav).reshape(-1)[sel]
+        validity = None if bool(jnp.all(av)) else av  # all-null groups
         src = table.column(vname)
         if how in ("sum", "min", "max") and src.dtype.id == TypeId.FLOAT64:
-            cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64)))
+            cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
         elif how == "mean":
-            cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64)))
+            cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
         elif how == "count":
             cols.append(Column(dt.INT64, data=arr))
         elif jnp.issubdtype(arr.dtype, jnp.integer) and how == "sum":
-            cols.append(Column(dt.INT64, data=arr.astype(jnp.int64)))
+            cols.append(Column(dt.INT64, data=arr.astype(jnp.int64), validity=validity))
         else:
-            cols.append(Column(src.dtype, data=arr))
+            cols.append(Column(src.dtype, data=arr, validity=validity))
         names.append(oname)
     return Table(cols, names=names), ovf
 

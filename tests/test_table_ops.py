@@ -202,3 +202,32 @@ def test_q95_distributed_matches_single_chip(mesh8):
     assert got["order_count"] == want["order_count"]
     np.testing.assert_allclose(got["total_shipping_cost"], want["total_shipping_cost"], rtol=1e-9)
     np.testing.assert_allclose(got["total_net_profit"], want["total_net_profit"], rtol=1e-9)
+
+
+def test_groupby_all_null_group_is_null(mesh8):
+    # group 1's values are ALL null: Spark returns NULL for sum/min/max/
+    # mean and 0 for count
+    keys = np.array([0, 0, 1, 1, 2], np.int64)
+    vals = np.array([5, 7, 99, 98, 3], np.int64)
+    vvalid = np.array([True, True, False, False, True])
+    t = Table(
+        [_int_col(keys, dt.INT64), _int_col(vals, dt.INT64, validity=vvalid)],
+        ["k", "v"],
+    )
+    out, ovf = distributed_groupby_table(
+        t, ["k"],
+        [("v", "sum", "s"), ("v", "min", "mn"), ("v", "max", "mx"),
+         ("v", "mean", "avg"), ("v", "count", "c")],
+        mesh8,
+    )
+    assert not ovf
+    rows = {k: i for i, k in enumerate(out.column("k").to_pylist())}
+    assert set(rows) == {0, 1, 2}
+    for name in ("s", "mn", "mx", "avg"):
+        col = out.column(name).to_pylist()
+        assert col[rows[1]] is None, name
+        assert col[rows[0]] is not None, name
+    assert out.column("c").to_pylist()[rows[1]] == 0
+    assert out.column("s").to_pylist()[rows[0]] == 12
+    assert out.column("mn").to_pylist()[rows[0]] == 5
+    assert out.column("mx").to_pylist()[rows[0]] == 7
