@@ -107,6 +107,22 @@ class Column:
         return len(self)
 
     @property
+    def max_char_len(self) -> int:
+        """Max byte length across rows (STRING columns): the padded-
+        matrix width every string kernel needs. Memoized — at most one
+        device sync per column, and host-side constructors prepopulate
+        it for free (through a remote backend the sync is a full RTT)."""
+        ml = self.__dict__.get("_max_char_len")
+        if ml is None:
+            if len(self) == 0:
+                ml = 0
+            else:
+                offs = self.offsets
+                ml = int(jnp.max(offs[1:] - offs[:-1]))
+            self._max_char_len = ml
+        return ml
+
+    @property
     def null_count(self) -> int:
         if self.validity is None:
             return 0
@@ -142,12 +158,16 @@ class Column:
             offsets = np.zeros(n + 1, dtype=np.int32)
             np.cumsum(lens, out=offsets[1:])
             chars = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy()
-            return cls(
+            col = cls(
                 dtype,
                 validity=validity,
                 offsets=jnp.asarray(offsets),
                 chars=jnp.asarray(chars),
             )
+            # free while host-side: saves ops/strings.to_padded a device
+            # sync (a full RTT on remote backends) per op
+            col._max_char_len = int(lens.max()) if n else 0
+            return col
         if tid == TypeId.DECIMAL128:
             unscaled = [0 if v is None else _to_unscaled(v, dtype.scale) for v in values]
             return cls(dtype, data=jnp.asarray(_pack_decimal128_host(unscaled)), validity=validity)

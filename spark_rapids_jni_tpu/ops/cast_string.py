@@ -74,9 +74,7 @@ def _padded_chars(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     """[N, L] uint8 padded char matrix + [N] lengths. Pad byte is 0."""
     offs = col.offsets
     lens = offs[1:] - offs[:-1]
-    n = len(col)
-    max_len = int(jnp.max(lens)) if n else 0  # host sync: batch size class
-    max_len = max(max_len, 1)
+    max_len = max(col.max_char_len, 1)  # memoized batch size class
     idx = offs[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
     inb = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
     chars = jnp.where(inb, col.chars[jnp.clip(idx, 0, max(col.chars.shape[0] - 1, 0))], 0)

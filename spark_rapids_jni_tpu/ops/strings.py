@@ -46,14 +46,17 @@ def _check_string(col: Column) -> None:
 
 
 def to_padded(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Ragged -> ([N, L] uint8 right-padded with 0, [N] int32 lengths)."""
+    """Ragged -> ([N, L] uint8 right-padded with 0, [N] int32 lengths).
+    Width comes from the memoized ``Column.max_char_len`` (the per-call
+    device sync here used to dominate whole kernels through the
+    tunnel)."""
     _check_string(col)
     offs = col.offsets
     lens = offs[1:] - offs[:-1]
     n = len(col)
     if n == 0:
         return jnp.zeros((0, 1), jnp.uint8), jnp.zeros((0,), jnp.int32)
-    max_len = max(int(jnp.max(lens)), 1)
+    max_len = max(col.max_char_len, 1)
     idx = offs[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
     inb = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
     nchars = max(int(col.chars.shape[0]), 1)

@@ -483,10 +483,12 @@ def _groupby_once(
             li += 1
         cols.append(_rebuild(meta, data, validity))
         names.append(kname)
+    sel_np = np.flatnonzero(gv)
     for (oname, how), g, gav, (vname, _h, _o) in zip(out_meta, gas, gavs, aggs):
         arr = jnp.asarray(g).reshape(-1)[sel]
-        av = jnp.asarray(gav).reshape(-1)[sel]
-        validity = None if bool(jnp.all(av)) else av  # all-null groups
+        # all-null-group detection on the host pull (no extra device sync)
+        av_np = np.asarray(gav).reshape(-1)[sel_np]
+        validity = None if av_np.all() else jnp.asarray(av_np)
         src = table.column(vname)
         if how in ("sum", "min", "max") and src.dtype.id == TypeId.FLOAT64:
             cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
