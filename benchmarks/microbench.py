@@ -94,6 +94,89 @@ def _report(name: str, rows: int, cols: int, secs: float, nbytes: int) -> None:
     )
 
 
+def _chained_secs(run, reps: int, k_short: int = 1, k_long: int = 9) -> float:
+    """Two-length chained-timing scaffold (bench.py discipline): run(k)
+    must execute a k-iteration data-dependent device chain and block on
+    a real host pull; the length difference cancels fixed latency."""
+    run(k_short), run(k_long)  # compile both lengths
+    ts, tl = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); run(k_short); ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); run(k_long); tl.append(time.perf_counter() - t0)
+    return max((float(np.median(tl)) - float(np.median(ts))) / (k_long - k_short), 1e-9)
+
+
+def _chained_transcode_secs(table, reps: int) -> float:
+    """Latency-cancelling protocol for the encode axis (bench.py
+    discipline): a data-dependent on-device chain at two lengths; the
+    difference isolates per-iteration device time even when a remote
+    backend acknowledges block_until_ready before completion. Only
+    valid for single-batch (<2GiB) tables."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.ops import row_conversion as rc
+    from functools import partial
+
+    layout = rc.compute_row_layout(table.dtypes())
+    n = table.num_rows
+    cols = tuple(table.columns)
+
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(c0_data, rest, iters: int):
+        # `rest` rides as a pytree ARG (closing over 211 device arrays
+        # would bake ~1GB of constants into the HLO)
+        def body(_, carry):
+            cols2 = (Column(cols[0].dtype, data=carry, validity=cols[0].validity),) + tuple(rest)
+            blob = rc._to_rows_fixed(layout, cols2, n)
+            perturb = (blob[0] == 0).astype(carry.dtype)  # data dependency
+            return carry ^ perturb
+
+        return lax.fori_loop(0, iters, body, c0_data)
+
+    def run(k):
+        out = chain(cols[0].data, cols[1:], k)
+        return float(jnp.sum(out.astype(jnp.int32)))  # host pull: real completion
+
+    return _chained_secs(run, reps)
+
+
+def _chained_decode_secs(row_col, dtypes, reps: int) -> float:
+    """Chained-protocol decode (grouped form): each iteration's blob
+    depends on the previous decode's first output byte."""
+    import jax.numpy as jnp
+    from jax import lax
+    from functools import partial
+
+    from spark_rapids_jni_tpu.columnar import Column
+    from spark_rapids_jni_tpu.columnar import dtype as dtm
+    from spark_rapids_jni_tpu.ops import row_conversion as rc
+
+    dtypes = tuple(dtypes)
+    offsets = row_col.offsets
+    stride = getattr(row_col, "_uniform_stride", None)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def chain(blob0, iters: int):
+        def body(_, blob):
+            lc = Column(dtm.LIST, offsets=offsets, child=Column(dtm.INT8, data=blob))
+            if stride is not None:
+                lc._uniform_stride = stride  # skip the traced host probe
+            g = rc.convert_from_rows_grouped(lc, dtypes)
+            gv = g.groups[0] if isinstance(g.groups, (list, tuple)) else next(iter(g.groups.values()))
+            first = gv.reshape(-1)[0]  # data dependency
+            return blob.at[0].set(blob[0] ^ first.astype(blob.dtype))
+
+        return lax.fori_loop(0, iters, body, blob0)
+
+    def run(k):
+        out = chain(row_col.child.data, k)
+        return float(out.reshape(-1)[0])  # host pull: real completion
+
+    return _chained_secs(run, reps)
+
+
 def bench_row_conversion_fixed(rows: int, reps: int, cols: int = 212) -> None:
     from spark_rapids_jni_tpu.ops import row_conversion as rc
 
@@ -117,6 +200,15 @@ def bench_row_conversion_fixed(rows: int, reps: int, cols: int = 212) -> None:
         lambda: [rc.convert_from_rows_grouped(b, dtypes).groups for b in row_cols], reps
     )
     _report("row_conversion_fixed_from_rows_grouped", rows, cols, secs, nbytes)
+
+    # chained (trusted) variants LAST: their loop state churns the
+    # allocator enough to distort any axis measured after them
+    if len(row_cols) == 1:
+        secs = _chained_decode_secs(row_cols[0], dtypes, max(reps // 2, 2))
+        _report("row_conversion_fixed_from_rows_chained", rows, cols, secs, nbytes)
+    if rows * rc.compute_row_layout(table.dtypes()).row_size_fixed < rc.MAX_BATCH_BYTES:
+        secs = _chained_transcode_secs(table, max(reps // 2, 2))
+        _report("row_conversion_fixed_to_rows_chained", rows, cols, secs, nbytes)
 
 
 def bench_row_conversion_mixed(rows: int, reps: int, cols: int = 155, strings: bool = True) -> None:

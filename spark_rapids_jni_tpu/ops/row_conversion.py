@@ -377,8 +377,7 @@ def convert_to_rows(table: Table) -> List[Column]:
         batches = _batch_boundaries(row_sizes)
         out = []
         for rs, re, _ in batches:
-            batch_cols = [_slice_column(c, rs, re) for c in cols]
-            blob = _jit_to_rows_fixed(layout, tuple(batch_cols), re - rs)
+            blob = _jit_to_rows_fixed_sliced(layout, tuple(cols), rs, re - rs)
             rel = jnp.arange(re - rs + 1, dtype=jnp.int32) * row_size
             out.append(_wrap_batch_as_list_column(blob, rel, uniform_stride=row_size))
         return out
@@ -805,6 +804,19 @@ def _jit_gather_fixed(blob, starts, fixed_end: int, n: int):
     return _jit_gather_fixed_impl(blob, starts, jnp.arange(fixed_end, dtype=jnp.int64))
 
 
-@partial(jax.jit, static_argnums=(0, 2))
-def _jit_to_rows_fixed(layout: RowLayout, cols: Tuple[Column, ...], n: int):
-    return _to_rows_fixed(layout, cols, n)
+@partial(jax.jit, static_argnums=(0, 3))
+def _jit_to_rows_fixed_sliced(layout: RowLayout, cols: Tuple[Column, ...],
+                              rs, n: int):
+    """Batch encode with the row slicing INSIDE the program: per-column
+    eager slices cost one dispatch each (212 columns x batches of
+    round-trips through a remote backend dominated the >2GiB axis).
+    Only the batch LENGTH is static (shapes need it); the start rides
+    as a traced scalar so a many-batch table compiles once per distinct
+    size, not once per offset."""
+    sliced = tuple(
+        Column(c.dtype, data=lax.dynamic_slice_in_dim(c.data, rs, n),
+               validity=None if c.validity is None
+               else lax.dynamic_slice_in_dim(c.validity, rs, n))
+        for c in cols
+    )
+    return _to_rows_fixed(layout, sliced, n)

@@ -484,10 +484,13 @@ def _groupby_once(
         cols.append(_rebuild(meta, data, validity))
         names.append(kname)
     sel_np = np.flatnonzero(gv)
-    for (oname, how), g, gav, (vname, _h, _o) in zip(out_meta, gas, gavs, aggs):
+    # ONE host transfer for every aggregate's validity lane (K separate
+    # np.asarray pulls would block once per aggregate on a remote
+    # backend); nulls re-upload only for the rare all-null-group case
+    gavs_h = jax.device_get(list(gavs))
+    for (oname, how), g, gav_h, (vname, _h, _o) in zip(out_meta, gas, gavs_h, aggs):
         arr = jnp.asarray(g).reshape(-1)[sel]
-        # all-null-group detection on the host pull (no extra device sync)
-        av_np = np.asarray(gav).reshape(-1)[sel_np]
+        av_np = gav_h.reshape(-1)[sel_np]
         validity = None if av_np.all() else jnp.asarray(av_np)
         src = table.column(vname)
         if how in ("sum", "min", "max") and src.dtype.id == TypeId.FLOAT64:
