@@ -203,10 +203,9 @@ def bench_row_conversion_fixed(rows: int, reps: int, cols: int = 212) -> None:
 
     # chained (trusted) variants LAST: their loop state churns the
     # allocator enough to distort any axis measured after them
-    if len(row_cols) == 1:
+    if len(row_cols) == 1:  # single batch (the chains assume one program)
         secs = _chained_decode_secs(row_cols[0], dtypes, max(reps // 2, 2))
         _report("row_conversion_fixed_from_rows_chained", rows, cols, secs, nbytes)
-    if len(row_cols) == 1:  # single batch, per the authoritative split
         secs = _chained_transcode_secs(table, max(reps // 2, 2))
         _report("row_conversion_fixed_to_rows_chained", rows, cols, secs, nbytes)
 
@@ -293,7 +292,7 @@ def main() -> None:
     args = p.parse_args()
     # row_conversion_fixed runs LAST: its chained variants leave loop
     # state that distorts axes measured after them in the same process
-    all_order = ["cast_string", "groupby", "row_conversion_mixed", "tpch", "row_conversion_fixed"]
+    all_order = sorted(_BENCHES, key=lambda nm: (nm == "row_conversion_fixed", nm))
     names: List[str] = all_order if args.bench == "all" else [args.bench]
     for name in names:
         _BENCHES[name](args.rows, args.reps)

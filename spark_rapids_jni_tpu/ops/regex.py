@@ -63,6 +63,7 @@ __all__ = [
     "matches_re",
     "extract_re",
     "split_re",
+    "replace_re",
 ]
 
 _NCP = MAX_CODEPOINT + 1
@@ -769,6 +770,8 @@ def split_re(col: Column, pattern: str, limit: int = -1) -> List[Column]:
 
     matched, _, last_end = _all_starts(prog, cp, cp_lens, endmask=None)
     hit = matched & (parr <= cp_lens[:, None])
+    if prog.anchor_start:  # '^' matches only the string start
+        hit = hit & (parr == 0)
     sep_end = jnp.maximum(last_end, parr)  # greedy end per start
 
     # next separator-match start at/after q: suffix-min over hit starts
@@ -822,3 +825,27 @@ def split_re(col: Column, pattern: str, limit: int = -1) -> List[Column]:
         v = valid_t if col.validity is None else (valid_t & col.validity)
         cols.append(Column(dt.STRING, validity=v, offsets=out.offsets, chars=out.chars))
     return cols
+
+
+@op_boundary("strings.replace_re")
+def replace_re(col: Column, pattern: str, replacement: bytes) -> Column:
+    """Spark regexp_replace(col, pattern, replacement) for patterns that
+    cannot match the empty string (zero-width matches change Java's
+    splice semantics in ways the split decomposition can't express —
+    they raise). Literal replacement only (no backrefs).
+
+    Rides the split machinery: text between separator matches, rejoined
+    with the replacement as the glue (concat_ws semantics keep absent
+    token slots silent and propagate null inputs correctly).
+    """
+    prog = compile_pattern(pattern)
+    if bool(prog.accept[0]):
+        raise ValueError("replace_re: pattern matches the empty string")
+    if isinstance(replacement, str):
+        replacement = replacement.encode()
+    from .strings import concat
+
+    toks = split_re(col, pattern, -1)
+    out = concat(toks, separator=replacement, null_policy="skip")
+    # concat_ws never yields null; restore the input's nulls
+    return Column(dt.STRING, validity=col.validity, offsets=out.offsets, chars=out.chars)

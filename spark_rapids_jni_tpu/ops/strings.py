@@ -34,6 +34,7 @@ __all__ = [
     "concat",
     "concat_ws",
     "contains",
+    "instr",
     "startswith",
     "endswith",
     "strip",
@@ -57,9 +58,11 @@ def to_padded(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
     if n == 0:
         return jnp.zeros((0, 1), jnp.uint8), jnp.zeros((0,), jnp.int32)
     max_len = max(col.max_char_len, 1)
+    nchars = int(col.chars.shape[0])
+    if nchars == 0:  # every row empty (or null): nothing to gather
+        return jnp.zeros((n, max_len), jnp.uint8), lens.astype(jnp.int32)
     idx = offs[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
     inb = jnp.arange(max_len, dtype=jnp.int32)[None, :] < lens[:, None]
-    nchars = max(int(col.chars.shape[0]), 1)
     padded = jnp.where(inb, col.chars[jnp.clip(idx, 0, nchars - 1)], 0)
     return padded, lens.astype(jnp.int32)
 
@@ -312,3 +315,31 @@ def strip(col: Column) -> Column:
     src = jnp.clip(begin[:, None] + j, 0, L - 1)
     out = jnp.where(j < out_lens[:, None], jnp.take_along_axis(padded, src, axis=1), 0)
     return from_padded(out, out_lens, col.validity)
+
+
+@op_boundary("strings.instr")
+def instr(col: Column, pattern: bytes) -> Column:
+    """Spark instr/locate: 1-based CHARACTER position of the first
+    literal occurrence, 0 when absent (empty pattern -> 1). A valid
+    UTF-8 needle can only match at character boundaries, so the byte
+    hit converts to a character index by counting lead bytes before it."""
+    _check_string(col)
+    padded, lens = to_padded(col)
+    n, L = padded.shape
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None, :], (n, L))
+    hits = _match_at(padded, lens, pattern, pos)
+    any_hit = jnp.any(hits, axis=1)
+    first = jnp.argmax(hits, axis=1).astype(jnp.int32)
+    # byte position -> character position: lead (non-continuation)
+    # bytes strictly before the hit
+    lead = ((padded & 0xC0) != 0x80) & (pos < lens[:, None])
+    cum = jnp.cumsum(lead.astype(jnp.int32), axis=1)
+    chars_before = jnp.where(
+        first > 0,
+        jnp.take_along_axis(cum, jnp.clip(first - 1, 0, L - 1)[:, None], axis=1)[:, 0],
+        0,
+    )
+    out = jnp.where(any_hit, chars_before + 1, 0)
+    if len(pattern) == 0:
+        out = jnp.ones((n,), jnp.int32)
+    return Column(dt.INT32, data=out, validity=col.validity)

@@ -220,3 +220,54 @@ def test_large_batch_smoke(rng):
     got = bools(rx.contains_re(col(vals), pattern))
     want = [bool(re.search(pattern, s)) for s in vals]
     assert got == want
+
+
+REPLACE_CASES = [
+    (r"\d+", "#"),
+    (r"[aeiou]", "_"),
+    (r"-", "--"),
+    (r"\s+", " "),
+    (r"l+", ""),
+]
+
+
+@pytest.mark.parametrize("pattern,rep", REPLACE_CASES)
+def test_replace_re(pattern, rep):
+    got = got_strings(rx.replace_re(col(CORPUS), pattern, rep.encode()))
+    want = [None if s is None else re.sub(pattern, rep, s) for s in CORPUS]
+    assert got == want, (pattern, rep)
+
+
+def test_replace_re_rejects_empty_match():
+    with pytest.raises(ValueError):
+        rx.replace_re(col(["abc"]), r"x*", b"-")
+
+
+def test_instr():
+    from spark_rapids_jni_tpu.ops import strings as ss
+
+    c = col(["hello world", "", None, "aXbXc"])
+    got = ss.instr(c, b"X")
+    data = np.asarray(got.data)
+    valid = np.asarray(got.validity)
+    assert data[0] == 0 and data[3] == 2
+    assert not valid[2]
+    assert np.asarray(ss.instr(c, b"o").data)[0] == 5  # 1-based
+    assert np.asarray(ss.instr(c, b"").data).tolist() == [1, 1, 1, 1]
+
+
+def test_split_and_replace_respect_start_anchor():
+    # '^' must only match the string start (was matching mid-string)
+    assert got_strings(rx.replace_re(col(["xa", "ab"]), r"^a", b"-")) == ["xa", "-b"]
+    toks = rx.split_re(col(["xa"]), r"^a")
+    row = [got_strings(t)[0] for t in toks if got_strings(t)[0] is not None]
+    assert row == ["xa"]
+
+
+def test_instr_character_position_utf8():
+    from spark_rapids_jni_tpu.ops import strings as ss
+
+    c = col(["ça", "日本語x語"])
+    assert np.asarray(ss.instr(c, "a".encode()).data)[0] == 2  # char pos, not byte
+    assert np.asarray(ss.instr(c, "x".encode()).data)[1] == 4
+    assert np.asarray(ss.instr(c, "語".encode()).data)[1] == 3  # first occurrence
