@@ -11,6 +11,7 @@
 #include "handle_registry.h"
 #include "host_buffer.h"
 #include "parquet_footer.h"
+#include "lz4.h"
 #include "snappy.h"
 
 #define SRJT_EXPORT extern "C" __attribute__((visibility("default")))
@@ -165,6 +166,13 @@ SRJT_EXPORT int32_t srjt_snappy_uncompress(const uint8_t* src, int64_t src_len, 
         return 0;
       },
       -1));
+}
+
+SRJT_EXPORT int64_t srjt_lz4_decompress_block(const uint8_t* src, int64_t src_len,
+                                              uint8_t* dst, int64_t dst_capacity) {
+  return guarded(
+      [&]() -> int64_t { return srjt::lz4_decompress_block(src, src_len, dst, dst_capacity); },
+      -1);
 }
 
 // -- columnar engine ---------------------------------------------------------

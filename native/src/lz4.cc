@@ -1,0 +1,66 @@
+#include "lz4.h"
+
+#include <cstring>
+
+namespace srjt {
+
+// LZ4 block format: a sequence of
+//   [token: hi-nibble literal_len, lo-nibble match_len-4]
+//   [literal_len extension bytes while 255]
+//   [literals]
+//   [2-byte LE match offset][match_len extension bytes while 255]
+//   [implicit match copy]
+// The final sequence carries literals only (no offset).
+int64_t lz4_decompress_block(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                             int64_t dst_capacity) {
+  int64_t ip = 0;
+  int64_t op = 0;
+  if (src_len == 0) return 0;
+  while (ip < src_len) {
+    const uint8_t token = src[ip++];
+    // literals
+    int64_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= src_len) throw Lz4Error("lz4: truncated literal length");
+        b = src[ip++];
+        lit += b;
+      } while (b == 255);
+    }
+    if (ip + lit > src_len) throw Lz4Error("lz4: literal run past input");
+    if (op + lit > dst_capacity) throw Lz4Error("lz4: output overflow (literals)");
+    std::memcpy(dst + op, src + ip, static_cast<size_t>(lit));
+    ip += lit;
+    op += lit;
+    if (ip == src_len) break;  // last sequence: literals only
+
+    // match
+    if (ip + 2 > src_len) throw Lz4Error("lz4: truncated match offset");
+    const int64_t offset = static_cast<int64_t>(src[ip]) | (static_cast<int64_t>(src[ip + 1]) << 8);
+    ip += 2;
+    if (offset == 0 || offset > op) throw Lz4Error("lz4: invalid match offset");
+    int64_t mlen = (token & 0x0F) + 4;
+    if ((token & 0x0F) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= src_len) throw Lz4Error("lz4: truncated match length");
+        b = src[ip++];
+        mlen += b;
+      } while (b == 255);
+    }
+    if (op + mlen > dst_capacity) throw Lz4Error("lz4: output overflow (match)");
+    // overlapping copy must be byte-serial when offset < mlen
+    const uint8_t* from = dst + op - offset;
+    if (offset >= mlen) {
+      std::memcpy(dst + op, from, static_cast<size_t>(mlen));
+      op += mlen;
+    } else {
+      for (int64_t i = 0; i < mlen; ++i) dst[op + i] = from[i];
+      op += mlen;
+    }
+  }
+  return op;
+}
+
+}  // namespace srjt

@@ -1,0 +1,23 @@
+// LZ4 block-format decompressor — companion to the snappy codec in the
+// native compression tier (reference ships nvcomp, pom.xml:464-469;
+// ORC and parquet both use LZ4 block framing). Implemented from the
+// public LZ4 block format description; no third-party code.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace srjt {
+
+struct Lz4Error : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Decompress one LZ4 block into dst. Returns the number of bytes
+// written (<= dst_capacity). Throws Lz4Error on malformed input or if
+// the output would exceed dst_capacity.
+int64_t lz4_decompress_block(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                             int64_t dst_capacity);
+
+}  // namespace srjt

@@ -117,7 +117,7 @@ def _packed_varints(vals: list) -> List[int]:
 _K_NONE, _K_ZLIB, _K_SNAPPY, _K_LZO, _K_LZ4, _K_ZSTD = 0, 1, 2, 3, 4, 5
 
 
-def _decompress_block(kind: int, blob: bytes) -> bytes:
+def _decompress_block(kind: int, blob: bytes, block_size: int) -> bytes:
     if kind == _K_ZLIB:
         return zlib.decompress(blob, -15)  # raw deflate
     if kind == _K_SNAPPY:
@@ -128,15 +128,22 @@ def _decompress_block(kind: int, blob: bytes) -> bytes:
         import pyarrow as pa
 
         return pa.Codec("snappy").decompress(blob).to_pybytes()
+    if kind == _K_LZ4:
+        # LZ4 block; decompressed chunk is bounded by compressionBlockSize
+        from .. import runtime
+
+        if runtime.native_available():
+            return runtime.lz4_decompress_block(blob, max(block_size, 1 << 18))
+        raise OrcReadError("LZ4 ORC needs the native runtime (cmake native/)")
     if kind == _K_ZSTD:
         import pyarrow as pa
 
         # zstd frames carry no decompressed size in ORC chunks — stream
         return pa.input_stream(pa.BufferReader(blob), compression="zstd").read()
-    raise OrcReadError(f"unsupported compression kind {kind} (LZO/LZ4 pending)")
+    raise OrcReadError(f"unsupported compression kind {kind} (LZO pending)")
 
 
-def _deframe(data: bytes, kind: int) -> bytes:
+def _deframe(data: bytes, kind: int, block_size: int = 1 << 18) -> bytes:
     """ORC compressed streams are chunked: 3-byte LE header =
     (length << 1) | isOriginal."""
     if kind == _K_NONE:
@@ -150,7 +157,7 @@ def _deframe(data: bytes, kind: int) -> bytes:
         ln = hdr >> 1
         chunk = data[pos : pos + ln]
         pos += ln
-        out.append(chunk if (hdr & 1) else _decompress_block(kind, chunk))
+        out.append(chunk if (hdr & 1) else _decompress_block(kind, chunk, block_size))
     return b"".join(out)
 
 
@@ -384,8 +391,9 @@ def _parse_tail(data: bytes):
     ps = _pb_dict(data[-1 - ps_len : -1])
     footer_len = ps.get(1, [0])[0]
     kind = ps.get(2, [_K_NONE])[0]
+    block_size = ps.get(3, [1 << 18])[0]
     footer_raw = data[-1 - ps_len - footer_len : -1 - ps_len]
-    footer = _pb_dict(_deframe(footer_raw, kind))
+    footer = _pb_dict(_deframe(footer_raw, kind, block_size))
 
     types: List[_TypeNode] = []
     for traw in footer.get(4, []):
@@ -410,7 +418,7 @@ def _parse_tail(data: bytes):
             )
         )
     num_rows = footer.get(6, [0])[0]
-    return types, stripes, kind, num_rows
+    return types, stripes, kind, num_rows, block_size
 
 
 # ---------------------------------------------------------------------------
@@ -431,13 +439,15 @@ def _scatter_present(values: np.ndarray, present: Optional[np.ndarray], fill=0) 
 
 
 class _StripeReader:
-    def __init__(self, data: bytes, stripe: _Stripe, kind: int):
+    def __init__(self, data: bytes, stripe: _Stripe, kind: int, block_size: int = 1 << 18):
         self.kind = kind
+        self.block_size = block_size
         foot = _pb_dict(
             _deframe(
                 data[stripe.offset + stripe.index_len + stripe.data_len :
                      stripe.offset + stripe.index_len + stripe.data_len + stripe.footer_len],
                 kind,
+                block_size,
             )
         )
         self.encodings = []
@@ -458,7 +468,7 @@ class _StripeReader:
 
     def stream(self, col: int, skind: int) -> Optional[bytes]:
         raw = self.streams.get((col, skind))
-        return None if raw is None else _deframe(raw, self.kind)
+        return None if raw is None else _deframe(raw, self.kind, self.block_size)
 
     def present(self, col: int) -> Optional[np.ndarray]:
         raw = self.stream(col, _S_PRESENT)
@@ -526,7 +536,7 @@ def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
     """Read a flat-schema ORC file into a device Table."""
     if not file_bytes.startswith(b"ORC"):
         raise OrcReadError("not an ORC file")
-    types, stripes, kind, _num_rows = _parse_tail(file_bytes)
+    types, stripes, kind, _num_rows, block_size = _parse_tail(file_bytes)
     if not types or types[0].kind != _T_STRUCT:
         raise OrcReadError("ORC root must be a struct")
     root = types[0]
@@ -544,7 +554,7 @@ def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
             raise OrcReadError(f"columns not in schema: {sorted(missing)}")
         sel = [i for i, nm in enumerate(names) if nm in keep]
 
-    readers = [_StripeReader(file_bytes, s, kind) for s in stripes]
+    readers = [_StripeReader(file_bytes, s, kind, block_size) for s in stripes]
     out_cols, out_names = [], []
     for i in sel:
         col_id = root.subtypes[i]

@@ -130,12 +130,22 @@ _CMD_TOTAL_UNCOMPRESSED = 6
 def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> bytes:
     if codec is None:
         return data
+    # native codec tier first (nvcomp analog, native/src/{snappy,lz4}.cc)
     if codec == "snappy":
-        # native codec tier first (nvcomp analog, native/src/snappy.cc)
         from .. import runtime
 
         if runtime.native_available():
             return runtime.snappy_uncompress(data, uncompressed_size)
+    if codec == "lz4_raw":
+        from .. import runtime
+
+        if runtime.native_available():
+            out = runtime.lz4_decompress_block(data, uncompressed_size)
+            if len(out) != uncompressed_size:  # corrupt page: fail loudly
+                raise ParquetReadError(
+                    f"lz4 page decoded to {len(out)} bytes, header says {uncompressed_size}"
+                )
+            return out
     import pyarrow as pa
 
     return pa.Codec(codec).decompress(data, decompressed_size=uncompressed_size).to_pybytes()

@@ -165,6 +165,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_divide_decimal128.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
     lib.srjt_byte_array_lens.restype = ctypes.c_int64
     lib.srjt_byte_array_lens.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
+    lib.srjt_lz4_decompress_block.restype = ctypes.c_int64
+    lib.srjt_lz4_decompress_block.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
     return lib
 
 
@@ -189,6 +191,24 @@ def native_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return native_lib() is not None
+
+
+def lz4_decompress_block(data: bytes, dst_capacity: int) -> bytes:
+    """Decompress one LZ4 block via the native codec tier; the exact
+    output size need not be known (ORC/parquet only bound it)."""
+    import numpy as np
+
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    out = np.empty(max(dst_capacity, 1), np.uint8)
+    src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    n = lib.srjt_lz4_decompress_block(
+        src, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(out)
+    )
+    if n < 0:
+        _raise_last(lib)
+    return out[:n].tobytes()
 
 
 def byte_array_lens(page: bytes):

@@ -123,3 +123,11 @@ def test_nested_raises():
     t = pa.table({"l": pa.array([[1, 2]], pa.list_(pa.int64()))})
     with pytest.raises(OrcReadError):
         read_table(write(t))
+
+
+def test_lz4_codec_native():
+    from spark_rapids_jni_tpu import runtime
+
+    if not runtime.native_available():
+        pytest.skip("native runtime not built")
+    check_roundtrip(BASIC, compression="lz4")

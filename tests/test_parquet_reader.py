@@ -200,3 +200,7 @@ def test_nested_next_to_flat_selection():
     got = read_table(write(t), columns=["l"])
     assert got.names == ["l"]
     assert got.column("l").to_pylist() == t.column("l").to_pylist()
+
+
+def test_lz4_raw_codec():
+    check_roundtrip(BASIC, compression="lz4")  # pyarrow writes LZ4_RAW
