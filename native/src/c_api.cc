@@ -468,7 +468,8 @@ SRJT_EXPORT int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quo
 // lengths. The chain off_{k+1} = off_k + 4 + len_k is inherently
 // sequential, so it lives in C while the character gather runs on
 // device (io/parquet_reader.py). Returns the value count, or -1 on a
-// malformed page (parquet_reader raises ParquetReadError).
+// malformed page: capacity overflow, or a walk that ends before
+// consuming the whole buffer (truncated trailing value / garbage).
 SRJT_EXPORT int64_t srjt_byte_array_lens(const uint8_t* data, int64_t size, int32_t* out_lens,
                                          int64_t capacity) {
   int64_t pos = 0;
@@ -477,10 +478,11 @@ SRJT_EXPORT int64_t srjt_byte_array_lens(const uint8_t* data, int64_t size, int3
     uint32_t len = static_cast<uint32_t>(data[pos]) | (static_cast<uint32_t>(data[pos + 1]) << 8) |
                    (static_cast<uint32_t>(data[pos + 2]) << 16) |
                    (static_cast<uint32_t>(data[pos + 3]) << 24);
-    if (pos + 4 + static_cast<int64_t>(len) > size) break;
+    if (pos + 4 + static_cast<int64_t>(len) > size) return -1;
     if (count >= capacity) return -1;
     out_lens[count++] = static_cast<int32_t>(len);
     pos += 4 + len;
   }
+  if (pos != size) return -1;
   return count;
 }

@@ -198,7 +198,18 @@ def _bool_bits(data: bytes, count: int) -> np.ndarray:
 
 
 def _zigzag(u: np.ndarray) -> np.ndarray:
-    return (u >> 1) ^ -(u & 1)
+    """Zigzag decode in the UNSIGNED 64-bit domain: `u >> 1` must be a
+    logical shift of the raw encoding (an arithmetic shift on a negative
+    int64 reinterpretation corrupts every value with |v| >= 2^62)."""
+    uu = np.asarray(u, dtype=np.int64).view(np.uint64)
+    dec = (uu >> np.uint64(1)) ^ (np.uint64(0) - (uu & np.uint64(1)))
+    return dec.view(np.int64)
+
+
+def _zigzag_py(v: int) -> int:
+    """Zigzag decode of a raw unsigned Python int (any magnitude up to
+    2^64-1 — np.int64() would raise OverflowError above 2^63-1)."""
+    return (v >> 1) ^ -(v & 1)
 
 
 def _varints(data: bytes, pos: int, count: int) -> Tuple[np.ndarray, int]:
@@ -232,7 +243,7 @@ def _rle_v1(data: bytes, count: int, signed: bool) -> np.ndarray:
             base_arr, pos = _varints(data, pos, 1)
             base = int(base_arr[0])
             if signed:
-                base = int(_zigzag(np.int64(base)))
+                base = _zigzag_py(base & 0xFFFFFFFFFFFFFFFF)
             take = min(run, count - filled)
             out[filled : filled + take] = base + delta * np.arange(take, dtype=np.int64)
             filled += take
@@ -281,7 +292,7 @@ def _rle_v2(data: bytes, count: int, signed: bool) -> np.ndarray:
             pos += 1
             v = int.from_bytes(data[pos : pos + width], "big")
             pos += width
-            val = int(_zigzag(np.int64(v))) if signed else v
+            val = _zigzag_py(v) if signed else v
             take = min(run, count - filled)
             out[filled : filled + take] = val
             filled += take
@@ -301,10 +312,10 @@ def _rle_v2(data: bytes, count: int, signed: bool) -> np.ndarray:
             run = ((first & 1) << 8 | data[pos + 1]) + 1
             pos += 2
             r = _PB(data, pos)
-            base_u = r.varint()
-            base = int(_zigzag(np.int64(base_u))) if signed else base_u
-            delta_base_u = r.varint()
-            delta_base = int(_zigzag(np.int64(delta_base_u)))
+            base_u = r.varint() & 0xFFFFFFFFFFFFFFFF
+            base = _zigzag_py(base_u) if signed else base_u
+            delta_base_u = r.varint() & 0xFFFFFFFFFFFFFFFF
+            delta_base = _zigzag_py(delta_base_u)
             pos = r.pos
             vals = np.empty(run, np.int64)
             vals[0] = base

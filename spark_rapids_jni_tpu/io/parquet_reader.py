@@ -127,6 +127,48 @@ _CMD_NUM_VALUES = 5
 _CMD_TOTAL_UNCOMPRESSED = 6
 
 
+def _lz4_hadoop(data: bytes, uncompressed_size: int) -> Optional[bytes]:
+    """Legacy parquet codec 5 (LZ4) as written by Hadoop/parquet-mr:
+    repeated [u32 BE uncompressed size][u32 BE compressed size][raw LZ4
+    block]. Returns None when the framing does not validate (some
+    writers used the LZ4 frame format instead — caller falls back)."""
+    pos, n = 0, len(data)
+    parts: List[bytes] = []
+    total = 0
+    while pos < n:
+        if pos + 8 > n:
+            return None
+        (usize,) = struct.unpack_from(">I", data, pos)
+        (csize,) = struct.unpack_from(">I", data, pos + 4)
+        pos += 8
+        if csize == 0 or pos + csize > n or total + usize > uncompressed_size:
+            return None
+        block = data[pos : pos + csize]
+        pos += csize
+        try:
+            out = _lz4_raw_block(block, usize)
+        except Exception:
+            return None
+        if len(out) != usize:
+            return None
+        parts.append(out)
+        total += usize
+    if total != uncompressed_size:
+        return None
+    return b"".join(parts)
+
+
+def _lz4_raw_block(block: bytes, uncompressed_size: int) -> bytes:
+    """One raw LZ4 block via the native decoder, pyarrow as fallback."""
+    from .. import runtime
+
+    if runtime.native_available():
+        return runtime.lz4_decompress_block(block, uncompressed_size)
+    import pyarrow as pa
+
+    return pa.Codec("lz4_raw").decompress(block, decompressed_size=uncompressed_size).to_pybytes()
+
+
 def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> bytes:
     if codec is None:
         return data
@@ -136,16 +178,19 @@ def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> by
 
         if runtime.native_available():
             return runtime.snappy_uncompress(data, uncompressed_size)
-    if codec == "lz4_raw":
-        from .. import runtime
-
-        if runtime.native_available():
-            out = runtime.lz4_decompress_block(data, uncompressed_size)
-            if len(out) != uncompressed_size:  # corrupt page: fail loudly
-                raise ParquetReadError(
-                    f"lz4 page decoded to {len(out)} bytes, header says {uncompressed_size}"
-                )
+    if codec == "lz4":
+        # legacy codec 5: Hadoop block framing in the wild (parquet-mr);
+        # LZ4 *frame* format from other writers — try Hadoop first
+        out = _lz4_hadoop(data, uncompressed_size)
+        if out is not None:
             return out
+    if codec == "lz4_raw":
+        out = _lz4_raw_block(data, uncompressed_size)
+        if len(out) != uncompressed_size:  # corrupt page: fail loudly
+            raise ParquetReadError(
+                f"lz4 page decoded to {len(out)} bytes, header says {uncompressed_size}"
+            )
+        return out
     import pyarrow as pa
 
     return pa.Codec(codec).decompress(data, decompressed_size=uncompressed_size).to_pybytes()
@@ -287,16 +332,21 @@ def _byte_array_lens(page: bytes) -> np.ndarray:
     from .. import runtime
 
     if runtime.native_available() and hasattr(runtime, "byte_array_lens"):
-        return runtime.byte_array_lens(page)
+        try:
+            return runtime.byte_array_lens(page)
+        except RuntimeError as e:  # keep the module's error contract
+            raise ParquetReadError(str(e)) from e
     lens: List[int] = []
     pos = 0
     n = len(page)
     while pos + 4 <= n:
         (ln,) = struct.unpack_from("<I", page, pos)
         if pos + 4 + ln > n:
-            break
+            raise ParquetReadError("byte-array page: truncated trailing value")
         lens.append(ln)
         pos += 4 + ln
+    if pos != n:
+        raise ParquetReadError("byte-array page: trailing garbage")
     return np.asarray(lens, np.int32)
 
 

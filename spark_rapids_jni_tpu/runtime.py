@@ -230,7 +230,7 @@ def byte_array_lens(page: bytes):
         cap,
     )
     if n < 0:
-        raise RuntimeError("byte_array_lens: capacity overflow")
+        raise RuntimeError("byte_array_lens: malformed page (truncated value or overflow)")
     return out[:n].copy()
 
 

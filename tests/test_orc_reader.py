@@ -74,6 +74,36 @@ def test_large_int_runs_and_literals(rng):
     check_roundtrip(t)
 
 
+def test_int64_extremes():
+    """Values with |v| >= 2^62 exercise zigzag decode at the unsigned
+    64-bit boundary (advisor round-2 high finding: an arithmetic shift
+    on the signed reinterpretation silently corrupted these)."""
+    ext = [
+        -(2**63),  # Long.MIN_VALUE (real Spark sentinel)
+        2**63 - 1,  # Long.MAX_VALUE
+        2**62 + 7,
+        -(2**62 + 7),
+        -1,
+        0,
+        1,
+        None,
+    ]
+    t = pa.table({"v": pa.array(ext, pa.int64())})
+    check_roundtrip(t)
+
+
+def test_int64_extreme_runs():
+    """A RUN of Long.MIN_VALUE hits RLEv2 short-repeat with an 8-byte
+    value whose top bit is set (advisor round-2: np.int64() raised
+    OverflowError instead of decoding)."""
+    t = pa.table({
+        "minrun": pa.array([-(2**63)] * 64, pa.int64()),
+        "maxrun": pa.array([2**63 - 1] * 64, pa.int64()),
+        "neg62": pa.array([-(2**62 + 13)] * 64, pa.int64()),
+    })
+    check_roundtrip(t)
+
+
 def test_strings_direct_and_dictionary(rng):
     n = 5000
     # low-cardinality -> dictionary encoding; high-cardinality -> direct
