@@ -32,7 +32,11 @@ from jax import lax
 N_ROWS = 1 << 20  # 1M-row stepping stone
 N_KEYS = 4096  # distinct groups
 REPS = 7
-K_SHORT, K_LONG = 1, 17
+# 256 chained iterations ~= 40ms of device time at the current kernel
+# speed (0.16 ms/iter): the long-short difference must dwarf the axon
+# tunnel's +-5ms run-to-run jitter or the derived per-iter is noise
+# (round-2 regression: K_LONG=17 left a 2.5ms signal inside that jitter)
+K_SHORT, K_LONG = 1, 257
 
 
 @partial(jax.jit, static_argnums=(3, 4))
@@ -51,7 +55,7 @@ def _chained_groupby(keys, vals, present, num_keys: int, iters: int):
     return acc
 
 
-def _timed(fn) -> float:
+def _timed_all(fn) -> "list[float]":
     out = fn()  # warmup/compile
     float(np.asarray(out))
     times = []
@@ -59,20 +63,24 @@ def _timed(fn) -> float:
         t0 = time.perf_counter()
         float(np.asarray(fn()))  # host sync: full completion
         times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    return times
 
 
-def bench_device() -> "tuple[float, float, float]":
+def bench_device():
     rng = np.random.default_rng(42)
     keys = jnp.asarray(rng.integers(0, N_KEYS, N_ROWS), jnp.int64)
     vals = jnp.asarray(rng.standard_normal(N_ROWS), jnp.float32)
     present = jnp.ones((N_ROWS,), bool)
     cap = N_KEYS
 
-    t_short = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_SHORT))
-    t_long = _timed(lambda: _chained_groupby(keys, vals, present, cap, K_LONG))
-    per_iter = max((t_long - t_short) / (K_LONG - K_SHORT), 1e-9)
-    return per_iter, t_short, t_long
+    shorts = _timed_all(lambda: _chained_groupby(keys, vals, present, cap, K_SHORT))
+    longs = _timed_all(lambda: _chained_groupby(keys, vals, present, cap, K_LONG))
+    t_short = float(np.median(shorts))
+    # per-rep per-iter spread (vs the short median): min/median/max so a
+    # lucky run can't masquerade as the result (VERDICT r2 protocol)
+    per_iters = sorted(max((tl - t_short) / (K_LONG - K_SHORT), 1e-9) for tl in longs)
+    per_iter = per_iters[len(per_iters) // 2]
+    return per_iter, per_iters, t_short, float(np.median(longs))
 
 
 def bench_cpu_ref() -> float:
@@ -92,7 +100,7 @@ def bench_cpu_ref() -> float:
 
 
 def main():
-    t_dev, t_short, t_long = bench_device()
+    t_dev, per_iters, t_short, t_long = bench_device()
     t_cpu = bench_cpu_ref()
     mrows_s = (N_ROWS / t_dev) / 1e6
     vs_baseline = t_cpu / t_dev  # >1 means faster than the CPU ref
@@ -105,13 +113,21 @@ def main():
                 "vs_baseline": round(vs_baseline, 3),
                 # raw protocol inputs so the derived per-iter can be
                 # audited against tunnel-latency drift: per_iter =
-                # (t_long - t_short) / (K_LONG - K_SHORT)
+                # (t_long - t_short) / (K_LONG - K_SHORT), and the
+                # per-rep per-iter spread [best, median, worst] keeps a
+                # lucky run from masquerading as the result
                 "raw": {
                     "t_short_s": round(t_short, 5),
                     "t_long_s": round(t_long, 5),
                     "k_short": K_SHORT,
                     "k_long": K_LONG,
                     "cpu_ref_s": round(t_cpu, 5),
+                    "per_iter_ms_min_med_max": [
+                        round(per_iters[0] * 1e3, 4),
+                        round(t_dev * 1e3, 4),
+                        round(per_iters[-1] * 1e3, 4),
+                    ],
+                    "vs_baseline_worst": round(t_cpu / per_iters[-1], 3),
                 },
             }
         )

@@ -264,48 +264,72 @@ def _groupby_impl(keys, vals, num_keys: int, interpret: bool):
 #
 # Both one-hots live only in VMEM; HBM traffic is just keys+vals.
 
-_OUTER_NT = 1024  # rows contracted per sublane step. VMEM-bounded (the 8x
-# sublane unroll keeps ~8 blocks of one-hot intermediates live): on v5e,
-# 512 -> 500 Mrows/s, 1024 -> 560 (longer contractions amortize the
-# one-hot builds), 1536/2048 fail to compile (VMEM); verified exact at
-# num_keys=16384 (the dispatch cap) at this setting.
+_OUTER_NT_MAX = 8192  # rows contracted per grid step (the dot's K dim).
+# The transposed build keeps one [4H, NT] lhs, one [128, NT] rhs and one
+# [H, NT] cmp tile live — (5H + 128) * 2 bytes per row — so NT scales
+# down as the key domain grows. v5e-measured (1M rows, chained): K=4096
+# NT 2048/4096/8192 -> 4.1/5.2/6.7 Grows/s; K=16384 NT=8192 -> 1.75
+# Grows/s; K=65536 NT=2048 -> 0.38 Grows/s (scatter: 0.15).
+_OUTER_VMEM_BUDGET = 13_000_000  # bytes of live kernel tiles that fit
+
+
+def _outer_nt(H: int) -> int:
+    per_row = (5 * H + _LANES) * 2
+    nt = _OUTER_VMEM_BUDGET // per_row
+    p = 512
+    while p * 2 <= min(nt, _OUTER_NT_MAX):
+        p *= 2
+    return p
 
 
 def _outer_kernel(k_ref, v_ref, out_ref, *, H: int):
+    """One full-width MXU contraction per grid step, everything built in
+    the keys' NATIVE row orientation.
+
+    The round-2 kernel spent its time on layout, not math: each sublane
+    step paid a [NT] -> [NT, 1] lane->sublane relayout to build one-hots
+    and a lane-axis concatenate to assemble the lhs, then issued a small
+    dot. Here keys arrive as a [1, NT] row; both one-hots broadcast that
+    row across SUBLANES (free) against a dim-0 iota, the limb concat
+    stacks along sublanes (tile-aligned), and the dot contracts both
+    operands on their last dim — lhsT [4H, NT] x rhsT [128, NT] ->
+    [4H, 128] — which the MXU consumes directly.
+    """
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # static unroll over the block's 8 sublanes (the legal (8, NT)
-    # block shape); each iteration contracts NT rows at full MXU width
-    for s in range(_GB_SUBLANES):
-        k = k_ref[s, :].reshape(-1, 1)  # [NT, 1] i32 (pre-mapped to [0, H*128))
-        v = v_ref[s, :].reshape(-1, 1)  # [NT, 1] f32
-        nt = k.shape[0]
+    k = k_ref[0]  # [1, NT] i32 (pre-mapped to [0, H*128) + trash H*128)
+    v = v_ref[0]  # [1, NT] f32
+    nt = k.shape[1]
 
-        lo = k & 127
-        hi = k >> 7
-        iota_l = jax.lax.broadcasted_iota(jnp.int32, (nt, _LANES), 1)
-        iota_h = jax.lax.broadcasted_iota(jnp.int32, (nt, H), 1)
-        rhs = (lo == iota_l).astype(jnp.bfloat16)  # [NT, 128]
-        # single bool->bf16 consumer, then multiplies: Mosaic rejects
-        # the multi-consumer broadcast i1 relayout a where-chain needs,
-        # and one-hot products are exact either way (factors are 0/1)
-        ohh = (hi == iota_h).astype(jnp.bfloat16)  # [NT, H]
+    hi = k >> 7
+    lo = k & 127
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (H, nt), 0)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (_LANES, nt), 0)
+    # single bool->bf16 consumer, then multiplies: Mosaic rejects the
+    # multi-consumer broadcast i1 relayout a where-chain needs, and
+    # one-hot products are exact either way (factors are 0/1)
+    cmp = (jnp.broadcast_to(hi, (H, nt)) == iota_h).astype(jnp.bfloat16)  # [H, NT]
+    rhsT = (jnp.broadcast_to(lo, (_LANES, nt)) == iota_l).astype(jnp.bfloat16)  # [128, NT]
 
-        v1 = v.astype(jnp.bfloat16)
-        r1 = v - v1.astype(jnp.float32)
-        v2 = r1.astype(jnp.bfloat16)
-        v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
-        lhs = jnp.concatenate(
-            [ohh * v1, ohh * v2, ohh * v3, ohh],
-            axis=1,
-        )  # [NT, 4H]
-        out_ref[...] += jax.lax.dot_general(
-            lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [4H, 128]
+    # v = v1 + v2 + v3 in bf16 limbs captures all 24 f32 mantissa bits;
+    # each limb and each one-hot entry is exactly representable in bf16,
+    # so every MXU product is exact and the f32 accumulator gives
+    # segment_sum-class accuracy at single-pass bf16 speed.
+    v1 = v.astype(jnp.bfloat16)
+    r1 = v - v1.astype(jnp.float32)
+    v2 = r1.astype(jnp.bfloat16)
+    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
+    lhsT = jnp.concatenate(
+        [cmp * v1, cmp * v2, cmp * v3, cmp],
+        axis=0,
+    )  # [4H, NT] — sublane-axis concat: tile stacking, no relayout
+    out_ref[...] += jax.lax.dot_general(
+        lhsT, rhsT, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [4H, 128]
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -319,20 +343,23 @@ def _outer_impl(keys, vals, num_keys: int, interpret: bool):
     in_domain = (keys >= 0) & (keys < num_keys)
     seg = jnp.where(in_domain, keys, trash).astype(jnp.int32)
 
-    step_rows = _GB_SUBLANES * _OUTER_NT
-    g = max((n + step_rows - 1) // step_rows, 1)
-    total = g * step_rows
-    kp = jnp.full((total,), trash, jnp.int32).at[:n].set(seg).reshape(g * _GB_SUBLANES, _OUTER_NT)
+    nt = _outer_nt(H)
+    g = max((n + nt - 1) // nt, 1)
+    total = g * nt
+    # [g, 1, NT]: blocks index the leading dim; the trailing (1, NT)
+    # equals the array's own trailing dims (the tiling rule Mosaic
+    # requires for non-(8,128)-divisible blocks)
+    kp = jnp.full((total,), trash, jnp.int32).at[:n].set(seg).reshape(g, 1, nt)
     vp = (
         jnp.zeros((total,), jnp.float32)
         .at[:n]
         .set(vals.astype(jnp.float32))
-        .reshape(g * _GB_SUBLANES, _OUTER_NT)
+        .reshape(g, 1, nt)
     )
 
     row_spec = pl.BlockSpec(
-        (_GB_SUBLANES, _OUTER_NT),
-        lambda i: (i, jnp.int32(0)),
+        (1, 1, nt),
+        lambda i: (i, jnp.int32(0), jnp.int32(0)),
         memory_space=_VMEM if not interpret else None,
     )
     out_spec = pl.BlockSpec(
@@ -361,13 +388,12 @@ def pallas_groupby_sum_outer(
     int64-safe counts (f32 accumulator: exact below 2^24 rows/key).
 
     Returns (sums[num_keys] f32, counts[num_keys] i64); out-of-domain
-    keys are dropped. num_keys <= 16384: at H = num_keys/128 the 8x
-    sublane unroll keeps ~8 [NT, 4H] bf16 lhs tiles live in VMEM, and
-    16384 (H=128 -> 4MB of lhs tiles) leaves headroom under the ~16MB
-    VMEM budget that 65536 (16MB of lhs tiles alone) does not.
+    keys are dropped. num_keys <= 65536: the contraction length NT
+    scales down as H grows (see _outer_nt) and past H=512 the one-hot
+    work amplification (2*4H*128 FLOPs/row) loses to the scatter path.
     """
-    if num_keys > 16384:
-        raise ValueError("pallas_groupby_sum_outer supports num_keys <= 16384")
+    if num_keys > 65536:
+        raise ValueError("pallas_groupby_sum_outer supports num_keys <= 65536")
     return _outer_impl(keys, vals, int(num_keys), bool(interpret))
 
 

@@ -45,7 +45,9 @@ def test_groupby_sum_bounded_parity(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("n,num_keys", [(5000, 4096), (300, 7), (40000, 130), (2048, 16384)])
+@pytest.mark.parametrize(
+    "n,num_keys", [(5000, 4096), (300, 7), (40000, 130), (2048, 16384), (3000, 65536)]
+)
 def test_groupby_sum_outer_parity(rng, n, num_keys):
     # dual-implementation cross-check: the MXU outer-product kernel must
     # agree with the host bincount oracle on sums AND counts, dropping
