@@ -1,0 +1,47 @@
+/*
+ * Device runtime control for the TPU sidecar execution path.
+ *
+ * The reference binds the in-process CUDA device per JNI call
+ * (cudf::jni::auto_set_device, reference RowConversionJni.cpp:48). The
+ * TPU runtime (jax/XLA) cannot live inside the JVM process, so the
+ * native library instead spawns a sidecar worker owning the chip and
+ * dispatches eligible ops to it (PACKAGING.md "Deployment model");
+ * this class is the executor-visible switch. With no sidecar connected
+ * every op runs on the native host engine — calling connect() is an
+ * acceleration opt-in, never a correctness requirement.
+ */
+package com.nvidia.spark.rapids.jni;
+
+public class DeviceRuntime {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /**
+   * Spawn and connect the device sidecar worker. Idempotent.
+   *
+   * @param pythonExe interpreter for the worker; null/empty uses
+   *                  $SRJT_PYTHON then "python3"
+   * @param timeoutSec startup budget (jax + device init dominate)
+   */
+  public static void connect(String pythonExe, int timeoutSec) {
+    connectNative(pythonExe, timeoutSec);
+  }
+
+  /** Backend platform of the connected worker ("tpu", "cpu"), or "" when
+   * disconnected. */
+  public static String platform() {
+    return platformNative();
+  }
+
+  /** Stop the worker; subsequent ops use the native host engine. */
+  public static void shutdown() {
+    shutdownNative();
+  }
+
+  private static native void connectNative(String pythonExe, int timeoutSec);
+
+  private static native String platformNative();
+
+  private static native void shutdownNative();
+}

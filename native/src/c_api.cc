@@ -401,11 +401,91 @@ SRJT_EXPORT int64_t srjt_table_column(int64_t h, int32_t i) {
 
 SRJT_EXPORT void srjt_table_close(int64_t h) { tables().release(h); }
 
+// -- device sidecar ----------------------------------------------------------
+//
+// The JNI->TPU execution path (PACKAGING.md): a spawned worker process
+// owns the JAX/XLA device; ops dispatch over a Unix socket and fall
+// back to the in-process host engine when no sidecar is connected.
+// Mirrors the reference's per-call device binding role
+// (cudf::jni::auto_set_device, RowConversionJni.cpp:48) for a runtime
+// that cannot live inside the JVM process.
+
+#include "sidecar.h"
+
+#include <memory>
+#include <mutex>
+
+namespace {
+std::mutex g_sidecar_mu;
+std::unique_ptr<srjt::SidecarClient> g_sidecar;
+thread_local std::string g_platform_buf;
+}  // namespace
+
+SRJT_EXPORT int32_t srjt_device_connect(const char* python_exe, int32_t timeout_sec) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        std::lock_guard<std::mutex> lock(g_sidecar_mu);
+        if (g_sidecar) return 0;
+        const char* exe = python_exe && *python_exe ? python_exe : nullptr;
+        if (!exe) exe = std::getenv("SRJT_PYTHON");
+        if (!exe || !*exe) exe = "python3";
+        g_sidecar = std::make_unique<srjt::SidecarClient>(
+            exe, timeout_sec > 0 ? timeout_sec : 120);
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT const char* srjt_device_platform() {
+  std::lock_guard<std::mutex> lock(g_sidecar_mu);
+  g_platform_buf = g_sidecar ? g_sidecar->platform() : "";
+  return g_platform_buf.c_str();
+}
+
+SRJT_EXPORT void srjt_device_shutdown() {
+  std::lock_guard<std::mutex> lock(g_sidecar_mu);
+  g_sidecar.reset();
+}
+
+SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* vals,
+                                            int64_t n, int32_t num_keys, float* out_sums,
+                                            int64_t* out_counts) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        std::lock_guard<std::mutex> lock(g_sidecar_mu);
+        if (!g_sidecar) throw std::runtime_error("no device sidecar connected");
+        g_sidecar->groupby_sum(keys, vals, n, num_keys, out_sums, out_counts);
+        return 0;
+      },
+      -1));
+}
+
 // -- operator entries --------------------------------------------------------
 
 SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
   return guarded(
-      [&]() -> int64_t { return put_column(srjt::convert_to_rows(table_ref(table_h))); },
+      [&]() -> int64_t {
+        {
+          // device path when a sidecar owns a chip; host engine
+          // otherwise (and on any sidecar failure — the op must not
+          // become less available because a worker died)
+          std::lock_guard<std::mutex> lock(g_sidecar_mu);
+          if (g_sidecar) {
+            try {
+              auto batches = g_sidecar->convert_to_rows(table_ref(table_h));
+              if (batches.size() == 1) {
+                return put_column(std::move(batches[0]));
+              }
+              // multi-batch: the single-handle ABI can't carry it yet
+              // (round-3 item: batch array returns); host engine has
+              // the same 2 GiB ceiling, so fall through
+            } catch (const std::exception&) {
+              // fall back to host engine below
+            }
+          }
+        }
+        return put_column(srjt::convert_to_rows(table_ref(table_h)));
+      },
       0);
 }
 

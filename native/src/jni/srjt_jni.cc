@@ -60,6 +60,9 @@ const char* srjt_last_cast_string();
 int64_t srjt_zorder_interleave_bits(int64_t table_h);
 int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t product_scale);
 int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quotient_scale);
+int32_t srjt_device_connect(const char* python_exe, int32_t timeout_sec);
+const char* srjt_device_platform();
+void srjt_device_shutdown();
 }
 
 namespace {
@@ -383,6 +386,27 @@ JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_ZOrder_interleaveBitsNa
   int64_t h = srjt_zorder_interleave_bits(table_handle);
   if (h == 0) throw_last_error(env);
   return h;
+}
+
+// DeviceRuntime: JVM-visible sidecar control (the auto_set_device
+// analog, RowConversionJni.cpp:48 — here the "device binding" is a
+// worker process owning the chip; see PACKAGING.md).
+JNIEXPORT void JNICALL Java_com_nvidia_spark_rapids_jni_DeviceRuntime_connectNative(
+    JNIEnv* env, jclass, jstring python_exe, jint timeout_sec) {
+  const char* exe = python_exe == nullptr ? nullptr : env->GetStringUTFChars(python_exe, nullptr);
+  int32_t rc = srjt_device_connect(exe == nullptr ? "" : exe, timeout_sec);
+  if (exe != nullptr) env->ReleaseStringUTFChars(python_exe, exe);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT jstring JNICALL Java_com_nvidia_spark_rapids_jni_DeviceRuntime_platformNative(
+    JNIEnv* env, jclass) {
+  return env->NewStringUTF(srjt_device_platform());
+}
+
+JNIEXPORT void JNICALL Java_com_nvidia_spark_rapids_jni_DeviceRuntime_shutdownNative(
+    JNIEnv*, jclass) {
+  srjt_device_shutdown();
 }
 
 }  // extern "C"

@@ -1,0 +1,247 @@
+#include "sidecar.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <stdexcept>
+#include <thread>
+
+#include "columnar.h"
+
+namespace srjt {
+
+namespace {
+
+constexpr uint32_t OP_PING = 0;
+constexpr uint32_t OP_GROUPBY_SUM_F32 = 1;
+constexpr uint32_t OP_CONVERT_TO_ROWS = 2;
+constexpr uint32_t OP_SHUTDOWN = 255;
+
+void append(std::vector<uint8_t>& buf, const void* p, size_t n) {
+  const uint8_t* b = static_cast<const uint8_t*>(p);
+  buf.insert(buf.end(), b, b + n);
+}
+
+template <typename T>
+void append_val(std::vector<uint8_t>& buf, T v) {
+  append(buf, &v, sizeof(T));
+}
+
+}  // namespace
+
+SidecarClient::SidecarClient(const std::string& python_exe, int timeout_sec) {
+  char tmpl[] = "/tmp/srjt-sidecar-XXXXXX";
+  int tfd = mkstemp(tmpl);
+  if (tfd < 0) throw std::runtime_error("sidecar: mkstemp failed");
+  close(tfd);
+  unlink(tmpl);
+  sock_path_ = std::string(tmpl) + ".sock";
+
+  int pid = fork();
+  if (pid < 0) throw std::runtime_error("sidecar: fork failed");
+  if (pid == 0) {
+    // child: exec the worker; inherit the environment (PYTHONPATH
+    // carries both the package and any device plugin site dir)
+    execlp(python_exe.c_str(), python_exe.c_str(), "-m", "spark_rapids_jni_tpu.sidecar",
+           "--socket", sock_path_.c_str(), static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  child_pid_ = pid;
+
+  // any exit from here on must not leak the worker or socket file: a
+  // constructor throw skips the destructor
+  try {
+    // wait for the socket to appear (the worker binds it before
+    // printing readiness; device/jax init dominates the wait)
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_sec);
+    while (true) {
+      fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+      if (fd_ < 0) throw std::runtime_error("sidecar: socket() failed");
+      sockaddr_un addr{};
+      addr.sun_family = AF_UNIX;
+      std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
+      if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) break;
+      close(fd_);
+      fd_ = -1;
+      int status = 0;
+      if (waitpid(child_pid_, &status, WNOHANG) == child_pid_) {
+        child_pid_ = -1;
+        throw std::runtime_error("sidecar: worker exited during startup");
+      }
+      if (std::chrono::steady_clock::now() > deadline) {
+        throw std::runtime_error("sidecar: startup timed out");
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+
+    // a wedged worker must surface as an op error (the fallback path),
+    // not an indefinite block under the client mutex
+    timeval tv{};
+    tv.tv_sec = 600;
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+
+    auto resp = request(OP_PING, {});
+    platform_.assign(resp.begin(), resp.end());
+  } catch (...) {
+    if (fd_ >= 0) close(fd_);
+    if (child_pid_ > 0) {
+      int status = 0;
+      kill(child_pid_, SIGKILL);
+      waitpid(child_pid_, &status, 0);
+    }
+    unlink(sock_path_.c_str());
+    throw;
+  }
+}
+
+SidecarClient::~SidecarClient() {
+  if (fd_ >= 0) {
+    try {
+      request(OP_SHUTDOWN, {});
+    } catch (...) {
+    }
+    close(fd_);
+  }
+  if (child_pid_ > 0) {
+    int status = 0;
+    // give the worker a moment to exit cleanly, then force
+    for (int i = 0; i < 20; ++i) {
+      if (waitpid(child_pid_, &status, WNOHANG) == child_pid_) {
+        child_pid_ = -1;
+        break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    if (child_pid_ > 0) {
+      kill(child_pid_, SIGKILL);
+      waitpid(child_pid_, &status, 0);
+    }
+  }
+  if (!sock_path_.empty()) unlink(sock_path_.c_str());
+}
+
+void SidecarClient::send_all(const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    // MSG_NOSIGNAL: a dead worker must yield an exception (-> host
+    // fallback), not a SIGPIPE that kills embedders that don't mask it
+    ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("sidecar: send failed (worker died or timed out)");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void SidecarClient::recv_all(void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = read(fd_, p, n);
+    if (r <= 0) throw std::runtime_error("sidecar: recv failed (worker died or timed out)");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8_t>& payload) {
+  uint64_t plen = payload.size();
+  uint8_t hdr[12];
+  std::memcpy(hdr, &op, 4);
+  std::memcpy(hdr + 4, &plen, 8);
+  send_all(hdr, sizeof(hdr));
+  if (!payload.empty()) send_all(payload.data(), payload.size());
+
+  uint8_t rhdr[12];
+  recv_all(rhdr, sizeof(rhdr));
+  uint32_t status;
+  uint64_t rlen;
+  std::memcpy(&status, rhdr, 4);
+  std::memcpy(&rlen, rhdr + 4, 8);
+  std::vector<uint8_t> resp(rlen);
+  if (rlen) recv_all(resp.data(), rlen);
+  if (status != 0) {
+    throw std::runtime_error("sidecar op failed: " +
+                             std::string(resp.begin(), resp.end()));
+  }
+  return resp;
+}
+
+void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t n,
+                                int32_t num_keys, float* out_sums, int64_t* out_counts) {
+  std::vector<uint8_t> payload;
+  payload.reserve(12 + static_cast<size_t>(n) * 12);
+  append_val<uint32_t>(payload, static_cast<uint32_t>(num_keys));
+  append_val<uint64_t>(payload, static_cast<uint64_t>(n));
+  append(payload, keys, static_cast<size_t>(n) * 8);
+  append(payload, vals, static_cast<size_t>(n) * 4);
+  auto resp = request(OP_GROUPBY_SUM_F32, payload);
+  size_t want = static_cast<size_t>(num_keys) * 12;
+  if (resp.size() != want) throw std::runtime_error("sidecar: groupby_sum bad response size");
+  std::memcpy(out_sums, resp.data(), static_cast<size_t>(num_keys) * 4);
+  std::memcpy(out_counts, resp.data() + static_cast<size_t>(num_keys) * 4,
+              static_cast<size_t>(num_keys) * 8);
+}
+
+std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
+    const NativeTable& table) {
+  std::vector<uint8_t> payload;
+  append_val<uint32_t>(payload, static_cast<uint32_t>(table.columns.size()));
+  for (const auto& col : table.columns) {
+    append_val<int32_t>(payload, static_cast<int32_t>(col->type));
+    append_val<int32_t>(payload, col->scale);
+    append_val<uint64_t>(payload, static_cast<uint64_t>(col->size));
+    uint8_t has_validity = col->validity.empty() ? 0 : 1;
+    append_val<uint8_t>(payload, has_validity);
+    if (has_validity) append(payload, col->validity.data(), col->validity.size());
+    if (col->type == TypeId::STRING) {
+      append(payload, col->offsets.data(), col->offsets.size() * 4);
+      append_val<uint64_t>(payload, static_cast<uint64_t>(col->chars.size()));
+      append(payload, col->chars.data(), col->chars.size());
+    } else {
+      append_val<uint64_t>(payload, static_cast<uint64_t>(col->data.size()));
+      append(payload, col->data.data(), col->data.size());
+    }
+  }
+  auto resp = request(OP_CONVERT_TO_ROWS, payload);
+
+  size_t pos = 0;
+  auto need = [&](size_t n) {
+    if (pos + n > resp.size()) throw std::runtime_error("sidecar: truncated response");
+  };
+  uint32_t nbatches;
+  need(4);
+  std::memcpy(&nbatches, resp.data(), 4);
+  pos = 4;
+  std::vector<std::unique_ptr<NativeColumn>> out;
+  for (uint32_t b = 0; b < nbatches; ++b) {
+    uint64_t nrows;
+    need(8);
+    std::memcpy(&nrows, resp.data() + pos, 8);
+    pos += 8;
+    auto col = std::make_unique<NativeColumn>();
+    col->type = TypeId::LIST;
+    col->size = static_cast<int64_t>(nrows);
+    col->offsets.resize(nrows + 1);
+    need((nrows + 1) * 4);
+    std::memcpy(col->offsets.data(), resp.data() + pos, (nrows + 1) * 4);
+    pos += (nrows + 1) * 4;
+    uint64_t blen;
+    need(8);
+    std::memcpy(&blen, resp.data() + pos, 8);
+    pos += 8;
+    col->chars.resize(blen);
+    need(blen);
+    std::memcpy(col->chars.data(), resp.data() + pos, blen);
+    pos += blen;
+    out.push_back(std::move(col));
+  }
+  return out;
+}
+
+}  // namespace srjt

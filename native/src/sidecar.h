@@ -1,0 +1,57 @@
+// Device sidecar client: the native half of the JNI->TPU execution
+// path (PACKAGING.md "sidecar" deployment model).
+//
+// The reference reaches its device from JNI in-process (CUDA runtime in
+// the executor, RowConversionJni.cpp:42 -> row_conversion.cu:1903). The
+// TPU runtime here is JAX/XLA behind a Python front end that cannot be
+// embedded in a JVM process, so libsrjt spawns a sidecar worker
+// (`python -m spark_rapids_jni_tpu.sidecar`) owning the chip and
+// forwards ops over a Unix-domain socket (protocol doc: sidecar.py).
+// When no sidecar is running, every op falls back to the in-process
+// host engine (columnar.cc).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace srjt {
+
+struct NativeTable;
+struct NativeColumn;
+
+class SidecarClient {
+ public:
+  // Spawns the worker and waits for its socket (readiness printed on
+  // stdout). python_exe: $SRJT_PYTHON or "python3". Throws on failure.
+  explicit SidecarClient(const std::string& python_exe, int timeout_sec);
+  ~SidecarClient();
+
+  SidecarClient(const SidecarClient&) = delete;
+  SidecarClient& operator=(const SidecarClient&) = delete;
+
+  // jax backend name on the worker ("tpu", "cpu", ...)
+  const std::string& platform() const { return platform_; }
+
+  // GROUPBY SUM over a bounded key domain, executed on the worker's
+  // device (the MXU Pallas kernel when the backend is a TPU).
+  void groupby_sum(const int64_t* keys, const float* vals, int64_t n, int32_t num_keys,
+                   float* out_sums, int64_t* out_counts);
+
+  // Table -> JCUDF row batches on the device. Returns one LIST<INT8>
+  // column per <=2GiB batch.
+  std::vector<std::unique_ptr<NativeColumn>> convert_to_rows(const NativeTable& table);
+
+ private:
+  std::vector<uint8_t> request(uint32_t op, const std::vector<uint8_t>& payload);
+  void send_all(const void* buf, size_t n);
+  void recv_all(void* buf, size_t n);
+
+  int fd_ = -1;
+  int child_pid_ = -1;
+  std::string sock_path_;
+  std::string platform_;
+};
+
+}  // namespace srjt

@@ -25,7 +25,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -410,8 +409,6 @@ def assemble_rows(
     monotonic. Everything stays in u32 lanes: u8<->u32 bitcasts of 2-D
     arrays are real tiled-layout relayouts, paid once at the final 1-D
     blob view."""
-    from jax import lax as _lax
-
     parts = rp_parts if isinstance(rp_parts, (tuple, list)) else (rp_parts,)
     n = parts[0].shape[0]
     s4 = sum(p.shape[1] for p in parts)
@@ -484,5 +481,5 @@ def assemble_rows(
         out = block((src_a, src_c, pmod, delta, alen))
     else:
         xs = tuple(v.reshape(nblk, nbt) for v in (src_a, src_c, pmod, delta, alen))
-        out = _lax.map(block, xs)  # [nblk, nbt, g4]
+        out = lax.map(block, xs)  # [nblk, nbt, g4]
     return u32_rows_to_u8_flat(out.reshape(-1, out.shape[-1]))[:total]

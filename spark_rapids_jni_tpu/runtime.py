@@ -167,6 +167,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_byte_array_lens.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
     lib.srjt_lz4_decompress_block.restype = ctypes.c_int64
     lib.srjt_lz4_decompress_block.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.srjt_device_connect.restype = ctypes.c_int32
+    lib.srjt_device_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.srjt_device_platform.restype = ctypes.c_char_p
+    lib.srjt_device_shutdown.restype = None
+    lib.srjt_device_groupby_sum.restype = ctypes.c_int32
+    lib.srjt_device_groupby_sum.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+    ]
     return lib
 
 
@@ -545,6 +555,64 @@ class NativeTable:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def device_connect(python_exe: Optional[str] = None, timeout_sec: int = 120) -> str:
+    """Spawn + connect the device sidecar worker (the JNI->TPU path,
+    PACKAGING.md): after this, eligible C-ABI ops execute on the
+    worker's jax backend. Returns the backend platform name."""
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    # the forked worker resolves the package through PYTHONPATH — make
+    # sure this package's parent directory is on it (a JVM deployment
+    # sets this in the executor launch env; see PACKAGING.md)
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pp = os.environ.get("PYTHONPATH", "")
+    if pkg_parent not in pp.split(os.pathsep):
+        os.environ["PYTHONPATH"] = f"{pkg_parent}{os.pathsep}{pp}" if pp else pkg_parent
+    exe = (python_exe or "").encode()
+    if lib.srjt_device_connect(exe, timeout_sec) != 0:
+        _raise_last(lib)
+    return device_platform()
+
+
+def device_platform() -> str:
+    """Connected sidecar's jax backend name, or '' when disconnected."""
+    lib = native_lib()
+    if lib is None:
+        return ""
+    return lib.srjt_device_platform().decode()
+
+
+def device_shutdown() -> None:
+    lib = native_lib()
+    if lib is not None:
+        lib.srjt_device_shutdown()
+
+
+def device_groupby_sum(keys, vals, num_keys: int):
+    """GROUP BY SUM executed on the sidecar's device (the MXU Pallas
+    kernel when the backend is a TPU). keys int64[n], vals float32[n]."""
+    import numpy as np
+
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    keys = np.ascontiguousarray(keys, np.int64)
+    vals = np.ascontiguousarray(vals, np.float32)
+    sums = np.empty(num_keys, np.float32)
+    counts = np.empty(num_keys, np.int64)
+    rc = lib.srjt_device_groupby_sum(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(keys), num_keys,
+        sums.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        _raise_last(lib)
+    return sums, counts
 
 
 def native_convert_to_rows(table: "NativeTable") -> NativeColumn:

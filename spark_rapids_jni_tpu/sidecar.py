@@ -1,0 +1,203 @@
+"""Device sidecar worker: the JNI->TPU execution path.
+
+The reference's JNI entry points land directly on device kernels
+(RowConversionJni.cpp:42 -> row_conversion.cu:1903) because CUDA lives
+in-process. The TPU runtime here is JAX/XLA, whose Python front end
+cannot be embedded in a JVM executor process; the deployment model
+(PACKAGING.md) is therefore a SIDECAR: ``libsrjt.so`` spawns this
+module as a child process that owns the chip, and dispatches ops over a
+Unix-domain socket with a length-prefixed binary protocol. The JVM
+process never hosts a Python interpreter; the native library falls back
+to its host-CPU engine when no sidecar/chip is available.
+
+Wire protocol (little-endian):
+  request:  [u32 op] [u64 payload_len] [payload]
+  response: [u32 status(0=ok)] [u64 payload_len] [payload | utf-8 error]
+
+Ops:
+  0 PING              -> payload = jax backend name (b"tpu"/b"cpu"/...)
+  1 GROUPBY_SUM_F32   in:  u32 num_keys, u64 n, i64[n] keys, f32[n] vals
+                      out: f32[num_keys] sums, i64[num_keys] counts
+                      (groupby_sum_bounded: the MXU outer-product kernel
+                      on TPU)
+  2 CONVERT_TO_ROWS   in:  serialized table (see _read_table)
+                      out: u32 nbatches, per batch: u64 nrows,
+                           i32[nrows+1] offsets, u64 blob_len, u8 blob
+  255 SHUTDOWN        -> empty ok, then the server exits
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import struct
+import sys
+
+OP_PING = 0
+OP_GROUPBY_SUM_F32 = 1
+OP_CONVERT_TO_ROWS = 2
+OP_SHUTDOWN = 255
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("sidecar: peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_table(payload: bytes):
+    """Deserialize: u32 ncols; per col: i32 type_id, i32 scale, u64 n,
+    u8 has_validity, [n] u8 validity, then either (u64 data_len, bytes)
+    for fixed width or (i32[n+1] offsets, u64 chars_len, bytes) for
+    STRING."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .columnar import Column, Table
+    from .columnar.dtype import DType, TypeId
+
+    pos = 0
+    (ncols,) = struct.unpack_from("<I", payload, pos)
+    pos += 4
+    cols = []
+    for _ in range(ncols):
+        type_id, scale = struct.unpack_from("<ii", payload, pos)
+        pos += 8
+        (n,) = struct.unpack_from("<Q", payload, pos)
+        pos += 8
+        has_validity = payload[pos]
+        pos += 1
+        validity = None
+        if has_validity:
+            validity = jnp.asarray(np.frombuffer(payload, np.uint8, n, pos).astype(bool))
+            pos += n
+        tid = TypeId(type_id)
+        d = DType(tid, scale if tid.name.startswith("DECIMAL") else 0)
+        if tid == TypeId.STRING:
+            offs = np.frombuffer(payload, np.int32, n + 1, pos)
+            pos += 4 * (n + 1)
+            (clen,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            chars = np.frombuffer(payload, np.uint8, clen, pos)
+            pos += clen
+            cols.append(
+                Column(d, validity=validity, offsets=jnp.asarray(offs), chars=jnp.asarray(chars))
+            )
+        else:
+            (dlen,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            raw = payload[pos : pos + dlen]
+            pos += dlen
+            if tid == TypeId.DECIMAL128:
+                data = np.frombuffer(raw, np.uint32).reshape(n, 4)
+            else:
+                data = np.frombuffer(raw, np.dtype(d.np_dtype))
+            cols.append(Column(d, data=jnp.asarray(data), validity=validity))
+    return Table(cols)
+
+
+def _op_groupby_sum(payload: bytes) -> bytes:
+    import numpy as np
+
+    from .ops.aggregate import groupby_sum_bounded
+
+    (num_keys,) = struct.unpack_from("<I", payload, 0)
+    (n,) = struct.unpack_from("<Q", payload, 4)
+    keys = np.frombuffer(payload, np.int64, n, 12)
+    vals = np.frombuffer(payload, np.float32, n, 12 + 8 * n)
+    import jax.numpy as jnp
+
+    sums, counts = groupby_sum_bounded(
+        jnp.asarray(keys), jnp.asarray(vals), int(num_keys)
+    )
+    return np.asarray(sums, np.float32).tobytes() + np.asarray(counts, np.int64).tobytes()
+
+
+def _op_convert_to_rows(payload: bytes) -> bytes:
+    import numpy as np
+
+    from .ops.row_conversion import convert_to_rows
+
+    table = _read_table(payload)
+    batches = convert_to_rows(table)
+    out = [struct.pack("<I", len(batches))]
+    for col in batches:
+        offs = np.asarray(col.offsets, np.int32)
+        blob = np.asarray(col.child.data).view(np.uint8)
+        out.append(struct.pack("<Q", len(col)))
+        out.append(offs.tobytes())
+        out.append(struct.pack("<Q", blob.size))
+        out.append(blob.tobytes())
+    return b"".join(out)
+
+
+def serve(sock_path: str) -> None:
+    # the import defines the device backend (axon TPU when available).
+    # This image preloads jax at interpreter startup with the TPU
+    # platform, so an inherited JAX_PLATFORMS must be re-asserted on
+    # the live config before any backend initializes (the hermetic test
+    # tier pins "cpu" this way; conftest.py does the same).
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    import spark_rapids_jni_tpu  # noqa: F401  (x64 flag before arrays)
+
+    backend = jax.default_backend()
+
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    srv.bind(sock_path)
+    srv.listen(1)
+    # the parent polls for this line to know the device is up
+    print(f"SRJT_SIDECAR_READY backend={backend}", flush=True)
+    conn, _ = srv.accept()
+    try:
+        while True:
+            hdr = _recv_exact(conn, 12)
+            op, plen = struct.unpack("<IQ", hdr)
+            payload = _recv_exact(conn, plen) if plen else b""
+            try:
+                if op == OP_PING:
+                    resp = backend.encode()
+                elif op == OP_GROUPBY_SUM_F32:
+                    resp = _op_groupby_sum(payload)
+                elif op == OP_CONVERT_TO_ROWS:
+                    resp = _op_convert_to_rows(payload)
+                elif op == OP_SHUTDOWN:
+                    conn.sendall(struct.pack("<IQ", 0, 0))
+                    return
+                else:
+                    raise ValueError(f"unknown op {op}")
+                conn.sendall(struct.pack("<IQ", 0, len(resp)) + resp)
+            except Exception as e:  # report, keep serving
+                msg = f"{type(e).__name__}: {e}".encode()
+                conn.sendall(struct.pack("<IQ", 1, len(msg)) + msg)
+    finally:
+        conn.close()
+        srv.close()
+        try:
+            os.unlink(sock_path)
+        except FileNotFoundError:
+            pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    args = ap.parse_args()
+    serve(args.socket)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

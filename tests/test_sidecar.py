@@ -1,0 +1,127 @@
+"""Device sidecar: the C ABI executing ops on the jax backend through a
+spawned worker process (the JNI->TPU path; PACKAGING.md).
+
+Under pytest the worker's backend is the CPU (conftest pins it), which
+exercises the identical spawn/socket/protocol/fallback machinery; the
+real-chip check asserting platform == "tpu" runs in the round's verify
+script (a standalone process so the axon TPU is visible).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu import runtime
+
+if not runtime.native_available():  # pragma: no cover
+    pytest.skip("native runtime not built", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    # the worker must inherit an environment whose `python` is THIS
+    # interpreter and whose backend matches the test tier's CPU pin
+    platform = runtime.device_connect(python_exe=sys.executable, timeout_sec=180)
+    yield platform
+    runtime.device_shutdown()
+
+
+def test_connect_reports_backend(sidecar):
+    # conftest pins JAX_PLATFORMS=cpu for hermetic tests; the sidecar
+    # inherits it — on a real deployment this reads "tpu"
+    assert sidecar == runtime.device_platform()
+    assert sidecar in ("cpu", "tpu")
+    expect = "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else sidecar
+    assert sidecar == expect
+
+
+def test_device_groupby_sum(sidecar):
+    rng = np.random.default_rng(7)
+    n, k = 20000, 257
+    keys = rng.integers(0, k, n).astype(np.int64)
+    vals = rng.standard_normal(n).astype(np.float32)
+    sums, counts = runtime.device_groupby_sum(keys, vals, k)
+    np.testing.assert_allclose(
+        sums, np.bincount(keys, weights=vals, minlength=k), rtol=1e-5, atol=1e-3
+    )
+    np.testing.assert_array_equal(counts, np.bincount(keys, minlength=k))
+
+
+def test_convert_to_rows_dispatches_device_and_matches_host(sidecar):
+    """With a sidecar connected, srjt_convert_to_rows executes on the
+    worker's jax backend; bytes must equal the host engine's (the
+    dual-implementation cross-check, reference row_conversion.cpp:43-60)."""
+    from spark_rapids_jni_tpu.columnar import Column, Table
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.ops import bitutils
+
+    rng = np.random.default_rng(11)
+    n = 513
+    import jax.numpy as jnp
+
+    tbl = Table(
+        [
+            Column(dt.INT32, data=jnp.asarray(rng.integers(-1000, 1000, n), jnp.int32)),
+            Column.from_pylist(
+                [None if i % 9 == 0 else f"s{i % 31}" for i in range(n)], dt.STRING
+            ),
+            Column(dt.INT64, data=jnp.asarray(rng.integers(-(2**50), 2**50, n), jnp.int64)),
+            Column(
+                dt.FLOAT64,
+                data=bitutils.float_store(jnp.asarray(rng.standard_normal(n)), dt.FLOAT64),
+            ),
+        ],
+        ["a", "s", "b", "f"],
+    )
+    with runtime.NativeTable.from_python(tbl) as nt:
+        with runtime.native_convert_to_rows(nt) as rows_dev:
+            dev = rows_dev.to_python(dt.LIST)
+        # same op with the sidecar disconnected -> host engine
+        runtime.device_shutdown()
+        try:
+            with runtime.native_convert_to_rows(nt) as rows_host:
+                host = rows_host.to_python(dt.LIST)
+        finally:
+            runtime.device_connect(python_exe=sys.executable, timeout_sec=180)
+    np.testing.assert_array_equal(np.asarray(dev.offsets), np.asarray(host.offsets))
+    np.testing.assert_array_equal(np.asarray(dev.child.data), np.asarray(host.child.data))
+
+
+def test_protocol_error_reports_and_survives(tmp_path):
+    """An op-level failure must come back as a status-1 response without
+    killing the worker — exercised over the raw wire protocol."""
+    import socket
+    import struct
+    import subprocess
+    import time
+
+    sock = str(tmp_path / "w.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.sidecar", "--socket", sock]
+    )
+    try:
+        for _ in range(600):
+            if os.path.exists(sock):
+                break
+            time.sleep(0.1)
+        from spark_rapids_jni_tpu.sidecar import _recv_exact
+
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.connect(sock)
+        conn.sendall(struct.pack("<IQ", 77, 0))  # unknown op
+        status, rlen = struct.unpack("<IQ", _recv_exact(conn, 12))
+        err = _recv_exact(conn, rlen)
+        assert status == 1 and b"unknown op" in err
+        conn.sendall(struct.pack("<IQ", 0, 0))  # PING still works
+        status, rlen = struct.unpack("<IQ", _recv_exact(conn, 12))
+        assert status == 0 and _recv_exact(conn, rlen) in (b"cpu", b"tpu")
+        conn.sendall(struct.pack("<IQ", 255, 0))  # shutdown
+        _recv_exact(conn, 12)
+        conn.close()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
