@@ -1,7 +1,7 @@
 /*
  * Java API contract (L4 tier, SURVEY §2.1): Spark-semantics string
  * casts with ANSI mode. Mirrors reference CastStrings.java
- * (toInteger :35) over the srjt C ABI; ANSI failures surface as
+ * (toInteger :35, toDecimal :47) over the srjt C ABI; ANSI failures surface as
  * CastException carrying the first failing row + value
  * (reference CastStringJni.cpp:25-44 CATCH_CAST_EXCEPTION shape,
  * bound in native/src/jni/srjt_jni.cc).
@@ -23,5 +23,20 @@ public class CastStrings {
     return new ColumnVector(toIntegerNative(cv.getNativeView(), ansiMode, type.getNativeId()));
   }
 
+  /**
+   * String column -> decimal column with Spark cast semantics
+   * (reference CastStrings.java:47-52): output DECIMAL32/64/128 chosen
+   * by precision, scale in the cudf convention (negative = fraction
+   * digits); rows that do not fit become null, or raise CastException
+   * with the first failing row in ANSI mode.
+   */
+  public static ColumnVector toDecimal(ColumnView cv, boolean ansiMode, int precision,
+                                       int scale) {
+    return new ColumnVector(toDecimalNative(cv.getNativeView(), ansiMode, precision, scale));
+  }
+
   private static native long toIntegerNative(long handle, boolean ansiMode, int typeId);
+
+  private static native long toDecimalNative(long handle, boolean ansiMode, int precision,
+                                             int scale);
 }

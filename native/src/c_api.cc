@@ -416,35 +416,50 @@ SRJT_EXPORT void srjt_table_close(int64_t h) { tables().release(h); }
 #include <mutex>
 
 namespace {
-std::mutex g_sidecar_mu;
-std::unique_ptr<srjt::SidecarClient> g_sidecar;
+// g_state_mu guards ONLY the shared_ptr swap (held for pointer reads,
+// never across an RPC or the multi-second connect); each RPC holds the
+// client's own op_mu_. Host-engine fallbacks never touch either.
+std::mutex g_state_mu;
+std::mutex g_connect_mu;  // serializes connect attempts only
+std::shared_ptr<srjt::SidecarClient> g_sidecar;
 thread_local std::string g_platform_buf;
+
+std::shared_ptr<srjt::SidecarClient> sidecar_ref() {
+  std::lock_guard<std::mutex> lock(g_state_mu);
+  return g_sidecar;
+}
 }  // namespace
 
 SRJT_EXPORT int32_t srjt_device_connect(const char* python_exe, int32_t timeout_sec) {
   return static_cast<int32_t>(guarded(
       [&]() -> int64_t {
-        std::lock_guard<std::mutex> lock(g_sidecar_mu);
-        if (g_sidecar) return 0;
+        std::lock_guard<std::mutex> connect_lock(g_connect_mu);
+        if (sidecar_ref()) return 0;
         const char* exe = python_exe && *python_exe ? python_exe : nullptr;
         if (!exe) exe = std::getenv("SRJT_PYTHON");
         if (!exe || !*exe) exe = "python3";
-        g_sidecar = std::make_unique<srjt::SidecarClient>(
+        auto client = std::make_shared<srjt::SidecarClient>(
             exe, timeout_sec > 0 ? timeout_sec : 120);
+        std::lock_guard<std::mutex> state_lock(g_state_mu);
+        g_sidecar = std::move(client);
         return 0;
       },
       -1));
 }
 
 SRJT_EXPORT const char* srjt_device_platform() {
-  std::lock_guard<std::mutex> lock(g_sidecar_mu);
-  g_platform_buf = g_sidecar ? g_sidecar->platform() : "";
+  auto client = sidecar_ref();
+  g_platform_buf = client ? client->platform() : "";
   return g_platform_buf.c_str();
 }
 
 SRJT_EXPORT void srjt_device_shutdown() {
-  std::lock_guard<std::mutex> lock(g_sidecar_mu);
-  g_sidecar.reset();
+  std::shared_ptr<srjt::SidecarClient> victim;
+  {
+    std::lock_guard<std::mutex> lock(g_state_mu);
+    victim = std::move(g_sidecar);
+  }
+  // destructor (worker shutdown) runs outside the state mutex
 }
 
 SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* vals,
@@ -452,9 +467,9 @@ SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* va
                                             int64_t* out_counts) {
   return static_cast<int32_t>(guarded(
       [&]() -> int64_t {
-        std::lock_guard<std::mutex> lock(g_sidecar_mu);
-        if (!g_sidecar) throw std::runtime_error("no device sidecar connected");
-        g_sidecar->groupby_sum(keys, vals, n, num_keys, out_sums, out_counts);
+        auto client = sidecar_ref();
+        if (!client) throw std::runtime_error("no device sidecar connected");
+        client->groupby_sum(keys, vals, n, num_keys, out_sums, out_counts);
         return 0;
       },
       -1));
@@ -465,23 +480,22 @@ SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* va
 SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
   return guarded(
       [&]() -> int64_t {
-        {
-          // device path when a sidecar owns a chip; host engine
-          // otherwise (and on any sidecar failure — the op must not
-          // become less available because a worker died)
-          std::lock_guard<std::mutex> lock(g_sidecar_mu);
-          if (g_sidecar) {
-            try {
-              auto batches = g_sidecar->convert_to_rows(table_ref(table_h));
-              if (batches.size() == 1) {
-                return put_column(std::move(batches[0]));
-              }
-              // multi-batch: the single-handle ABI can't carry it yet
-              // (round-3 item: batch array returns); host engine has
-              // the same 2 GiB ceiling, so fall through
-            } catch (const std::exception&) {
-              // fall back to host engine below
+        // device path when a sidecar owns a chip; host engine
+        // otherwise (and on any sidecar failure — the op must not
+        // become less available because a worker died). Tables over
+        // the 2 GiB single-batch ceiling skip the dispatch: both
+        // engines reject them, so shipping GiBs to the worker first
+        // would just make the same failure expensive.
+        auto client = sidecar_ref();
+        if (client && srjt::rows_total_bytes(table_ref(table_h)) <= (int64_t(1) << 31) - 1) {
+          try {
+            auto batches = client->convert_to_rows(table_ref(table_h));
+            if (batches.size() == 1) {
+              return put_column(std::move(batches[0]));
             }
+            // unexpected batch count: fall through to the host engine
+          } catch (const std::exception&) {
+            // fall back to host engine below
           }
         }
         return put_column(srjt::convert_to_rows(table_ref(table_h)));
@@ -509,6 +523,13 @@ SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode
   return guarded_cast([&]() -> int64_t {
     return put_column(srjt::string_to_integer(
         col_ref(col_h), static_cast<srjt::TypeId>(out_type_id), ansi_mode != 0));
+  });
+}
+
+SRJT_EXPORT int64_t srjt_cast_string_to_decimal(int64_t col_h, int32_t ansi_mode,
+                                                int32_t precision, int32_t scale) {
+  return guarded_cast([&]() -> int64_t {
+    return put_column(srjt::string_to_decimal(col_ref(col_h), ansi_mode != 0, precision, scale));
   });
 }
 

@@ -110,6 +110,26 @@ RowLayout compute_row_layout(const std::vector<TypeId>& types) {
 
 // -- Table -> rows ----------------------------------------------------------
 
+int64_t rows_total_bytes(const NativeTable& table) {
+  std::vector<TypeId> types;
+  types.reserve(table.columns.size());
+  for (const auto& c : table.columns) types.push_back(c->type);
+  RowLayout layout = compute_row_layout(types);
+  int64_t n = table.num_rows();
+  if (layout.variable_cols.empty()) return n * layout.row_size_fixed;
+  int64_t total = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t var = 0;
+    for (int32_t ci : layout.variable_cols) {
+      const NativeColumn& c = *table.columns[static_cast<size_t>(ci)];
+      var += c.offsets[static_cast<size_t>(r) + 1] - c.offsets[static_cast<size_t>(r)];
+    }
+    int64_t sz = layout.fixed_end + var;
+    total += (sz + JCUDF_ROW_ALIGNMENT - 1) / JCUDF_ROW_ALIGNMENT * JCUDF_ROW_ALIGNMENT;
+  }
+  return total;
+}
+
 std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table) {
   std::vector<TypeId> types;
   types.reserve(table.columns.size());
@@ -385,6 +405,246 @@ std::unique_ptr<NativeColumn> string_to_integer(const NativeColumn& col, TypeId 
       store_int(*out, r, out_type, mag, neg);
     } else if (ansi_mode) {
       // first failing row wins (validate_ansi_column, cast_string.cu:594-627)
+      throw CastError(r, std::string(reinterpret_cast<const char*>(s), len), false);
+    }
+  }
+  if (!out->has_nulls()) out->validity.clear();
+  return out;
+}
+
+// -- string -> decimal (parity: ops/cast_decimal.py, reference
+// cast_string.cu:243-574) ----------------------------------------------------
+
+namespace {
+
+using u128 = unsigned __int128;
+
+bool is_all_nines_u128(u128 x) {
+  if (x == 0) return false;
+  u128 y = x + 1;
+  while (y % 10 == 0) y /= 10;
+  return y == 1;
+}
+
+// acc * 10^k with the reference's overflow semantics (equivalent to a
+// final-product check; k > 38 with acc != 0 always overflows).
+bool mul_pow10_checked(u128& acc, int64_t k, u128 limit) {
+  if (k <= 0 || acc == 0) return false;
+  if (k > 38) return true;
+  constexpr u128 u128_max = ~static_cast<u128>(0);
+  for (int64_t i = 0; i < k; ++i) {
+    if (acc > u128_max / 10) return true;
+    acc *= 10;
+  }
+  return acc > limit;
+}
+
+// One row of the two-pass decimal parse. Returns false when the value
+// is invalid for (precision, scale). States and counters mirror
+// ops/cast_decimal.py line for line (which itself mirrors
+// validate_and_exponent / string_to_decimal_kernel).
+bool parse_decimal_row(const uint8_t* s, int32_t len, int32_t precision, int32_t scale,
+                       u128 pos_limit, u128 neg_limit, u128* out_mag, bool* out_neg) {
+  int32_t i = 0;
+  while (i < len && is_ws(s[i])) ++i;
+  if (i >= len || len == 0) return false;
+  bool has_sign = s[i] == '+' || s[i] == '-';
+  bool positive = !(has_sign && s[i] == '-');
+  int32_t istart = i + (has_sign ? 1 : 0);
+  if (istart >= len) return false;
+
+  // pass 1: validation state machine + exponent + dot location
+  enum { D, EOS, ES, E, W, X };
+  int state = D;
+  bool dot_seen = false, exp_pos = true, prev_digit = false;
+  int32_t dot_rel = 0;
+  int32_t last_digit_abs = len;
+  uint64_t exp_mag = 0;
+  for (int32_t j = istart; j < len; ++j) {
+    uint8_t c = s[j];
+    bool d = c >= '0' && c <= '9';
+    bool w = is_ws(c);
+    bool dot = c == '.';
+    bool e = c == 'e' || c == 'E';
+    int32_t rel = j - istart;
+    int nxt;
+    if (state == D) {
+      if (d) nxt = D;
+      else if (dot && !dot_seen) nxt = D;
+      else if (e) nxt = EOS;
+      else if (w && rel != 0) nxt = W;
+      else nxt = X;
+    } else if (state == EOS) {
+      if (c == '+' || c == '-') nxt = ES;
+      else if (w && rel != 0) nxt = W;
+      else if (d) nxt = E;
+      else nxt = X;
+    } else if (state == ES || state == E) {
+      nxt = d ? E : X;
+    } else {  // W
+      nxt = w ? W : X;
+    }
+
+    if (state == D && dot && !dot_seen) {
+      dot_rel = rel;
+      dot_seen = true;
+    }
+    if (state == D && prev_digit && (nxt == EOS || nxt == W) && last_digit_abs == len) {
+      last_digit_abs = j;
+    }
+    if (state == EOS && c == '-') exp_pos = false;
+    bool consume_exp = (state == EOS || state == ES || state == E) && d && nxt == E;
+    if (consume_exp) {
+      uint64_t dig = c - '0';
+      constexpr uint64_t lim = (1ull << 63) - 1;
+      if (exp_mag != 0 && (exp_mag > lim / 10 || exp_mag * 10 > lim - dig)) return false;
+      exp_mag = exp_mag == 0 ? dig : exp_mag * 10 + dig;
+    }
+    prev_digit = d;
+    state = nxt;
+    if (state == X) return false;
+  }
+
+  int64_t exp_val = exp_pos ? static_cast<int64_t>(exp_mag) : -static_cast<int64_t>(exp_mag);
+  int64_t dl0 = dot_seen ? dot_rel : last_digit_abs - istart;
+  int64_t decimal_location = dl0 + exp_val;
+
+  // pass 2: accumulate up to the precision/scale cutoff
+  int32_t break_pos = len;
+  for (int32_t j = istart; j < len; ++j) {
+    uint8_t c = s[j];
+    if (!(c >= '0' && c <= '9') && c != '.') {
+      break_pos = j;
+      break;
+    }
+  }
+  int64_t last_digit = decimal_location - scale;
+  u128 limit = positive ? pos_limit : neg_limit;
+
+  u128 acc = 0;
+  int64_t total_digits = 0, num_precise = 0;
+  bool found_sig = false, has_cut = false;
+  int32_t cut_pos = len;
+  if (last_digit >= 0) {
+    int64_t td = 0;
+    for (int32_t j = istart; j < break_pos; ++j) {
+      uint8_t c = s[j];
+      if (!(c >= '0' && c <= '9')) continue;
+      ++td;
+      bool sig = found_sig || c != '0' || td > decimal_location;
+      // cutoff BEFORE accumulating this digit
+      if ((num_precise + 1 > precision) || (total_digits + 1 > last_digit)) {
+        has_cut = true;
+        cut_pos = j;
+        break;
+      }
+      acc = acc * 10 + (c - '0');
+      ++total_digits;
+      if (sig) ++num_precise;
+      found_sig = found_sig || sig;
+    }
+  }
+
+  // rounding at the cutoff digit
+  int64_t rounding_digits = 0;
+  if (has_cut) {
+    uint8_t cd = s[cut_pos];
+    if (cd >= '0' && cd <= '9' && cd - '0' >= 5) {
+      bool all_nines = is_all_nines_u128(acc);
+      u128 inc = acc + 1;
+      if (inc > limit) return false;
+      if (acc != 0 && all_nines) rounding_digits = 1;
+      acc = inc;
+    }
+  }
+  total_digits += rounding_digits;
+  num_precise += rounding_digits;
+  int64_t decimal_location_r = decimal_location + rounding_digits;
+
+  // significant digits before the decimal point in the string
+  int32_t e_pos = len;
+  for (int32_t j = istart; j < len; ++j) {
+    if (s[j] == 'e' || s[j] == 'E') {
+      e_pos = j;
+      break;
+    }
+  }
+  int64_t sig_in_string = 0, df = 0;
+  bool started = false;
+  for (int32_t j = istart; j < e_pos; ++j) {
+    if (s[j] == '.') continue;
+    ++df;
+    bool counted = df <= decimal_location;
+    if (counted && s[j] != '0') started = true;
+    if (counted && started) ++sig_in_string;
+  }
+
+  // zero padding up to the decimal location
+  int64_t zeros_to_decimal =
+      scale > 0 ? decimal_location_r - total_digits - scale : decimal_location_r - total_digits;
+  if (zeros_to_decimal < 0) zeros_to_decimal = 0;
+  int64_t sig_before_decimal = sig_in_string + zeros_to_decimal + rounding_digits;
+  if (precision + scale < sig_before_decimal) return false;
+  if (mul_pow10_checked(acc, zeros_to_decimal, limit)) return false;
+  num_precise += zeros_to_decimal;
+
+  // zero padding down to the scale
+  int64_t sig_preceding_zeros = decimal_location_r < 0 ? -decimal_location_r : 0;
+  int64_t digits_after_decimal = num_precise - sig_before_decimal + sig_preceding_zeros;
+  int64_t digits_needed = std::min<int64_t>(precision - sig_before_decimal,
+                                            -static_cast<int64_t>(scale));
+  int64_t pad = digits_needed - digits_after_decimal;
+  if (pad < 0) pad = 0;
+  if (mul_pow10_checked(acc, pad, limit)) return false;
+
+  *out_mag = acc;
+  *out_neg = !positive;
+  return true;
+}
+
+}  // namespace
+
+std::unique_ptr<NativeColumn> string_to_decimal(const NativeColumn& col, bool ansi_mode,
+                                                int32_t precision, int32_t scale) {
+  if (col.type != TypeId::STRING) {
+    throw std::runtime_error("string_to_decimal expects a STRING column");
+  }
+  if (precision < 1 || precision > 38) {
+    throw std::runtime_error("precision must be in [1, 38]");
+  }
+  TypeId out_type =
+      precision <= 9 ? TypeId::DECIMAL32 : (precision <= 18 ? TypeId::DECIMAL64 : TypeId::DECIMAL128);
+  u128 pos_limit, neg_limit;
+  if (out_type == TypeId::DECIMAL32) {
+    pos_limit = (static_cast<u128>(1) << 31) - 1;
+    neg_limit = static_cast<u128>(1) << 31;
+  } else if (out_type == TypeId::DECIMAL64) {
+    pos_limit = (static_cast<u128>(1) << 63) - 1;
+    neg_limit = static_cast<u128>(1) << 63;
+  } else {
+    pos_limit = (static_cast<u128>(1) << 127) - 1;
+    neg_limit = static_cast<u128>(1) << 127;
+  }
+
+  int64_t n = col.size;
+  auto out = std::make_unique<NativeColumn>();
+  out->type = out_type;
+  out->scale = scale;
+  out->size = n;
+  out->data.assign(static_cast<size_t>(n) * type_size_bytes(out_type), 0);
+  out->validity.assign(static_cast<size_t>(n), 0);
+  for (int64_t r = 0; r < n; ++r) {
+    if (!col.valid_at(r)) continue;  // null in -> null out, never an ANSI error
+    const uint8_t* s = col.chars.data() + col.offsets[static_cast<size_t>(r)];
+    int32_t len = col.offsets[static_cast<size_t>(r) + 1] - col.offsets[static_cast<size_t>(r)];
+    u128 mag = 0;
+    bool neg = false;
+    if (parse_decimal_row(s, len, precision, scale, pos_limit, neg_limit, &mag, &neg)) {
+      out->validity[static_cast<size_t>(r)] = 1;
+      u128 v = neg ? (static_cast<u128>(0) - mag) : mag;  // two's complement
+      int32_t w = type_size_bytes(out_type);
+      std::memcpy(out->data.data() + static_cast<int64_t>(r) * w, &v, w);
+    } else if (ansi_mode) {
       throw CastError(r, std::string(reinterpret_cast<const char*>(s), len), false);
     }
   }

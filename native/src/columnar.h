@@ -103,6 +103,9 @@ struct RowLayout {
 
 RowLayout compute_row_layout(const std::vector<TypeId>& types);
 
+// Total JCUDF row bytes the table would produce (batch/dispatch sizing).
+int64_t rows_total_bytes(const NativeTable& table);
+
 // Table -> one LIST<INT8> column of JCUDF rows (single batch; throws if
 // the blob would exceed the 2 GiB size_type limit).
 std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table);
@@ -115,6 +118,13 @@ std::unique_ptr<NativeTable> convert_from_rows(const NativeColumn& rows,
 // Spark string->integer cast; throws CastError in ANSI mode.
 std::unique_ptr<NativeColumn> string_to_integer(const NativeColumn& col, TypeId out_type,
                                                 bool ansi_mode);
+
+// Spark string->decimal cast (reference CastStrings.java:47-52 ->
+// cast_string.cu:785-801): output DECIMAL32/64/128 by precision, cudf
+// scale convention (negative = fraction digits); throws CastError in
+// ANSI mode. Byte-level parity with ops/cast_decimal.py.
+std::unique_ptr<NativeColumn> string_to_decimal(const NativeColumn& col, bool ansi_mode,
+                                                int32_t precision, int32_t scale);
 
 // DeltaLake-compatible interleaveBits: LIST<UINT8> output.
 std::unique_ptr<NativeColumn> interleave_bits(const NativeTable& table);

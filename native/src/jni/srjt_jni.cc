@@ -54,6 +54,8 @@ int64_t srjt_convert_to_rows(int64_t table_h);
 int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* type_ids,
                                const int32_t* scales, int32_t ncols);
 int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode, int32_t out_type_id);
+int64_t srjt_cast_string_to_decimal(int64_t col_h, int32_t ansi_mode, int32_t precision,
+                                    int32_t scale);
 int32_t srjt_last_cast_error_pending();
 int64_t srjt_last_cast_row();
 const char* srjt_last_cast_string();
@@ -332,38 +334,49 @@ JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertFr
   return h;
 }
 
-JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_CastStrings_toIntegerNative(
-    JNIEnv* env, jclass, jlong handle, jboolean ansi_mode, jint type_id) {
-  int64_t h = srjt_cast_string_to_integer(handle, ansi_mode == JNI_TRUE ? 1 : 0, type_id);
-  if (h == 0) {
-    if (srjt_last_cast_error_pending() != 0) {
-      // CATCH_CAST_EXCEPTION shape (reference CastStringJni.cpp:25-44).
-      // The offending value is arbitrary bytes: sanitize to 7-bit ASCII
-      // before NewStringUTF (invalid modified-UTF-8 is JNI UB).
-      std::string safe = srjt_last_cast_string();
-      for (char& c : safe) {
-        if (static_cast<unsigned char>(c) > 0x7F || c == '\0') c = '?';
-      }
-      jclass ex = env->FindClass("com/nvidia/spark/rapids/jni/CastException");
-      if (ex != nullptr) {
-        jmethodID ctor = env->GetMethodID(ex, "<init>", "(Ljava/lang/String;I)V");
-        if (ctor != nullptr) {
-          jstring jstr = env->NewStringUTF(safe.c_str());
-          if (jstr != nullptr) {
-            jobject e = env->NewObject(ex, ctor, jstr,
-                                       static_cast<jint>(srjt_last_cast_row()));
-            if (e != nullptr) {
-              env->Throw(static_cast<jthrowable>(e));
-            }
+// CATCH_CAST_EXCEPTION shape (reference CastStringJni.cpp:25-44): when
+// a cast error is pending, throw CastException with the first failing
+// row + value; otherwise fall back to RuntimeException. The offending
+// value is arbitrary bytes: sanitize to 7-bit ASCII before
+// NewStringUTF (invalid modified-UTF-8 is JNI UB).
+static void throw_cast_or_last(JNIEnv* env) {
+  if (srjt_last_cast_error_pending() != 0) {
+    std::string safe = srjt_last_cast_string();
+    for (char& c : safe) {
+      if (static_cast<unsigned char>(c) > 0x7F || c == '\0') c = '?';
+    }
+    jclass ex = env->FindClass("com/nvidia/spark/rapids/jni/CastException");
+    if (ex != nullptr) {
+      jmethodID ctor = env->GetMethodID(ex, "<init>", "(Ljava/lang/String;I)V");
+      if (ctor != nullptr) {
+        jstring jstr = env->NewStringUTF(safe.c_str());
+        if (jstr != nullptr) {
+          jobject e = env->NewObject(ex, ctor, jstr, static_cast<jint>(srjt_last_cast_row()));
+          if (e != nullptr) {
+            env->Throw(static_cast<jthrowable>(e));
           }
         }
       }
-      if (env->ExceptionCheck()) {
-        return 0;  // CastException (or a JNI failure) is already pending
-      }
     }
-    throw_last_error(env);
+    if (env->ExceptionCheck()) {
+      return;  // CastException (or a JNI failure) is already pending
+    }
   }
+  throw_last_error(env);
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_CastStrings_toIntegerNative(
+    JNIEnv* env, jclass, jlong handle, jboolean ansi_mode, jint type_id) {
+  int64_t h = srjt_cast_string_to_integer(handle, ansi_mode == JNI_TRUE ? 1 : 0, type_id);
+  if (h == 0) throw_cast_or_last(env);
+  return h;
+}
+
+JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_CastStrings_toDecimalNative(
+    JNIEnv* env, jclass, jlong handle, jboolean ansi_mode, jint precision, jint scale) {
+  int64_t h =
+      srjt_cast_string_to_decimal(handle, ansi_mode == JNI_TRUE ? 1 : 0, precision, scale);
+  if (h == 0) throw_cast_or_last(env);
   return h;
 }
 

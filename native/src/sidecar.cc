@@ -174,6 +174,7 @@ std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8
 
 void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t n,
                                 int32_t num_keys, float* out_sums, int64_t* out_counts) {
+  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   payload.reserve(12 + static_cast<size_t>(n) * 12);
   append_val<uint32_t>(payload, static_cast<uint32_t>(num_keys));
@@ -190,6 +191,7 @@ void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t 
 
 std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
     const NativeTable& table) {
+  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_val<uint32_t>(payload, static_cast<uint32_t>(table.columns.size()));
   for (const auto& col : table.columns) {

@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -45,6 +46,10 @@ class SidecarClient {
 
  private:
   std::vector<uint8_t> request(uint32_t op, const std::vector<uint8_t>& payload);
+
+  // one socket, one in-flight request: ops serialize HERE, not on the
+  // library-global registry mutex (host-engine fallbacks stay free)
+  std::mutex op_mu_;
   void send_all(const void* buf, size_t n);
   void recv_all(void* buf, size_t n);
 

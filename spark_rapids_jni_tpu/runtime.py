@@ -153,6 +153,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_convert_from_rows.argtypes = [ctypes.c_int64, i32p, i32p, ctypes.c_int32]
     lib.srjt_cast_string_to_integer.restype = ctypes.c_int64
     lib.srjt_cast_string_to_integer.argtypes = [ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+    lib.srjt_cast_string_to_decimal.restype = ctypes.c_int64
+    lib.srjt_cast_string_to_decimal.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
     lib.srjt_last_cast_error_pending.restype = ctypes.c_int32
     lib.srjt_last_cast_row.restype = ctypes.c_int64
     lib.srjt_last_cast_string.restype = ctypes.c_char_p
@@ -601,6 +605,8 @@ def device_groupby_sum(keys, vals, num_keys: int):
         raise RuntimeError("native runtime not built (run cmake in native/)")
     keys = np.ascontiguousarray(keys, np.int64)
     vals = np.ascontiguousarray(vals, np.float32)
+    if len(keys) != len(vals):
+        raise ValueError(f"keys/vals length mismatch: {len(keys)} vs {len(vals)}")
     sums = np.empty(num_keys, np.float32)
     counts = np.empty(num_keys, np.int64)
     rc = lib.srjt_device_groupby_sum(
@@ -640,6 +646,23 @@ def native_cast_string_to_integer(col: NativeColumn, ansi_mode: bool, out_dtype)
     in ANSI mode on the first failing row."""
     lib = col._lib
     h = lib.srjt_cast_string_to_integer(col.handle, 1 if ansi_mode else 0, int(out_dtype.id))
+    if h == 0:
+        if lib.srjt_last_cast_error_pending():
+            raise NativeCastError(
+                int(lib.srjt_last_cast_row()),
+                lib.srjt_last_cast_string().decode("utf-8", "replace"),
+            )
+        _raise_last(lib)
+    return NativeColumn(h, lib)
+
+
+def native_cast_string_to_decimal(
+    col: NativeColumn, ansi_mode: bool, precision: int, scale: int
+) -> NativeColumn:
+    """CastStrings.toDecimal through the C ABI; raises NativeCastError
+    in ANSI mode on the first failing row."""
+    lib = col._lib
+    h = lib.srjt_cast_string_to_decimal(col.handle, 1 if ansi_mode else 0, precision, scale)
     if h == 0:
         if lib.srjt_last_cast_error_pending():
             raise NativeCastError(

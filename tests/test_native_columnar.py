@@ -21,6 +21,7 @@ from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.columnar import dtype as dt
 from spark_rapids_jni_tpu.ops import row_conversion as rc
 from spark_rapids_jni_tpu.ops import zorder as zo
+from spark_rapids_jni_tpu.ops.cast_decimal import string_to_decimal
 from spark_rapids_jni_tpu.ops.cast_string import string_to_integer
 
 pytestmark = pytest.mark.skipif(
@@ -144,6 +145,61 @@ def test_cast_to_integer_matches_python_op(rng):
         want = string_to_integer(col_from(corpus, dt.STRING), False, d).to_pylist()
         got = _native_to_integer(corpus, False, d)
         assert got == want, d
+
+
+def _native_to_decimal(strings, precision, scale, ansi=False):
+    from spark_rapids_jni_tpu.columnar.dtype import decimal32, decimal64, decimal128
+
+    d = decimal32(scale) if precision <= 9 else (
+        decimal64(scale) if precision <= 18 else decimal128(scale)
+    )
+    with runtime.NativeColumn.from_python(col_from(strings, dt.STRING)) as sc:
+        with runtime.native_cast_string_to_decimal(sc, ansi, precision, scale) as out:
+            assert out._lib.srjt_column_type(out.handle) == int(d.id)
+            assert out._lib.srjt_column_scale(out.handle) == scale
+            return out.to_python(d).to_pylist()
+
+
+def test_cast_to_decimal_goldens():
+    """Reference StringToDecimalTests shapes (cast_string.cu battery,
+    :245-541): simple/rounding/exponent/overprecision/positive scale."""
+    assert _native_to_decimal(["1.23", "-2.5", "0.05", None, "x"], 5, -2) == [
+        123, -250, 5, None, None,
+    ]
+    assert _native_to_decimal(["1.255", "1.254", "-1.255"], 5, -2) == [126, 125, -126]
+    assert _native_to_decimal(["1.5e2", "-12E-1", "3e0"], 7, -1) == [1500, -12, 30]
+    assert _native_to_decimal(["12345.67"], 4, -2) == [None]  # overprecise
+    # positive scale 1: unscaled value excludes the scaled-away digit
+    assert _native_to_decimal(["1234", "12345", "150"], 3, 1) == [123, None, 15]
+    assert _native_to_decimal(["99999999999999999999", "1"], 20, 0) == [
+        99999999999999999999, 1,
+    ]
+
+
+def test_cast_to_decimal_ansi():
+    assert _native_to_decimal(["1.5", "2.5"], 4, -1, ansi=True) == [15, 25]
+    with pytest.raises(runtime.NativeCastError) as ei:
+        _native_to_decimal(["1.5", "bad7", "2"], 4, -1, ansi=True)
+    assert ei.value.row_with_error == 1
+    assert ei.value.string_with_error == "bad7"
+
+
+def test_cast_to_decimal_matches_python_op():
+    corpus = [
+        "0", "1", "-1", "1.5", "-1.5", "1.25", "-1.25", "0.05", ".5", "5.",
+        " 42.42 ", "+7.001", "007.900", "", " ", ".", "..", "1..2",
+        "1e3", "1E-3", "-1.5e2", "1e", "1e+", "1e99999999999999999999",
+        "9" * 40, "0." + "9" * 40, "123456789012345678901234567890123456789",
+        "0.000000000000000000000000000000000000001", None,
+        "\t1.5\n", "1.5 x", "x1.5", "- 1", "1 1", "nan", "inf",
+        "99999999999999999.99", "-99999999999999999.99",
+    ]
+    for precision, scale in [(5, -2), (9, 0), (18, -6), (38, -10), (10, 2), (3, 1), (38, 0)]:
+        want = string_to_decimal(
+            col_from(corpus, dt.STRING), False, precision, scale
+        ).to_pylist()
+        got = _native_to_decimal(corpus, precision, scale)
+        assert got == want, (precision, scale)
 
 
 def test_zorder_matches_python(rng):

@@ -33,9 +33,10 @@ def test_connect_reports_backend(sidecar):
     # conftest pins JAX_PLATFORMS=cpu for hermetic tests; the sidecar
     # inherits it — on a real deployment this reads "tpu"
     assert sidecar == runtime.device_platform()
-    assert sidecar in ("cpu", "tpu")
-    expect = "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else sidecar
-    assert sidecar == expect
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        assert sidecar == "cpu"  # the hermetic pin must reach the worker
+    else:  # pragma: no cover - real-chip runs assert in the verify script
+        assert sidecar in ("cpu", "tpu")
 
 
 def test_device_groupby_sum(sidecar):
