@@ -454,6 +454,9 @@ SRJT_EXPORT const char* srjt_device_platform() {
 }
 
 SRJT_EXPORT void srjt_device_shutdown() {
+  // hold the connect mutex too: a concurrent connect mid-construction
+  // must not install a fresh worker after this shutdown returns
+  std::lock_guard<std::mutex> connect_lock(g_connect_mu);
   std::shared_ptr<srjt::SidecarClient> victim;
   {
     std::lock_guard<std::mutex> lock(g_state_mu);
@@ -487,7 +490,7 @@ SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
         // engines reject them, so shipping GiBs to the worker first
         // would just make the same failure expensive.
         auto client = sidecar_ref();
-        if (client && srjt::rows_total_bytes(table_ref(table_h)) <= (int64_t(1) << 31) - 1) {
+        if (client && srjt::rows_total_bytes(table_ref(table_h)) <= srjt::MAX_BATCH_BYTES) {
           try {
             auto batches = client->convert_to_rows(table_ref(table_h));
             if (batches.size() == 1) {

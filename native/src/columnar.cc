@@ -5,10 +5,21 @@
 namespace srjt {
 
 namespace {
-constexpr int64_t MAX_BATCH_BYTES = (int64_t(1) << 31) - 1;  // cudf size_type
 constexpr int32_t JCUDF_ROW_ALIGNMENT = 8;
 
 int32_t round_up(int32_t v, int32_t align) { return (v + align - 1) / align * align; }
+
+// Aligned byte size of one row under `layout` — the single source for
+// both batch sizing (rows_total_bytes) and the encode loop.
+int64_t row_bytes(const srjt::RowLayout& layout, const srjt::NativeTable& table, int64_t r) {
+  int64_t var = 0;
+  for (int32_t ci : layout.variable_cols) {
+    const srjt::NativeColumn& c = *table.columns[static_cast<size_t>(ci)];
+    var += c.offsets[static_cast<size_t>(r) + 1] - c.offsets[static_cast<size_t>(r)];
+  }
+  int64_t sz = layout.fixed_end + var;
+  return (sz + JCUDF_ROW_ALIGNMENT - 1) / JCUDF_ROW_ALIGNMENT * JCUDF_ROW_ALIGNMENT;
+}
 }  // namespace
 
 int32_t type_size_bytes(TypeId t) {
@@ -118,15 +129,7 @@ int64_t rows_total_bytes(const NativeTable& table) {
   int64_t n = table.num_rows();
   if (layout.variable_cols.empty()) return n * layout.row_size_fixed;
   int64_t total = 0;
-  for (int64_t r = 0; r < n; ++r) {
-    int64_t var = 0;
-    for (int32_t ci : layout.variable_cols) {
-      const NativeColumn& c = *table.columns[static_cast<size_t>(ci)];
-      var += c.offsets[static_cast<size_t>(r) + 1] - c.offsets[static_cast<size_t>(r)];
-    }
-    int64_t sz = layout.fixed_end + var;
-    total += (sz + JCUDF_ROW_ALIGNMENT - 1) / JCUDF_ROW_ALIGNMENT * JCUDF_ROW_ALIGNMENT;
-  }
+  for (int64_t r = 0; r < n; ++r) total += row_bytes(layout, table, r);
   return total;
 }
 
@@ -142,16 +145,7 @@ std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table) {
   // let a >2^31-byte row wrap negative and bypass the check
   std::vector<int64_t> row_size(static_cast<size_t>(n), layout.row_size_fixed);
   if (!layout.variable_cols.empty()) {
-    for (int64_t r = 0; r < n; ++r) {
-      int64_t var = 0;
-      for (int32_t ci : layout.variable_cols) {
-        const NativeColumn& c = *table.columns[static_cast<size_t>(ci)];
-        var += c.offsets[static_cast<size_t>(r) + 1] - c.offsets[static_cast<size_t>(r)];
-      }
-      int64_t sz = layout.fixed_end + var;
-      row_size[static_cast<size_t>(r)] =
-          (sz + JCUDF_ROW_ALIGNMENT - 1) / JCUDF_ROW_ALIGNMENT * JCUDF_ROW_ALIGNMENT;
-    }
+    for (int64_t r = 0; r < n; ++r) row_size[static_cast<size_t>(r)] = row_bytes(layout, table, r);
   }
   int64_t total = 0;
   for (int64_t s : row_size) total += s;

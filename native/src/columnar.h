@@ -30,6 +30,9 @@
 
 namespace srjt {
 
+// cudf size_type ceiling per row batch (row_conversion.cu:67,100-105)
+constexpr int64_t MAX_BATCH_BYTES = (int64_t(1) << 31) - 1;
+
 enum class TypeId : int32_t {
   EMPTY = 0,
   INT8 = 1,

@@ -641,18 +641,25 @@ def native_convert_from_rows(rows: NativeColumn, dtypes) -> NativeTable:
     return NativeTable(h, lib)
 
 
+def _raise_cast_or_last(lib) -> None:
+    """ANSI cast-error protocol (CATCH_CAST_EXCEPTION shape): raise
+    NativeCastError with the first failing row when one is pending,
+    else the generic native error."""
+    if lib.srjt_last_cast_error_pending():
+        raise NativeCastError(
+            int(lib.srjt_last_cast_row()),
+            lib.srjt_last_cast_string().decode("utf-8", "replace"),
+        )
+    _raise_last(lib)
+
+
 def native_cast_string_to_integer(col: NativeColumn, ansi_mode: bool, out_dtype) -> NativeColumn:
     """CastStrings.toInteger through the C ABI; raises NativeCastError
     in ANSI mode on the first failing row."""
     lib = col._lib
     h = lib.srjt_cast_string_to_integer(col.handle, 1 if ansi_mode else 0, int(out_dtype.id))
     if h == 0:
-        if lib.srjt_last_cast_error_pending():
-            raise NativeCastError(
-                int(lib.srjt_last_cast_row()),
-                lib.srjt_last_cast_string().decode("utf-8", "replace"),
-            )
-        _raise_last(lib)
+        _raise_cast_or_last(lib)
     return NativeColumn(h, lib)
 
 
@@ -664,12 +671,7 @@ def native_cast_string_to_decimal(
     lib = col._lib
     h = lib.srjt_cast_string_to_decimal(col.handle, 1 if ansi_mode else 0, precision, scale)
     if h == 0:
-        if lib.srjt_last_cast_error_pending():
-            raise NativeCastError(
-                int(lib.srjt_last_cast_row()),
-                lib.srjt_last_cast_string().decode("utf-8", "replace"),
-            )
-        _raise_last(lib)
+        _raise_cast_or_last(lib)
     return NativeColumn(h, lib)
 
 
