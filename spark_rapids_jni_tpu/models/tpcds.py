@@ -76,26 +76,22 @@ def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Tab
     ORDER BY d_year, sum_agg DESC, i_brand_id
     """
     item = tables["item"]
-    keep_item = (col("i_manufact_id") == lit(np.int32(manufact_id))).evaluate(item)
-    item_f = copying.apply_boolean_mask(item, keep_item)
-
     dates = tables["date_dim"]
-    keep_date = (col("d_moy") == lit(np.int32(month))).evaluate(dates)
-    dates_f = copying.apply_boolean_mask(dates, keep_date)
-
     ss = tables["store_sales"]
-    # join small dims into the fact table (hash join, build = dim side)
-    j1 = _join_on_renamed(ss, dates_f, "ss_sold_date_sk", "d_date_sk", ["d_year"])
-    j2 = _join_on_renamed(j1, item_f, "ss_item_sk", "i_item_sk", ["i_brand_id"])
 
-    # aggregation stage lowered through the generic compiled pipeline;
-    # the bounded group-key domains come from the DIMENSION tables (tiny,
-    # so the host sync is cheap): d_year from date_dim, i_brand_id from
-    # item — not hard-coded, so any caller-supplied star schema works
+    # the WHOLE stage — star joins (with build-side dim filters), group
+    # keys, aggregate — lowers through ONE compiled program; the bounded
+    # domains come from the DIMENSION tables (tiny, so the host sync is
+    # cheap) — not hard-coded, so any caller-supplied star schema works
     year_lo = int(jnp.min(dates.column("d_year").data))
     year_hi = int(jnp.max(dates.column("d_year").data))
     n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
-    agg = _q3_agg_pipeline(year_lo, year_hi - year_lo + 1, n_brands)(j2)
+    n_dates = int(jnp.max(dates.column("d_date_sk").data)) + 1
+    n_items = int(jnp.max(item.column("i_item_sk").data)) + 1
+    agg = _q3_pipeline(
+        year_lo, year_hi - year_lo + 1, n_brands, n_dates, n_items,
+        int(manufact_id), int(month),
+    )(ss, {"date_dim": dates, "item": item})
     agg = Table(
         [
             Column(dt.INT32, data=agg.column("year_idx").data + jnp.int32(year_lo)),
@@ -116,24 +112,30 @@ import functools
 
 
 @functools.lru_cache(maxsize=16)
-def _q3_agg_pipeline(year_lo: int, n_years: int, n_brands: int):
-    from ..pipeline import Agg, GroupKey, PlanSpec, compile_plan
+def _q3_pipeline(year_lo: int, n_years: int, n_brands: int, n_dates: int, n_items: int,
+                 manufact_id: int, month: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
 
     return compile_plan(
         PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk", build_key="d_date_sk",
+                    num_keys=n_dates, payload=("d_year",),
+                    build_filter=col("d_moy") == lit(np.int32(month)),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk", build_key="i_item_sk",
+                    num_keys=n_items, payload=("i_brand_id",),
+                    build_filter=col("i_manufact_id") == lit(np.int32(manufact_id)),
+                ),
+            ),
             project=(("year_idx", col("d_year") - lit(np.int32(year_lo))),),
             group_by=(GroupKey("year_idx", n_years), GroupKey("i_brand_id", n_brands)),
             aggregates=(Agg("ss_ext_sales_price", "sum", "ss_ext_sales_price_sum"),),
         )
     )
 
-
-def _join_on_renamed(left: Table, right: Table, lkey: str, rkey: str, payload) -> Table:
-    """Join where key columns have different names: present the right
-    table with its key renamed to the left's."""
-    rsel = right.select([rkey] + list(payload))
-    rsel = Table(rsel.columns, [lkey] + list(payload))
-    return inner_join(left, rsel, on=[lkey])
 
 
 def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:

@@ -37,7 +37,7 @@ from .ops import bitutils
 from .ops.expressions import Expression
 from .utils.dispatch import op_boundary
 
-__all__ = ["Agg", "GroupKey", "PlanSpec", "CompiledPipeline", "compile_plan"]
+__all__ = ["Agg", "GroupKey", "JoinSpec", "PlanSpec", "CompiledPipeline", "compile_plan"]
 
 _AGG_HOWS = ("sum", "count", "count_all", "min", "max", "mean")
 
@@ -64,11 +64,44 @@ class GroupKey:
 
 
 @dataclasses.dataclass(frozen=True)
-class PlanSpec:
-    """Declarative single-stage plan: filter -> project -> aggregate.
+class JoinSpec:
+    """Bounded-domain hash join against a BUILD table (the broadcast
+    dim-join Spark offloads per stage; q3's star joins, q95's EXISTS /
+    NOT EXISTS). TPU-first execution: the build side scatters into a
+    DENSE [num_keys] presence/payload map (dim keys are bounded), and
+    the probe is a row gather — no sort, no dynamic shapes, and probe
+    misses flow into the same trash-segment mask the filter uses.
 
-    ``project`` derives named columns from expressions (evaluated over
-    the input schema); aggregates may reference input OR projected
+    ``how``: "inner" gathers ``payload`` columns into the working
+    schema and drops probe misses; "semi"/"anti" keep/drop rows by
+    presence only (payload must be empty). Build keys must be UNIQUE
+    among rows passing ``build_filter`` for inner joins with payload —
+    duplicates are surfaced as a loud error, like out-of-domain group
+    keys."""
+
+    build: str  # name of the build table passed to __call__
+    probe_key: str  # column in the working (fact-side) schema
+    build_key: str  # column in the build table
+    num_keys: int  # bounded domain of the build key
+    payload: Tuple[str, ...] = ()
+    how: str = "inner"
+    build_filter: Optional[Expression] = None
+
+    def __post_init__(self):
+        if self.how not in ("inner", "semi", "anti"):
+            raise ValueError(f"unknown join {self.how!r}")
+        if self.how != "inner" and self.payload:
+            raise ValueError("payload columns require an inner join")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanSpec:
+    """Declarative single-stage plan: join* -> filter -> project ->
+    aggregate, compiled to ONE program.
+
+    ``joins`` apply in order and splice their payload columns into the
+    working schema; ``filter`` and ``project`` see the post-join
+    schema; aggregates may reference input, payload, or projected
     names. With no ``group_by`` the stage is a global aggregation
     producing one row.
     """
@@ -77,6 +110,7 @@ class PlanSpec:
     project: Tuple[Tuple[str, Expression], ...] = ()
     group_by: Tuple[GroupKey, ...] = ()
     aggregates: Tuple[Agg, ...] = ()
+    joins: Tuple[JoinSpec, ...] = ()
 
     def __post_init__(self):
         if not self.aggregates:
@@ -105,19 +139,31 @@ class CompiledPipeline:
         self._fn = jax.jit(self._trace)
 
     # -- traced body (ONE program) -----------------------------------------
-    def _trace(self, table: Table):
+    def _trace(self, table: Table, builds: Dict[str, Table]):
         plan = self.plan
+        cols = dict(zip(table.names, table.columns))
         mask = None
+        n_dup = jnp.zeros((), jnp.int64)
+
+        for js in plan.joins:
+            hit, joined, dups = _dense_join(js, cols, builds[js.build])
+            n_dup = n_dup + dups
+            keep = ~hit if js.how == "anti" else hit
+            mask = keep if mask is None else mask & keep
+            cols.update(joined)
+
         if plan.filter is not None:
-            pred = plan.filter.evaluate(table)
-            mask = pred.data.astype(bool)
+            work = Table(list(cols.values()), list(cols.keys()))
+            pred = plan.filter.evaluate(work)
+            fm = pred.data.astype(bool)
             if pred.validity is not None:
-                mask = mask & pred.validity
+                fm = fm & pred.validity
+            mask = fm if mask is None else mask & fm
 
         # projected columns become part of the working schema
-        cols = dict(zip(table.names, table.columns))
+        work = Table(list(cols.values()), list(cols.keys()))
         for name, expr in plan.project:
-            cols[name] = expr.evaluate(table)
+            cols[name] = expr.evaluate(work)
 
         def masked_valid(col: Column):
             v = None if col.validity is None else col.validity
@@ -135,7 +181,7 @@ class CompiledPipeline:
                 else:
                     v = masked_valid(col)
                 out[agg.out_name] = _global_agg(col, v, agg.how)
-            return out, None, None, None
+            return out, None, None, None, n_dup
 
         # mixed-radix group id over the bounded domains; rows filtered
         # out (or null-keyed) land in the trash segment
@@ -171,13 +217,24 @@ class CompiledPipeline:
             col = cols[agg.source]
             v = None if col.validity is None else col.validity
             aggs[agg.out_name] = _grouped_agg(col, v, gid, num, agg.how, counts_all)
-        return aggs, counts_all, num, n_out_of_domain
+        return aggs, counts_all, num, n_out_of_domain, n_dup
 
     # -- host wrapper -------------------------------------------------------
     @op_boundary("compiled_pipeline")
-    def __call__(self, table: Table) -> Table:
-        aggs, counts_all, num, n_oob = self._fn(table)
+    def __call__(self, table: Table, builds: Optional[Dict[str, Table]] = None) -> Table:
         plan = self.plan
+        want = {js.build for js in plan.joins}
+        have = set(builds or {})
+        if want != have:
+            raise ValueError(f"plan needs build tables {sorted(want)}, got {sorted(have)}")
+        aggs, counts_all, num, n_oob, n_dup = self._fn(table, builds or {})
+        if any(js.how == "inner" for js in plan.joins):
+            dups = int(n_dup)  # host sync only when an inner join exists
+            if dups:
+                raise ValueError(
+                    f"{dups} duplicate build keys in an inner-join payload map; "
+                    "bounded-domain joins require unique build keys"
+                )
         if n_oob is not None:
             oob = int(n_oob)  # piggybacks on the result-size host sync
             if oob:
@@ -260,6 +317,57 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
         return s, has_vals
     s = jax.ops.segment_max(jnp.where(m, x, -jnp.inf), gid_v, num_segments=num + 1)[:num]
     return s, has_vals
+
+
+def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
+    """One bounded-domain join: scatter the (filtered) build side into
+    dense presence/payload maps, probe by row gather. Returns
+    (hit [N] bool, {name: joined Column}, duplicate-key count)."""
+    num = js.num_keys
+    bk = bt.column(js.build_key)
+    enter = bk.valid_mask()
+    if js.build_filter is not None:
+        bf = js.build_filter.evaluate(bt)
+        bfm = bf.data.astype(bool)
+        if bf.validity is not None:
+            bfm = bfm & bf.validity
+        enter = enter & bfm
+    # domain guard BEFORE the i32 narrowing: an int64 key >= 2^31 must
+    # miss, not wrap into the valid domain
+    enter = enter & (bk.data >= 0) & (bk.data < num)
+    bkeys = bk.data.astype(jnp.int32)
+    slot = jnp.where(enter, bkeys, num)  # trash slot for dropped rows
+
+    present = (
+        jnp.zeros((num + 1,), bool).at[slot].set(True, mode="drop")[:num]
+    )
+    dups = jnp.zeros((), jnp.int64)
+    if js.how == "inner":
+        # duplicate build keys would silently collapse inner-join row
+        # multiplicity to semi semantics — always surfaced, with or
+        # without payload columns
+        cnt = jax.ops.segment_sum(enter.astype(jnp.int32), slot, num_segments=num + 1)[:num]
+        dups = jnp.sum((cnt > 1).astype(jnp.int64))
+
+    pcol = cols[js.probe_key]
+    indom = (pcol.data >= 0) & (pcol.data < num)
+    pkc = jnp.clip(pcol.data, 0, num - 1).astype(jnp.int32)
+    hit = present[pkc] & indom & pcol.valid_mask()
+
+    joined: Dict[str, Column] = {}
+    for pname in js.payload:
+        src = bt.column(pname)
+        d = src.dtype
+        if not d.is_fixed_width or d.id == dt.TypeId.DECIMAL128:
+            raise ValueError(f"join payload {pname!r}: only plain fixed-width columns")
+        dense = jnp.zeros((num + 1,), src.data.dtype).at[slot].set(
+            jnp.where(enter, src.data, jnp.zeros((), src.data.dtype)), mode="drop"
+        )[:num]
+        dvalid = (
+            jnp.zeros((num + 1,), bool).at[slot].set(src.valid_mask() & enter, mode="drop")[:num]
+        )
+        joined[pname] = Column(d, data=dense[pkc], validity=dvalid[pkc] & hit)
+    return hit, joined, dups
 
 
 def _wrap_result(data, valid, how: str) -> Column:

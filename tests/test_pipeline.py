@@ -149,3 +149,155 @@ def test_out_of_domain_keys_raise():
     pipe = compile_plan(PlanSpec(group_by=(GroupKey("k", 4),), aggregates=(Agg("v", "sum"),)))
     with pytest.raises(ValueError, match="outside the declared bounded domain"):
         pipe(t)
+
+
+# -- joins (scan -> join* -> filter -> group -> agg in ONE program) ----------
+
+
+def _join_fixture(rng, n=4000, n_dims=50):
+    fact = make_table(
+        fk=(rng.integers(0, n_dims + 5, n).tolist(), dt.INT32),  # some misses
+        v=([float(v) for v in rng.uniform(0, 10, n)], dt.FLOAT64),
+    )
+    dim = make_table(
+        dk=(list(range(n_dims)), dt.INT32),
+        grp=(rng.integers(0, 4, n_dims).tolist(), dt.INT32),
+        flag=(rng.integers(0, 2, n_dims).tolist(), dt.INT32),
+    )
+    return fact, dim
+
+
+def test_inner_join_payload_groupby_matches_pandas(rng):
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact, dim = _join_fixture(rng)
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="dim", probe_key="fk", build_key="dk", num_keys=50,
+                    payload=("grp",), build_filter=col("flag") == lit(np.int32(1)),
+                ),
+            ),
+            group_by=(GroupKey("grp", 4),),
+            aggregates=(Agg("v", "sum"), Agg("v", "count_all")),
+        )
+    )
+    out = pipe(fact, {"dim": dim})
+
+    df = pd.DataFrame({"fk": fact.column("fk").to_pylist(), "v": fact.column("v").to_pylist()})
+    dd = pd.DataFrame({
+        "dk": dim.column("dk").to_pylist(),
+        "grp": dim.column("grp").to_pylist(),
+        "flag": dim.column("flag").to_pylist(),
+    })
+    want = (
+        df.merge(dd[dd.flag == 1], left_on="fk", right_on="dk")
+        .groupby("grp")
+        .agg(v_sum=("v", "sum"), n=("v", "size"))
+        .reset_index()
+        .sort_values("grp")
+    )
+    got = dict(zip(out.column("grp").to_pylist(), out.column("v_sum").to_pylist()))
+    want_map = dict(zip(want.grp.tolist(), want.v_sum.tolist()))
+    assert set(got) == set(want_map)
+    for g in got:
+        assert abs(got[g] - want_map[g]) < 1e-9
+    got_n = dict(zip(out.column("grp").to_pylist(), out.column("v_count_all").to_pylist()))
+    assert got_n == dict(zip(want.grp.tolist(), want.n.tolist()))
+
+
+def test_semi_and_anti_join_the_q95_shape(rng):
+    """EXISTS / NOT EXISTS against a second table — the TPC-DS q95
+    shape (orders with returns / without returns) expressed as plan
+    joins."""
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact, dim = _join_fixture(rng)
+    df = pd.DataFrame({"fk": fact.column("fk").to_pylist(), "v": fact.column("v").to_pylist()})
+    dd = pd.DataFrame({"dk": dim.column("dk").to_pylist(), "flag": dim.column("flag").to_pylist()})
+    present = set(dd[dd.flag == 1].dk.tolist())
+
+    for how, keep in (("semi", lambda k: k in present), ("anti", lambda k: k not in present)):
+        pipe = compile_plan(
+            PlanSpec(
+                joins=(
+                    JoinSpec(
+                        build="dim", probe_key="fk", build_key="dk", num_keys=50,
+                        how=how, build_filter=col("flag") == lit(np.int32(1)),
+                    ),
+                ),
+                aggregates=(Agg("v", "sum"), Agg("v", "count_all")),
+            )
+        )
+        out = pipe(fact, {"dim": dim})
+        want_rows = df[df.fk.map(keep)]
+        assert out.column("v_count_all").to_pylist() == [len(want_rows)], how
+        assert abs(out.column("v_sum").to_pylist()[0] - want_rows.v.sum()) < 1e-9, how
+
+
+def test_inner_join_duplicate_build_keys_raise(rng):
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact = make_table(fk=([0, 1], dt.INT32), v=([1.0, 2.0], dt.FLOAT64))
+    dim = make_table(dk=([1, 1], dt.INT32), p=([5, 6], dt.INT32))
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(JoinSpec(build="dim", probe_key="fk", build_key="dk", num_keys=4,
+                            payload=("p",)),),
+            aggregates=(Agg("v", "sum"),),
+        )
+    )
+    with pytest.raises(ValueError, match="duplicate build keys"):
+        pipe(fact, {"dim": dim})
+
+
+def test_join_build_tables_must_match_plan(rng):
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact = make_table(fk=([0], dt.INT32), v=([1.0], dt.FLOAT64))
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(JoinSpec(build="dim", probe_key="fk", build_key="dk", num_keys=4),),
+            aggregates=(Agg("v", "sum"),),
+        )
+    )
+    with pytest.raises(ValueError, match="build tables"):
+        pipe(fact)
+    with pytest.raises(ValueError, match="payload columns require"):
+        JoinSpec(build="d", probe_key="a", build_key="b", num_keys=4, how="semi",
+                 payload=("x",))
+
+
+def test_join_int64_keys_past_2_31_miss_not_wrap():
+    """int64 keys >= 2^31 must MISS the bounded domain, not wrap into it
+    (the i32 narrowing happens after the range guard)."""
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact = make_table(fk=([1, 2**32 + 1], dt.INT64), v=([10.0, 100.0], dt.FLOAT64))
+    dim = make_table(dk=([1], dt.INT64), p=([7], dt.INT32))
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(JoinSpec(build="dim", probe_key="fk", build_key="dk", num_keys=4,
+                            payload=("p",)),),
+            aggregates=(Agg("v", "sum"), Agg("v", "count_all")),
+        )
+    )
+    out = pipe(fact, {"dim": dim})
+    assert out.column("v_count_all").to_pylist() == [1]
+    assert out.column("v_sum").to_pylist() == [10.0]
+
+
+def test_inner_join_without_payload_still_rejects_duplicates():
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact = make_table(fk=([1], dt.INT32), v=([1.0], dt.FLOAT64))
+    dim = make_table(dk=([1, 1], dt.INT32))
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(JoinSpec(build="dim", probe_key="fk", build_key="dk", num_keys=4),),
+            aggregates=(Agg("v", "sum"),),
+        )
+    )
+    with pytest.raises(ValueError, match="duplicate build keys"):
+        pipe(fact, {"dim": dim})
