@@ -13,6 +13,7 @@
 #include "parquet_footer.h"
 #include "lz4.h"
 #include "snappy.h"
+#include "zstd_codec.h"
 
 #define SRJT_EXPORT extern "C" __attribute__((visibility("default")))
 
@@ -173,6 +174,17 @@ SRJT_EXPORT int64_t srjt_lz4_decompress_block(const uint8_t* src, int64_t src_le
   return guarded(
       [&]() -> int64_t { return srjt::lz4_decompress_block(src, src_len, dst, dst_capacity); },
       -1);
+}
+
+SRJT_EXPORT int64_t srjt_zstd_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                                         int64_t dst_capacity) {
+  return guarded(
+      [&]() -> int64_t { return srjt::zstd_decompress(src, src_len, dst, dst_capacity); }, -1);
+}
+
+SRJT_EXPORT int64_t srjt_zstd_frame_content_size(const uint8_t* src, int64_t src_len) {
+  return guarded(
+      [&]() -> int64_t { return srjt::zstd_frame_content_size(src, src_len); }, -2);
 }
 
 // -- columnar engine ---------------------------------------------------------

@@ -1,0 +1,26 @@
+#include "zstd_codec.h"
+
+#include <zstd.h>
+
+#include <stdexcept>
+#include <string>
+
+namespace srjt {
+
+int64_t zstd_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                        int64_t dst_capacity) {
+  size_t n = ZSTD_decompress(dst, static_cast<size_t>(dst_capacity), src,
+                             static_cast<size_t>(src_len));
+  if (ZSTD_isError(n)) {
+    throw std::runtime_error(std::string("zstd: ") + ZSTD_getErrorName(n));
+  }
+  return static_cast<int64_t>(n);
+}
+
+int64_t zstd_frame_content_size(const uint8_t* src, int64_t src_len) {
+  unsigned long long v = ZSTD_getFrameContentSize(src, static_cast<size_t>(src_len));
+  if (v == ZSTD_CONTENTSIZE_UNKNOWN || v == ZSTD_CONTENTSIZE_ERROR) return -1;
+  return static_cast<int64_t>(v);
+}
+
+}  // namespace srjt

@@ -1,0 +1,19 @@
+// Zstd decompression for the native codec tier (nvcomp analog,
+// SURVEY §2.8): the dominant modern parquet/ORC codec, served by the
+// system libzstd exactly as the reference serves its codecs by linking
+// nvcomp/libsnappy rather than reimplementing them.
+#pragma once
+
+#include <cstdint>
+
+namespace srjt {
+
+// Decompress one zstd frame into dst; returns bytes written. Throws on
+// malformed input or when the output exceeds dst_capacity.
+int64_t zstd_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                        int64_t dst_capacity);
+
+// Content size declared in the frame header, or -1 when unknown.
+int64_t zstd_frame_content_size(const uint8_t* src, int64_t src_len);
+
+}  // namespace srjt

@@ -136,6 +136,12 @@ def _decompress_block(kind: int, blob: bytes, block_size: int) -> bytes:
             return runtime.lz4_decompress_block(blob, max(block_size, 1 << 18))
         raise OrcReadError("LZ4 ORC needs the native runtime (cmake native/)")
     if kind == _K_ZSTD:
+        from .. import runtime
+
+        if runtime.native_available():
+            # frame content size when declared, else the ORC chunk bound
+            size = runtime.zstd_frame_content_size(blob)
+            return runtime.zstd_decompress(blob, size if size >= 0 else max(block_size, 1 << 18))
         import pyarrow as pa
 
         # zstd frames carry no decompressed size in ORC chunks — stream
@@ -377,7 +383,7 @@ _T_FLOAT, _T_DOUBLE, _T_STRING, _T_BINARY, _T_TIMESTAMP = 5, 6, 7, 8, 9
 _T_LIST, _T_MAP, _T_STRUCT, _T_UNION = 10, 11, 12, 13
 _T_DECIMAL, _T_DATE, _T_VARCHAR, _T_CHAR = 14, 15, 16, 17
 
-_S_PRESENT, _S_DATA, _S_LENGTH, _S_DICT_DATA = 0, 1, 2, 3
+_S_PRESENT, _S_DATA, _S_LENGTH, _S_DICT_DATA, _S_SECONDARY = 0, 1, 2, 3, 5
 _E_DIRECT, _E_DICTIONARY, _E_DIRECT_V2, _E_DICTIONARY_V2 = 0, 1, 2, 3
 
 
@@ -386,6 +392,8 @@ class _TypeNode:
     kind: int
     subtypes: List[int] = field(default_factory=list)
     field_names: List[str] = field(default_factory=list)
+    precision: int = 0
+    scale: int = 0
 
 
 @dataclass
@@ -414,6 +422,8 @@ def _parse_tail(data: bytes):
                 kind=td.get(1, [_T_STRUCT])[0],
                 subtypes=_packed_varints(td.get(2, [])),
                 field_names=[x.decode() for x in td.get(3, [])],
+                precision=td.get(5, [0])[0],
+                scale=td.get(6, [0])[0],
             )
         )
     stripes = []
@@ -438,6 +448,7 @@ def _parse_tail(data: bytes):
 
 _INT_KINDS = {_T_BYTE: dt.INT8, _T_SHORT: dt.INT16, _T_INT: dt.INT32, _T_LONG: dt.INT64,
               _T_DATE: dt.INT32}
+_ORC_TS_EPOCH = 1420070400  # 2015-01-01 00:00:00 UTC, the ORC timestamp base
 
 
 def _scatter_present(values: np.ndarray, present: Optional[np.ndarray], fill=0) -> Tuple[np.ndarray, Optional[np.ndarray]]:
@@ -488,7 +499,10 @@ class _StripeReader:
         return _bool_bits(raw, self.num_rows)
 
     def ints(self, col: int, signed: bool, count: int) -> np.ndarray:
-        raw = self.stream(col, _S_DATA)
+        return self.ints_stream(col, _S_DATA, signed, count)
+
+    def ints_stream(self, col: int, skind: int, signed: bool, count: int) -> np.ndarray:
+        raw = self.stream(col, skind)
         enc = self.encodings[col][0]
         if enc in (_E_DIRECT_V2, _E_DICTIONARY_V2):
             return _rle_v2(raw, count, signed)
@@ -539,7 +553,49 @@ def _read_column(rd: _StripeReader, col: int, tnode: _TypeNode):
         if n_present:
             np.cumsum(lens[:-1], out=starts[1:])
         return ("bytes", lens.astype(np.int32), np.frombuffer(chars, np.uint8), starts), present
-    raise OrcReadError(f"unsupported ORC type kind {k} (timestamps/decimals/nested pending)")
+    if k == _T_TIMESTAMP:
+        # DATA: seconds relative to 2015-01-01 UTC (signed RLE);
+        # SECONDARY: nanos with the trailing-zero packing (low 3 bits =
+        # zero-count code c; c != 0 restores c+1 trailing zeros)
+        secs = rd.ints(col, True, n_present).astype(np.int64)
+        raw = rd.ints_stream(col, _S_SECONDARY, False, n_present).view(np.uint64)
+        z = (raw & np.uint64(7)).astype(np.int64)
+        nanos = (raw >> np.uint64(3)).astype(np.int64)
+        scale_f = np.power(10, np.where(z != 0, z + 1, 0)).astype(np.int64)
+        nanos = nanos * scale_f
+        # no pre-epoch second adjustment: the ORC C++ writer (pyarrow's)
+        # stores floor(seconds) directly, so seconds + nanos compose for
+        # negative values too (validated against the oracle incl.
+        # pre-2015 and pre-1970 fractional timestamps)
+        total = (secs + np.int64(_ORC_TS_EPOCH)) * np.int64(1_000_000_000) + nanos
+        return total, present
+    if k == _T_DECIMAL:
+        # DATA: unbounded zigzag base-128 varints (can exceed 64 bits);
+        # SECONDARY: per-value scale (signed RLE). Host decode: decimal
+        # columns are metadata-scale next to the fact lanes.
+        raw = rd.stream(col, _S_DATA) or b""
+        vals: List[int] = []
+        pos = 0
+        for _ in range(n_present):
+            v = 0
+            shift = 0
+            while True:
+                b = raw[pos]
+                pos += 1
+                v |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+            vals.append((v >> 1) ^ -(v & 1))
+        scales = rd.ints_stream(col, _S_SECONDARY, True, n_present)
+        declared = tnode.scale
+        out: List[int] = []
+        for v, s_ in zip(vals, scales.tolist()):
+            if s_ > declared:  # cannot happen in valid files; guard
+                raise OrcReadError("decimal stored scale exceeds declared scale")
+            out.append(v * (10 ** int(declared - s_)))
+        return ("decimal", out), present
+    raise OrcReadError(f"unsupported ORC type kind {k} (nested/unions pending)")
 
 
 @op_boundary("orc_read_table")
@@ -613,6 +669,25 @@ def _to_column_normalized(parts, present_all: np.ndarray, tnode: _TypeNode) -> C
         return Column(dt.STRING, validity=None if not has_nulls else jnp.asarray(present),
                       offsets=jnp.asarray(offsets), chars=jnp.asarray(chars_all))
 
+    if k == _T_DECIMAL:
+        merged: List[int] = []
+        for p in parts:
+            merged.extend(p[1])
+        out_vals: List[Optional[int]] = []
+        j = 0
+        for ok in present_all.tolist():
+            if ok:
+                out_vals.append(merged[j])
+                j += 1
+            else:
+                out_vals.append(None)
+        d = dt.decimal64(-tnode.scale) if tnode.precision <= 18 else dt.decimal128(-tnode.scale)
+        return Column.from_pylist(out_vals, d)
+    if k == _T_TIMESTAMP:
+        vals = np.concatenate([np.asarray(p) for p in parts]) if parts else np.zeros(0, np.int64)
+        full, _ = _scatter_present(vals, present)
+        return Column.from_numpy(full, dt.TIMESTAMP_NANOSECONDS,
+                                 validity=present if has_nulls else None)
     vals = np.concatenate([np.asarray(p) for p in parts]) if parts else np.zeros(0, np.int64)
     if k == _T_BOOLEAN:
         full, _ = _scatter_present(vals.astype(np.uint8), present)

@@ -184,6 +184,16 @@ def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> by
         out = _lz4_hadoop(data, uncompressed_size)
         if out is not None:
             return out
+    if codec == "zstd":
+        from .. import runtime
+
+        if runtime.native_available():
+            out = runtime.zstd_decompress(data, uncompressed_size)
+            if len(out) != uncompressed_size:  # corrupt page: fail loudly
+                raise ParquetReadError(
+                    f"zstd page decoded to {len(out)} bytes, header says {uncompressed_size}"
+                )
+            return out
     if codec == "lz4_raw":
         out = _lz4_raw_block(data, uncompressed_size)
         if len(out) != uncompressed_size:  # corrupt page: fail loudly

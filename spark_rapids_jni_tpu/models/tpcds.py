@@ -21,7 +21,7 @@ from ..columnar import dtype as dt
 from ..ops import bitutils, copying
 from ..ops.aggregate import groupby_aggregate
 from ..ops.expressions import col, lit
-from ..ops.join import inner_join, left_semi_join
+from ..ops.join import left_semi_join
 from ..ops.sort import sort_by_key
 
 __all__ = ["gen_store", "gen_web", "q3", "q95"]

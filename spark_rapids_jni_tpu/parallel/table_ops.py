@@ -202,6 +202,25 @@ def exchange_table(
     if capacity is None:
         capacity = default_capacity(per_shard, n_parts)
 
+    # memory tier: refuse buffer footprints past the device budget
+    # BEFORE dispatch (retryable — the caller splits or the task
+    # re-runs), instead of letting XLA OOM with a possibly poisoned
+    # client (utils/memory.py)
+    from ..utils.memory import (
+        MemoryBudgetExceeded,
+        device_memory_budget,
+        exchange_bytes_estimate,
+    )
+
+    row_bytes = 8 * len(lanes)  # flat upper bound: every lane <= 8B
+    est = exchange_bytes_estimate(row_bytes, n_parts, int(capacity))
+    budget = device_memory_budget()
+    if est > budget:
+        raise MemoryBudgetExceeded(
+            f"exchange at capacity {capacity} needs ~{est} device bytes "
+            f"(budget {budget}); split the batch or lower the capacity"
+        )
+
     # keys are derived INSIDE the body from the payload lanes at these
     # positions (no duplicate key operands through shard_map); null
     # rows' garbage data is masked to 0 so every null key hashes
@@ -359,10 +378,107 @@ def distributed_groupby_table(
     out = _groupby_once(table, key_cols, aggs, mesh, axis, int(capacity), int(group_capacity))
     if out[1] and auto:
         capacity = max(per_shard, 1)
+        # memory tier (utils/memory.py): the escalated capacity must fit
+        # the device budget; a skewed key must not grow buckets until
+        # XLA OOMs. Over budget -> split the batch and re-run (the
+        # reference's 2 GiB batching discipline), merging partials.
+        from ..utils.memory import device_memory_budget, exchange_bytes_estimate
+
+        row_bytes = _exchange_row_bytes(table, key_cols, aggs)
+        if exchange_bytes_estimate(row_bytes, n_parts, capacity) > device_memory_budget():
+            return _groupby_split_retry(table, key_cols, aggs, mesh, axis)
         out = _groupby_once(
             table, key_cols, aggs, mesh, axis, capacity, capacity * n_parts
         )
     return out
+
+
+def _exchange_row_bytes(table: Table, key_cols: Sequence[str], aggs) -> int:
+    """Bytes per exchanged row for the groupby shuffle: 8B upper bound
+    per lane, two lanes (data + possible validity) per key and per
+    aggregate value."""
+    return 16 * (len(key_cols) + len(aggs))
+
+
+_MERGE_HOW = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min", "max": "max"}
+
+
+def _groupby_split_retry(
+    table: Table,
+    key_cols: Sequence[str],
+    aggs: Sequence[Tuple[str, str, str]],
+    mesh: Mesh,
+    axis: str,
+) -> Tuple[Table, bool]:
+    """Split the batch in half row-wise, run each half (recursively
+    subject to the same budget), and re-aggregate the partial results
+    on a single chip. ``mean`` decomposes into sum+count for the
+    partials and recombines at the end; every other supported aggregate
+    is merge-associative."""
+    from ..ops.aggregate import groupby_aggregate
+    from ..ops.copying import slice_table
+    from ..utils.memory import _note_split
+
+    _note_split()
+    n = table.num_rows
+    if n < 2:
+        raise RuntimeError("cannot split a single-row batch further")
+    # mean is not merge-associative: compute sum + count in the partials
+    inner_aggs: List[Tuple[str, str, str]] = []
+    for vname, how, oname in aggs:
+        if how == "mean":
+            inner_aggs.append((vname, "sum", f"{oname}__s"))
+            inner_aggs.append((vname, "count", f"{oname}__c"))
+        else:
+            inner_aggs.append((vname, how, oname))
+
+    mid = (n // 2 + mesh.shape[axis] - 1) // mesh.shape[axis] * mesh.shape[axis]
+    mid = min(max(mid, 1), n - 1)
+    parts = []
+    for lo, hi in ((0, mid), (mid, n)):
+        half = slice_table(table, lo, hi)
+        out, ovf = distributed_groupby_table(half, key_cols, inner_aggs, mesh, axis=axis)
+        if ovf:
+            # a half that still overflows after its own escalation/split
+            # cannot produce the caller's schema from here — surface the
+            # retryable pressure instead of a partial with alien columns
+            from ..utils.memory import MemoryBudgetExceeded
+
+            raise MemoryBudgetExceeded(
+                "groupby split-retry: half-batch still overflows its capacity"
+            )
+        parts.append(out)
+
+    from ..ops.copying import concatenate
+
+    merged_in = concatenate(parts)
+    keys_t = Table([merged_in.column(k) for k in key_cols], list(key_cols))
+    val_names = [o for _v, _h, o in inner_aggs]
+    vals_t = Table([merged_in.column(o) for o in val_names], val_names)
+    merge_aggs = [(o, _MERGE_HOW[h]) for (_v, h, o) in inner_aggs]
+    merged = groupby_aggregate(keys_t, vals_t, merge_aggs)
+
+    out_cols = [merged.column(k) for k in key_cols]
+    out_names = list(key_cols)
+    for vname, how, oname in aggs:
+        if how == "mean":
+            s = merged.column(f"{oname}__s_sum")
+            c = merged.column(f"{oname}__c_sum")
+            sf = bitutils.float_view(s.data, s.dtype) if s.dtype.id == TypeId.FLOAT64 else s.data
+            m = sf / jnp.maximum(c.data.astype(sf.dtype), 1)
+            valid = c.data > 0
+            if s.validity is not None:
+                valid = valid & s.validity
+            out_cols.append(
+                Column(dt.FLOAT64, data=bitutils.float_store(m.astype(jnp.float64), dt.FLOAT64),
+                       validity=valid)
+            )
+        else:
+            mcol = merged.column(f"{oname}_{_MERGE_HOW[how]}")
+            out_cols.append(mcol)
+        out_names.append(oname)
+    return Table(out_cols, out_names), False
+
 
 
 def _groupby_once(

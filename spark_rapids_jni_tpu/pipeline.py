@@ -145,9 +145,11 @@ class CompiledPipeline:
         mask = None
         n_dup = jnp.zeros((), jnp.int64)
 
+        n_bad_build = jnp.zeros((), jnp.int64)
         for js in plan.joins:
-            hit, joined, dups = _dense_join(js, cols, builds[js.build])
+            hit, joined, dups, bad_build = _dense_join(js, cols, builds[js.build])
             n_dup = n_dup + dups
+            n_bad_build = n_bad_build + bad_build
             keep = ~hit if js.how == "anti" else hit
             mask = keep if mask is None else mask & keep
             cols.update(joined)
@@ -181,7 +183,7 @@ class CompiledPipeline:
                 else:
                     v = masked_valid(col)
                 out[agg.out_name] = _global_agg(col, v, agg.how)
-            return out, None, None, None, n_dup
+            return out, None, None, None, n_dup, n_bad_build
 
         # mixed-radix group id over the bounded domains; rows filtered
         # out (or null-keyed) land in the trash segment
@@ -217,7 +219,7 @@ class CompiledPipeline:
             col = cols[agg.source]
             v = None if col.validity is None else col.validity
             aggs[agg.out_name] = _grouped_agg(col, v, gid, num, agg.how, counts_all)
-        return aggs, counts_all, num, n_out_of_domain, n_dup
+        return aggs, counts_all, num, n_out_of_domain, n_dup, n_bad_build
 
     # -- host wrapper -------------------------------------------------------
     @op_boundary("compiled_pipeline")
@@ -227,13 +229,19 @@ class CompiledPipeline:
         have = set(builds or {})
         if want != have:
             raise ValueError(f"plan needs build tables {sorted(want)}, got {sorted(have)}")
-        aggs, counts_all, num, n_oob, n_dup = self._fn(table, builds or {})
-        if any(js.how == "inner" for js in plan.joins):
-            dups = int(n_dup)  # host sync only when an inner join exists
+        aggs, counts_all, num, n_oob, n_dup, n_bad_build = self._fn(table, builds or {})
+        if plan.joins:
+            # one host sync covers both join mis-declaration classes
+            dups, bad_build = int(n_dup), int(n_bad_build)
             if dups:
                 raise ValueError(
                     f"{dups} duplicate build keys in an inner-join payload map; "
                     "bounded-domain joins require unique build keys"
+                )
+            if bad_build:
+                raise ValueError(
+                    f"{bad_build} build rows have join keys outside the declared "
+                    "bounded domain; widen the JoinSpec num_keys"
                 )
         if n_oob is not None:
             oob = int(n_oob)  # piggybacks on the result-size host sync
@@ -322,7 +330,8 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
 def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
     """One bounded-domain join: scatter the (filtered) build side into
     dense presence/payload maps, probe by row gather. Returns
-    (hit [N] bool, {name: joined Column}, duplicate-key count)."""
+    (hit [N] bool, {name: joined Column}, duplicate-key count,
+    out-of-domain build-row count — both loud mis-declaration errors)."""
     num = js.num_keys
     bk = bt.column(js.build_key)
     enter = bk.valid_mask()
@@ -333,8 +342,13 @@ def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
             bfm = bfm & bf.validity
         enter = enter & bfm
     # domain guard BEFORE the i32 narrowing: an int64 key >= 2^31 must
-    # miss, not wrap into the valid domain
-    enter = enter & (bk.data >= 0) & (bk.data < num)
+    # miss, not wrap into the valid domain. A build row INSIDE the
+    # filter but OUTSIDE the declared domain is a mis-declaration
+    # (silently dropping it would quietly un-match fact rows) — counted
+    # and raised host-side like out-of-domain group keys.
+    in_dom_b = (bk.data >= 0) & (bk.data < num)
+    bad_build = jnp.sum((enter & ~in_dom_b).astype(jnp.int64))
+    enter = enter & in_dom_b
     bkeys = bk.data.astype(jnp.int32)
     slot = jnp.where(enter, bkeys, num)  # trash slot for dropped rows
 
@@ -367,7 +381,7 @@ def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
             jnp.zeros((num + 1,), bool).at[slot].set(src.valid_mask() & enter, mode="drop")[:num]
         )
         joined[pname] = Column(d, data=dense[pkc], validity=dvalid[pkc] & hit)
-    return hit, joined, dups
+    return hit, joined, dups, bad_build
 
 
 def _wrap_result(data, valid, how: str) -> Column:

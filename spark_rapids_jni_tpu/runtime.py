@@ -171,6 +171,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_byte_array_lens.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
     lib.srjt_lz4_decompress_block.restype = ctypes.c_int64
     lib.srjt_lz4_decompress_block.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.srjt_zstd_decompress.restype = ctypes.c_int64
+    lib.srjt_zstd_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.srjt_zstd_frame_content_size.restype = ctypes.c_int64
+    lib.srjt_zstd_frame_content_size.argtypes = [u8p, ctypes.c_int64]
     lib.srjt_device_connect.restype = ctypes.c_int32
     lib.srjt_device_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32]
     lib.srjt_device_platform.restype = ctypes.c_char_p
@@ -219,6 +223,35 @@ def lz4_decompress_block(data: bytes, dst_capacity: int) -> bytes:
     src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
     n = lib.srjt_lz4_decompress_block(
         src, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(out)
+    )
+    if n < 0:
+        _raise_last(lib)
+    return out[:n].tobytes()
+
+
+def zstd_frame_content_size(data: bytes) -> int:
+    """Declared decompressed size of a zstd frame, or -1 if unknown."""
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    n = lib.srjt_zstd_frame_content_size(src, len(data))
+    if n == -2:
+        _raise_last(lib)
+    return int(n)
+
+
+def zstd_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """Decompress one zstd frame via the native codec tier."""
+    import numpy as np
+
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    out = np.empty(max(uncompressed_size, 1), np.uint8)
+    src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    n = lib.srjt_zstd_decompress(
+        src, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), uncompressed_size
     )
     if n < 0:
         _raise_last(lib)

@@ -1,0 +1,80 @@
+"""Device-memory budget + retryable-OOM semantics (SURVEY §2.8 RMM row).
+
+The reference threads RMM memory resources through every op signature
+(row_conversion.hpp:27-49) and relies on the plugin's retry-on-OOM
+discipline; its 2 GiB batching (row_conversion.cu:100-105) is the
+splitting mechanism. Here device memory is XLA-owned, so the analog is
+*predictive*: ops that grow buffers data-dependently (the exchange
+capacity escalation in parallel/table_ops.py) estimate their device
+footprint BEFORE dispatch and, over budget, either raise
+``MemoryBudgetExceeded`` (a ``RetryableError``: Spark task retry
+semantics apply) or split the batch and re-run — never drive XLA into
+an allocator OOM that may poison the client.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .errors import RetryableError
+
+__all__ = [
+    "MemoryBudgetExceeded",
+    "device_memory_budget",
+    "exchange_bytes_estimate",
+    "split_retry_count",
+]
+
+
+class MemoryBudgetExceeded(RetryableError):
+    """A requested device buffer footprint exceeds the memory budget.
+    Retryable: the caller may split the batch (ops with split-retry do
+    so automatically) or the task may re-run elsewhere."""
+
+
+# observability: how many batch splits the memory tier has forced
+_split_retries = 0
+
+
+def split_retry_count() -> int:
+    return _split_retries
+
+
+def _note_split() -> None:
+    global _split_retries
+    _split_retries += 1
+
+
+def device_memory_budget() -> int:
+    """Usable device bytes for a single op's working buffers.
+
+    Resolution order: ``SRJT_DEVICE_MEMORY_BUDGET`` (bytes; the test
+    hook and the operator override), the backend's reported limit when
+    it exposes one, else a platform default (v5e HBM less runtime
+    reserve; host RAM share on CPU). The budget is per-op headroom, not
+    the raw chip size: XLA temps routinely need a small multiple of the
+    declared buffers."""
+    env = os.environ.get("SRJT_DEVICE_MEMORY_BUDGET")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"] * 0.5)
+        if dev.platform == "tpu":
+            return 8 << 30  # half of v5e's 16 GB HBM
+    except Exception:
+        pass
+    return 4 << 30  # conservative CPU-tier default
+
+
+def exchange_bytes_estimate(row_bytes: int, n_parts: int, capacity: int) -> int:
+    """PER-DEVICE bytes an all_to_all exchange program needs at a given
+    per-destination ``capacity``: each shard holds its own [n_parts,
+    capacity] bucket matrix per lane, doubled for the send/receive pair
+    the collective keeps live. Compared against the per-device
+    budget — a fleet-total estimate would over-reject by n_parts."""
+    return 2 * n_parts * capacity * max(row_bytes, 1)

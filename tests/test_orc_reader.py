@@ -161,3 +161,48 @@ def test_lz4_codec_native():
     if not runtime.native_available():
         pytest.skip("native runtime not built")
     check_roundtrip(BASIC, compression="lz4")
+
+
+def test_timestamps_vs_pyarrow():
+    """ORC TIMESTAMP: 2015-epoch seconds + trailing-zero-packed nanos,
+    incl. pre-2015 and pre-1970 values with fractional parts."""
+    import datetime
+
+    vals = [
+        datetime.datetime(2020, 6, 1, 12, 34, 56, 789012),
+        datetime.datetime(2015, 1, 1, 0, 0, 0),
+        datetime.datetime(2014, 12, 31, 23, 59, 59, 500000),
+        datetime.datetime(1969, 12, 31, 23, 59, 59, 123456),
+        datetime.datetime(1960, 2, 29, 1, 2, 3),
+        None,
+        datetime.datetime(2038, 1, 19, 3, 14, 7, 999999),
+    ]
+    t = pa.table({"ts": pa.array(vals, pa.timestamp("ns"))})
+    data = write(t)
+    got = read_table(data)
+    want = [None if v is None else pa.scalar(v, pa.timestamp("ns")).value for v in vals]
+    assert got.column("ts").to_pylist() == want
+
+
+def test_decimals_vs_pyarrow():
+    """ORC DECIMAL: unbounded varint magnitudes + per-value scales,
+    through both the 64-bit and 128-bit output widths."""
+    import decimal
+
+    d = decimal.Decimal
+    small = [d("1.23"), d("-45.60"), d("0.01"), None, d("99999.99"), d("-0.05")]
+    t = pa.table({"v": pa.array(small, pa.decimal128(7, 2))})
+    got = read_table(write(t))
+    assert got.column("v").dtype.scale == -2
+    assert got.column("v").to_pylist() == [
+        None if v is None else int(v.scaleb(2)) for v in small
+    ]
+
+    big = [d("12345678901234567890123456.789"), d("-0.999"), None, d("1e20")]
+    t = pa.table({"v": pa.array(big, pa.decimal128(38, 3))})
+    got = read_table(write(t))
+    assert got.column("v").dtype.scale == -3
+    ctx = decimal.Context(prec=50)  # default 28-digit context would round
+    assert got.column("v").to_pylist() == [
+        None if v is None else int(v.scaleb(3, ctx)) for v in big
+    ]

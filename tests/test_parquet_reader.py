@@ -227,3 +227,19 @@ def test_lz4_hadoop_framing():
     # LZ4-frame payloads (non-Hadoop writers) must be rejected -> None
     frame = pa_mod.Codec("lz4").compress(plain).to_pybytes()
     assert _lz4_hadoop(frame, len(plain)) is None
+
+
+def test_zstd_decodes_through_native_tier(monkeypatch):
+    """The zstd path must run on the native codec (nvcomp analog), not
+    the pyarrow fallback."""
+    from spark_rapids_jni_tpu import runtime
+
+    if not runtime.native_available():
+        pytest.skip("native runtime not built")
+    import pyarrow as pa_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("pyarrow codec used for zstd")
+
+    monkeypatch.setattr(pa_mod, "Codec", _boom)
+    check_roundtrip(BASIC, compression="zstd")

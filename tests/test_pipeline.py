@@ -301,3 +301,18 @@ def test_inner_join_without_payload_still_rejects_duplicates():
     )
     with pytest.raises(ValueError, match="duplicate build keys"):
         pipe(fact, {"dim": dim})
+
+
+def test_join_build_keys_outside_domain_raise():
+    from spark_rapids_jni_tpu.pipeline import JoinSpec
+
+    fact = make_table(fk=([0], dt.INT32), v=([1.0], dt.FLOAT64))
+    dim = make_table(dk=([0, 150], dt.INT32))  # 150 outside num_keys=100
+    pipe = compile_plan(
+        PlanSpec(
+            joins=(JoinSpec(build="dim", probe_key="fk", build_key="dk", num_keys=100),),
+            aggregates=(Agg("v", "sum"),),
+        )
+    )
+    with pytest.raises(ValueError, match="outside the declared bounded"):
+        pipe(fact, {"dim": dim})

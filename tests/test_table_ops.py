@@ -231,3 +231,47 @@ def test_groupby_all_null_group_is_null(mesh8):
     assert out.column("s").to_pylist()[rows[0]] == 12
     assert out.column("mn").to_pylist()[rows[0]] == 5
     assert out.column("mx").to_pylist()[rows[0]] == 7
+
+
+def test_memory_budget_split_retry(mesh8, monkeypatch):
+    """A skewed key whose overflow escalation would exceed the device
+    budget must SPLIT the batch and re-run, not grow buffers until OOM
+    (the reference's RMM retry / 2 GiB batching discipline)."""
+    from spark_rapids_jni_tpu.utils import memory as mem
+
+    # sized so the first escalation (capacity=per_shard=512, ~393KB
+    # per-device) exceeds it but each half's escalation (~196KB) fits
+    monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", "300000")
+    rng = np.random.default_rng(3)
+    n = 4096
+    keys = np.where(rng.integers(0, 10, n) < 9, 0, rng.integers(0, 50, n))
+    vals = rng.integers(0, 100, n)
+    t = Table(
+        [_int_col(keys, dt.INT64), _int_col(vals, dt.INT64)], ["k", "v"]
+    )
+    before = mem.split_retry_count()
+    out, ovf = distributed_groupby_table(
+        t, ["k"], [("v", "sum", "v_sum"), ("v", "mean", "v_mean")], mesh8
+    )
+    assert mem.split_retry_count() > before, "expected a memory-driven split"
+    assert not ovf
+    want, wc = {}, {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0) + v
+        wc[k] = wc.get(k, 0) + 1
+    got = dict(zip(out.column("k").to_pylist(), out.column("v_sum").to_pylist()))
+    gotm = dict(zip(out.column("k").to_pylist(), out.column("v_mean").to_pylist()))
+    assert got == want
+    for k in want:
+        assert abs(gotm[k] - want[k] / wc[k]) < 1e-9
+
+
+def test_exchange_over_budget_raises_retryable(mesh8, monkeypatch):
+    from spark_rapids_jni_tpu.utils.errors import RetryableError
+    from spark_rapids_jni_tpu.utils.memory import MemoryBudgetExceeded
+
+    monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", "1000")
+    t = Table([_int_col(np.arange(64), dt.INT64)], ["k"])
+    with pytest.raises(MemoryBudgetExceeded) as ei:
+        exchange_table(t, ["k"], mesh8)
+    assert isinstance(ei.value, RetryableError)  # Spark task-retry class
