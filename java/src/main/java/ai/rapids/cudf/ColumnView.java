@@ -54,5 +54,11 @@ public class ColumnView implements AutoCloseable {
 
   private static native boolean hasValidityNative(long handle);
 
+  /** Free a raw native column handle that was never wrapped (error
+   * cleanup in multi-handle returns). */
+  public static void closeNativeHandle(long handle) {
+    closeNative(handle);
+  }
+
   private static native void closeNative(long handle);
 }

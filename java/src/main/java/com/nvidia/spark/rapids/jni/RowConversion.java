@@ -23,10 +23,30 @@ public class RowConversion {
     NativeDepsLoader.loadNativeDeps();
   }
 
-  /** Table -> LIST&lt;INT8&gt; row blobs (tiled general path). */
+  /**
+   * Table -> LIST&lt;INT8&gt; row blobs (tiled general path). Batches
+   * split INTERNALLY against the 2 GiB size_type ceiling — one element
+   * per batch, like the reference (row_conversion.cu:1465-1543); the
+   * caller no longer pre-splits large tables.
+   */
   public static ColumnVector[] convertToRows(Table table) {
-    long handle = convertToRowsNative(table.getNativeView());
-    return new ColumnVector[] {new ColumnVector(handle)};
+    long[] handles = convertToRowsBatchedNative(table.getNativeView());
+    ColumnVector[] out = new ColumnVector[handles.length];
+    int wrapped = 0;
+    try {
+      for (; wrapped < handles.length; wrapped++) {
+        out[wrapped] = new ColumnVector(handles[wrapped]);
+      }
+    } catch (Throwable t) {
+      for (int i = 0; i < wrapped; i++) {
+        out[i].close();
+      }
+      for (int i = wrapped; i < handles.length; i++) {
+        ai.rapids.cudf.ColumnView.closeNativeHandle(handles[i]);
+      }
+      throw t;
+    }
+    return out;
   }
 
   /** Fixed-width-optimized variant (&lt;100 columns, &lt;=1KB rows —
@@ -50,7 +70,7 @@ public class RowConversion {
     return convertFromRows(rows, schema);
   }
 
-  private static native long convertToRowsNative(long tableHandle);
+  private static native long[] convertToRowsBatchedNative(long tableHandle);
 
   private static native long convertFromRowsNative(long rowsHandle, int[] typeIds, int[] scales);
 }

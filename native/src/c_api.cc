@@ -518,6 +518,26 @@ SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
       0);
 }
 
+// Batched encode: fills out_handles with one LIST<INT8> column handle
+// per <=max_batch_bytes batch (0 = the 2 GiB default); returns the
+// batch count, or -1 on error / when capacity is too small (callers
+// size capacity >= ceil(total/max)+1).
+SRJT_EXPORT int32_t srjt_convert_to_rows_batched(int64_t table_h, int64_t max_batch_bytes,
+                                                 int64_t* out_handles, int32_t capacity) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        auto batches = srjt::convert_to_rows_batched(table_ref(table_h), max_batch_bytes);
+        if (static_cast<int32_t>(batches.size()) > capacity) {
+          throw std::runtime_error("batch handle capacity too small");
+        }
+        for (size_t i = 0; i < batches.size(); ++i) {
+          out_handles[i] = put_column(std::move(batches[i]));
+        }
+        return static_cast<int64_t>(batches.size());
+      },
+      -1));
+}
+
 SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* type_ids,
                                            const int32_t* scales, int32_t ncols) {
   return guarded(

@@ -133,34 +133,24 @@ int64_t rows_total_bytes(const NativeTable& table) {
   return total;
 }
 
-std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table) {
-  std::vector<TypeId> types;
-  types.reserve(table.columns.size());
-  for (const auto& c : table.columns) types.push_back(c->type);
-  RowLayout layout = compute_row_layout(types);
-  int64_t n = table.num_rows();
+namespace {
 
-  // per-row sizes (variable string payload after the fixed section),
-  // kept in int64 until after the 2 GiB guard: narrowing first would
-  // let a >2^31-byte row wrap negative and bypass the check
-  std::vector<int64_t> row_size(static_cast<size_t>(n), layout.row_size_fixed);
-  if (!layout.variable_cols.empty()) {
-    for (int64_t r = 0; r < n; ++r) row_size[static_cast<size_t>(r)] = row_bytes(layout, table, r);
-  }
+// Encode rows [r0, r1) into one LIST<INT8> batch column (the shared
+// body of the single-batch and batched entries).
+std::unique_ptr<NativeColumn> encode_rows_range(const NativeTable& table,
+                                                const srjt::RowLayout& layout,
+                                                const std::vector<int64_t>& row_size,
+                                                int64_t r0, int64_t r1) {
   int64_t total = 0;
-  for (int64_t s : row_size) total += s;
-  if (total > MAX_BATCH_BYTES) {
-    throw std::runtime_error("row batch exceeds 2GiB size_type limit");
-  }
-
+  for (int64_t r = r0; r < r1; ++r) total += row_size[static_cast<size_t>(r)];
   auto out = std::make_unique<NativeColumn>();
   out->type = TypeId::LIST;
-  out->size = n;
-  out->offsets.resize(static_cast<size_t>(n) + 1);
+  out->size = r1 - r0;
+  out->offsets.resize(static_cast<size_t>(r1 - r0) + 1);
   out->chars.assign(static_cast<size_t>(total), 0);
   int64_t pos = 0;
-  for (int64_t r = 0; r < n; ++r) {
-    out->offsets[static_cast<size_t>(r)] = static_cast<int32_t>(pos);
+  for (int64_t r = r0; r < r1; ++r) {
+    out->offsets[static_cast<size_t>(r - r0)] = static_cast<int32_t>(pos);
     uint8_t* row = out->chars.data() + pos;
     int64_t var_off = layout.fixed_end;
     for (size_t ci = 0; ci < table.columns.size(); ++ci) {
@@ -185,7 +175,72 @@ std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table) {
     }
     pos += row_size[static_cast<size_t>(r)];
   }
-  out->offsets[static_cast<size_t>(n)] = static_cast<int32_t>(pos);
+  out->offsets[static_cast<size_t>(r1 - r0)] = static_cast<int32_t>(pos);
+  return out;
+}
+
+std::vector<int64_t> all_row_sizes(const NativeTable& table, const RowLayout& layout) {
+  int64_t n = table.num_rows();
+  std::vector<int64_t> row_size(static_cast<size_t>(n), layout.row_size_fixed);
+  if (!layout.variable_cols.empty()) {
+    for (int64_t r = 0; r < n; ++r) row_size[static_cast<size_t>(r)] = row_bytes(layout, table, r);
+  }
+  return row_size;
+}
+
+}  // namespace
+
+std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table) {
+  std::vector<TypeId> types;
+  types.reserve(table.columns.size());
+  for (const auto& c : table.columns) types.push_back(c->type);
+  RowLayout layout = compute_row_layout(types);
+  int64_t n = table.num_rows();
+  // per-row sizes kept in int64 until after the 2 GiB guard: narrowing
+  // first would let a >2^31-byte row wrap negative and bypass the check
+  std::vector<int64_t> row_size = all_row_sizes(table, layout);
+  int64_t total = 0;
+  for (int64_t s : row_size) total += s;
+  if (total > MAX_BATCH_BYTES) {
+    throw std::runtime_error("row batch exceeds 2GiB size_type limit");
+  }
+  return encode_rows_range(table, layout, row_size, 0, n);
+}
+
+std::vector<std::unique_ptr<NativeColumn>> convert_to_rows_batched(const NativeTable& table,
+                                                                   int64_t max_batch_bytes) {
+  if (max_batch_bytes <= 0 || max_batch_bytes > MAX_BATCH_BYTES) {
+    max_batch_bytes = MAX_BATCH_BYTES;
+  }
+  std::vector<TypeId> types;
+  types.reserve(table.columns.size());
+  for (const auto& c : table.columns) types.push_back(c->type);
+  RowLayout layout = compute_row_layout(types);
+  int64_t n = table.num_rows();
+  std::vector<int64_t> row_size = all_row_sizes(table, layout);
+
+  // greedy batch boundaries against the size ceiling (the reference's
+  // build_batches scan, row_conversion.cu:1465-1543)
+  std::vector<std::unique_ptr<NativeColumn>> out;
+  int64_t start = 0;
+  while (start < n) {
+    int64_t acc = 0;
+    int64_t end = start;
+    while (end < n) {
+      int64_t s = row_size[static_cast<size_t>(end)];
+      if (acc + s > max_batch_bytes) break;
+      acc += s;
+      ++end;
+    }
+    if (end == start) {
+      throw std::runtime_error("a single row exceeds the batch size limit");
+    }
+    out.push_back(encode_rows_range(table, layout, row_size, start, end));
+    start = end;
+  }
+  if (out.empty()) {
+    out.push_back(encode_rows_range(table, layout, row_size, 0, 0));
+  }
   return out;
 }
 

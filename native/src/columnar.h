@@ -109,6 +109,12 @@ RowLayout compute_row_layout(const std::vector<TypeId>& types);
 // Total JCUDF row bytes the table would produce (batch/dispatch sizing).
 int64_t rows_total_bytes(const NativeTable& table);
 
+// Table -> LIST<INT8> row batches, internally split against
+// max_batch_bytes (<=0 = the 2 GiB default) — the reference's
+// convertToRows contract (row_conversion.cu:1465-1543).
+std::vector<std::unique_ptr<NativeColumn>> convert_to_rows_batched(const NativeTable& table,
+                                                                   int64_t max_batch_bytes);
+
 // Table -> one LIST<INT8> column of JCUDF rows (single batch; throws if
 // the blob would exceed the 2 GiB size_type limit).
 std::unique_ptr<NativeColumn> convert_to_rows(const NativeTable& table);

@@ -51,6 +51,8 @@ int64_t srjt_table_num_rows(int64_t h);
 int64_t srjt_table_column(int64_t h, int32_t i);
 void srjt_table_close(int64_t h);
 int64_t srjt_convert_to_rows(int64_t table_h);
+int32_t srjt_convert_to_rows_batched(int64_t table_h, int64_t max_batch_bytes,
+                                     int64_t* out_handles, int32_t capacity);
 int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* type_ids,
                                const int32_t* scales, int32_t ncols);
 int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode, int32_t out_type_id);
@@ -315,11 +317,21 @@ JNIEXPORT void JNICALL Java_ai_rapids_cudf_Table_closeNative(JNIEnv*, jclass, jl
 
 // --- com.nvidia.spark.rapids.jni contract ops ----------------------------
 
-JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsNative(
+JNIEXPORT jlongArray JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsBatchedNative(
     JNIEnv* env, jclass, jlong table_handle) {
-  int64_t h = srjt_convert_to_rows(table_handle);
-  if (h == 0) throw_last_error(env);
-  return h;
+  // capacity: each batch holds >= 1 byte, bounded by the 2 GiB ceiling;
+  // 64 batches covers 128 GiB of rows — re-raise past that
+  int64_t handles[64];
+  int32_t n = srjt_convert_to_rows_batched(table_handle, 0, handles, 64);
+  if (n < 0) {
+    throw_last_error(env);
+    return nullptr;
+  }
+  jlongArray arr = env->NewLongArray(n);
+  if (arr != nullptr) {
+    env->SetLongArrayRegion(arr, 0, n, reinterpret_cast<const jlong*>(handles));
+  }
+  return arr;
 }
 
 JNIEXPORT jlong JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative(

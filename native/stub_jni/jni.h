@@ -57,6 +57,8 @@ struct JNIEnv {
   void ReleaseStringUTFChars(jstring, const char*) { die(); }
   void DeleteLocalRef(jobject) { die(); }
   jbyteArray NewByteArray(jsize) { die(); }
+  jlongArray NewLongArray(jsize) { die(); }
+  void SetLongArrayRegion(jlongArray, jsize, jsize, const jlong*) { die(); }
   void* GetPrimitiveArrayCritical(jarray, jboolean*) { die(); }
   void ReleasePrimitiveArrayCritical(jarray, void*, jint) { die(); }
   void GetByteArrayRegion(jbyteArray, jsize, jsize, jbyte*) { die(); }

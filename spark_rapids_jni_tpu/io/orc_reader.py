@@ -139,9 +139,14 @@ def _decompress_block(kind: int, blob: bytes, block_size: int) -> bytes:
         from .. import runtime
 
         if runtime.native_available():
-            # frame content size when declared, else the ORC chunk bound
+            # frame content size when declared, else the ORC chunk
+            # bound; the header is untrusted bytes, so the allocation
+            # is CLAMPED to the block size a valid chunk can reach
+            bound = max(block_size, 1 << 18)
             size = runtime.zstd_frame_content_size(blob)
-            return runtime.zstd_decompress(blob, size if size >= 0 else max(block_size, 1 << 18))
+            if size > bound:
+                raise OrcReadError(f"zstd chunk declares {size} bytes > block size {bound}")
+            return runtime.zstd_decompress(blob, size if size >= 0 else bound)
         import pyarrow as pa
 
         # zstd frames carry no decompressed size in ORC chunks — stream

@@ -375,16 +375,19 @@ def distributed_groupby_table(
         capacity = default_capacity(max(per_shard, 1), n_parts)
     if group_capacity is None:
         group_capacity = min(capacity * n_parts, max(per_shard, 64))
+    # memory tier guards the FIRST dispatch too: a batch whose default
+    # capacity already exceeds the budget must split, not OOM
+    from ..utils.memory import device_memory_budget, exchange_bytes_estimate
+
+    row_bytes = _exchange_row_bytes(table, key_cols, aggs)
+    if auto and exchange_bytes_estimate(row_bytes, n_parts, int(capacity)) > device_memory_budget():
+        return _groupby_split_retry(table, key_cols, aggs, mesh, axis)
     out = _groupby_once(table, key_cols, aggs, mesh, axis, int(capacity), int(group_capacity))
     if out[1] and auto:
         capacity = max(per_shard, 1)
-        # memory tier (utils/memory.py): the escalated capacity must fit
-        # the device budget; a skewed key must not grow buckets until
-        # XLA OOMs. Over budget -> split the batch and re-run (the
-        # reference's 2 GiB batching discipline), merging partials.
-        from ..utils.memory import device_memory_budget, exchange_bytes_estimate
-
-        row_bytes = _exchange_row_bytes(table, key_cols, aggs)
+        # same budget check for the escalated capacity: a skewed key
+        # must not grow buckets until XLA OOMs — split instead (the
+        # reference's 2 GiB batching discipline), merging partials
         if exchange_bytes_estimate(row_bytes, n_parts, capacity) > device_memory_budget():
             return _groupby_split_retry(table, key_cols, aggs, mesh, axis)
         out = _groupby_once(

@@ -149,6 +149,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_table_close.argtypes = [ctypes.c_int64]
     lib.srjt_convert_to_rows.restype = ctypes.c_int64
     lib.srjt_convert_to_rows.argtypes = [ctypes.c_int64]
+    lib.srjt_convert_to_rows_batched.restype = ctypes.c_int32
+    lib.srjt_convert_to_rows_batched.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+    ]
     lib.srjt_convert_from_rows.restype = ctypes.c_int64
     lib.srjt_convert_from_rows.argtypes = [ctypes.c_int64, i32p, i32p, ctypes.c_int32]
     lib.srjt_cast_string_to_integer.restype = ctypes.c_int64
@@ -661,6 +665,22 @@ def native_convert_to_rows(table: "NativeTable") -> NativeColumn:
     if h == 0:
         _raise_last(lib)
     return NativeColumn(h, lib)
+
+
+def native_convert_to_rows_batched(
+    table: "NativeTable", max_batch_bytes: int = 0
+) -> List[NativeColumn]:
+    """RowConversion.convertToRows with internal batch splitting: one
+    LIST<INT8> column per <= max_batch_bytes batch (0 = the 2 GiB
+    size_type default). The injectable limit is the test hook for the
+    reference's build_batches discipline."""
+    lib = table._lib
+    cap = 1024
+    handles = (ctypes.c_int64 * cap)()
+    n = lib.srjt_convert_to_rows_batched(table.handle, max_batch_bytes, handles, cap)
+    if n < 0:
+        _raise_last(lib)
+    return [NativeColumn(handles[i], lib) for i in range(n)]
 
 
 def native_convert_from_rows(rows: NativeColumn, dtypes) -> NativeTable:

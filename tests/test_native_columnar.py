@@ -365,3 +365,55 @@ def test_convert_from_rows_rejects_corrupt_blob():
     assert b"outside its row" in lib.srjt_last_error()
     lib.srjt_column_close(h)
     lib.srjt_column_close(h2)
+
+
+def test_convert_to_rows_internal_batch_split():
+    """convertToRows splits internally against the batch byte ceiling
+    (reference build_batches, row_conversion.cu:1465-1543) — exercised
+    with an injected limit so the test doesn't need 2 GiB of rows."""
+    n = 1000
+    t = Table(
+        [
+            col_from(list(range(n)), dt.INT64),
+            col_from([f"s{i % 13}" * (i % 5) for i in range(n)], dt.STRING),
+        ],
+        ["v", "s"],
+    )
+    with runtime.NativeTable.from_python(t) as nt:
+        # default limit: one batch, identical to the single-batch entry
+        batches = runtime.native_convert_to_rows_batched(nt)
+        assert len(batches) == 1
+        with runtime.native_convert_to_rows(nt) as single:
+            a = single.to_python(dt.LIST)
+        b = batches[0].to_python(dt.LIST)
+        np.testing.assert_array_equal(np.asarray(a.child.data), np.asarray(b.child.data))
+        for c in batches:
+            c.close()
+
+        # injected 4 KiB limit: many batches, concatenation reproduces
+        # the single blob and every batch respects the ceiling
+        batches = runtime.native_convert_to_rows_batched(nt, max_batch_bytes=4096)
+        assert len(batches) > 1
+        blobs, nrows = [], 0
+        for c in batches:
+            pc = c.to_python(dt.LIST)
+            blob = np.asarray(pc.child.data)
+            assert blob.size <= 4096
+            blobs.append(blob)
+            nrows += len(pc)
+            c.close()
+        assert nrows == n
+        np.testing.assert_array_equal(np.concatenate(blobs), np.asarray(a.child.data))
+
+        # decode side: each batch converts back and the rows concatenate
+        batches = runtime.native_convert_to_rows_batched(nt, max_batch_bytes=4096)
+        vals, strs = [], []
+        for c in batches:
+            with runtime.native_convert_from_rows(c, t.dtypes()) as back:
+                with back.column(0) as c0:
+                    vals.extend(c0.to_python(dt.INT64).to_pylist())
+                with back.column(1) as c1:
+                    strs.extend(c1.to_python(dt.STRING).to_pylist())
+            c.close()
+        assert vals == t.column("v").to_pylist()
+        assert strs == t.column("s").to_pylist()
