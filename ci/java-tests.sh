@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# JVM test tier (SURVEY §4.2 analog of the reference's surefire JUnit
+# run, reference pom.xml:480-534): compile the Java API + tests and run
+# each test main against the real libsrjt.so over a live JNI boundary.
+#
+# Requires a JDK (javac + java). The CI image this repo is built on has
+# none, so the script degrades to an explicit SKIP — the hermetic proxy
+# for this tier is the ctypes suite (tests/test_native_columnar.py),
+# which drives the same C ABI the JNI veneer marshals into. Run this on
+# any JDK host to execute the Java tier for real:
+#
+#   ci/java-tests.sh            # build native (with real jni.h) + run
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v javac >/dev/null 2>&1 || ! command -v java >/dev/null 2>&1; then
+  echo "java-tests: SKIP (no JDK on PATH; the ctypes tier covers the C ABI)"
+  exit 0
+fi
+
+# 1) native lib built against the REAL JNI headers
+JAVA_BIN=$(command -v javac)
+JAVA_HOME_GUESS=$(dirname "$(dirname "$(readlink -f "$JAVA_BIN")")")
+export JAVA_HOME=${JAVA_HOME:-$JAVA_HOME_GUESS}
+cmake -S native -B native/build-jni -G Ninja -DSRJT_BUILD_JNI=ON >/dev/null
+ninja -C native/build-jni >/dev/null
+
+# 2) compile API + tests
+OUT=build/java-tests
+rm -rf "$OUT" && mkdir -p "$OUT/classes"
+find java/src/main/java java/src/test/java -name '*.java' > "$OUT/sources.txt"
+javac -d "$OUT/classes" @"$OUT/sources.txt"
+
+# 3) run each suite main (fresh JVM per suite, like surefire's fork —
+# a poisoned native state cannot contaminate the next suite; the
+# reference isolates CudaFatalTest the same way, pom.xml:523-532)
+export SRJT_NATIVE_LIB="$PWD/native/build-jni/libsrjt.so"
+FAIL=0
+for suite in RowConversionTest CastStringsTest DecimalUtilsTest ZOrderTest ScalarTest; do
+  echo "== $suite"
+  if ! java -cp "$OUT/classes" "com.nvidia.spark.rapids.jni.$suite"; then
+    FAIL=1
+  fi
+done
+exit $FAIL

@@ -1,0 +1,113 @@
+/*
+ * Column/Table equality assertions (L4 tier, SURVEY §2.8 row 1): the
+ * `ai.rapids.cudf.AssertUtils` surface the reference's JUnit tier
+ * compares results with (CUDF_TEST_EXPECT_TABLES_EQUIVALENT's Java
+ * analog). Comparison is value-level: per-row validity must match, and
+ * the payload must match on VALID rows only — null rows may carry
+ * arbitrary bytes, exactly like the reference's EQUIVALENT mode.
+ */
+package ai.rapids.cudf;
+
+public final class AssertUtils {
+
+  private AssertUtils() {}
+
+  public static void assertColumnsAreEqual(ColumnView expected, ColumnView actual) {
+    assertColumnsAreEqual(expected, actual, "column");
+  }
+
+  public static void assertColumnsAreEqual(ColumnView expected, ColumnView actual, String name) {
+    DType et = expected.getType();
+    DType at = actual.getType();
+    check(et.equals(at), name + ": type " + et + " != " + at);
+    long rows = expected.getRowCount();
+    check(rows == actual.getRowCount(),
+        name + ": rows " + rows + " != " + actual.getRowCount());
+    byte[] ev = readValidity(expected, rows);
+    byte[] av = readValidity(actual, rows);
+    for (int r = 0; r < rows; r++) {
+      check(ev[r] == av[r], name + ": validity differs at row " + r
+          + " (expected " + ev[r] + ", got " + av[r] + ")");
+    }
+    if (et.getTypeId() == DType.DTypeEnum.STRING
+        || et.getTypeId() == DType.DTypeEnum.LIST) {
+      // both STRING and LIST carry their payload in offsets + chars
+      // (LIST<INT8> row blobs, zorder output)
+      int[] eo = readOffsets(expected, rows);
+      int[] ao = readOffsets(actual, rows);
+      byte[] ec = readBytes(expected.copyCharsToHost());
+      byte[] ac = readBytes(actual.copyCharsToHost());
+      for (int r = 0; r < rows; r++) {
+        if (ev[r] == 0) {
+          continue;
+        }
+        int elen = eo[r + 1] - eo[r];
+        int alen = ao[r + 1] - ao[r];
+        check(elen == alen, name + ": string length differs at row " + r);
+        for (int b = 0; b < elen; b++) {
+          check(ec[eo[r] + b] == ac[ao[r] + b], name + ": string bytes differ at row " + r);
+        }
+      }
+      return;
+    }
+    byte[] ed = readBytes(expected.copyDataToHost());
+    byte[] ad = readBytes(actual.copyDataToHost());
+    check(ed.length == ad.length, name + ": data size " + ed.length + " != " + ad.length);
+    int width = rows > 0 ? (int) (ed.length / rows) : 0;
+    for (int r = 0; r < rows; r++) {
+      if (ev[r] == 0) {
+        continue;
+      }
+      for (int b = 0; b < width; b++) {
+        check(ed[r * width + b] == ad[r * width + b],
+            name + ": data differs at row " + r + " byte " + b);
+      }
+    }
+  }
+
+  public static void assertTablesAreEqual(Table expected, Table actual) {
+    check(expected.getNumberOfColumns() == actual.getNumberOfColumns(),
+        "table: column count " + expected.getNumberOfColumns()
+            + " != " + actual.getNumberOfColumns());
+    check(expected.getRowCount() == actual.getRowCount(),
+        "table: rows " + expected.getRowCount() + " != " + actual.getRowCount());
+    for (int i = 0; i < expected.getNumberOfColumns(); i++) {
+      try (ColumnVector e = expected.getColumn(i);
+           ColumnVector a = actual.getColumn(i)) {
+        assertColumnsAreEqual(e, a, "column " + i);
+      }
+    }
+  }
+
+  private static byte[] readValidity(ColumnView c, long rows) {
+    try (HostMemoryBuffer b = c.copyValidityToHost()) {
+      byte[] out = new byte[(int) rows];
+      b.getBytes(out, 0, 0, rows);
+      return out;
+    }
+  }
+
+  private static int[] readOffsets(ColumnView c, long rows) {
+    byte[] raw = readBytes(c.copyOffsetsToHost());
+    int[] out = new int[(int) rows + 1];
+    for (int i = 0; i <= rows; i++) {
+      out[i] = (raw[4 * i] & 0xFF) | ((raw[4 * i + 1] & 0xFF) << 8)
+          | ((raw[4 * i + 2] & 0xFF) << 16) | ((raw[4 * i + 3] & 0xFF) << 24);
+    }
+    return out;
+  }
+
+  private static byte[] readBytes(HostMemoryBuffer buf) {
+    try (HostMemoryBuffer b = buf) {
+      byte[] out = new byte[(int) b.getLength()];
+      b.getBytes(out, 0, 0, b.getLength());
+      return out;
+    }
+  }
+
+  private static void check(boolean cond, String message) {
+    if (!cond) {
+      throw new AssertionError(message);
+    }
+  }
+}

@@ -8,10 +8,180 @@
  */
 package ai.rapids.cudf;
 
+import java.math.BigInteger;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
 public final class ColumnVector extends ColumnView {
 
   public ColumnVector(long handle) {
     super(handle);
+  }
+
+  // -- boxed host-array factories (the `ai.rapids.cudf` construction
+  // surface the reference's JUnit tier builds test data with) --------
+
+  public static ColumnVector fromBoxedBytes(Byte... values) {
+    ByteBuffer bb = fixedBuf(values.length, 1);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.put(values[i] != null ? values[i] : 0);
+    }
+    return fromFixed(DType.INT8, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedShorts(Short... values) {
+    ByteBuffer bb = fixedBuf(values.length, 2);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.putShort(values[i] != null ? values[i] : 0);
+    }
+    return fromFixed(DType.INT16, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedInts(Integer... values) {
+    ByteBuffer bb = fixedBuf(values.length, 4);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.putInt(values[i] != null ? values[i] : 0);
+    }
+    return fromFixed(DType.INT32, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedLongs(Long... values) {
+    ByteBuffer bb = fixedBuf(values.length, 8);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.putLong(values[i] != null ? values[i] : 0);
+    }
+    return fromFixed(DType.INT64, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedFloats(Float... values) {
+    ByteBuffer bb = fixedBuf(values.length, 4);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.putFloat(values[i] != null ? values[i] : 0f);
+    }
+    return fromFixed(DType.FLOAT32, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedDoubles(Double... values) {
+    ByteBuffer bb = fixedBuf(values.length, 8);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.putDouble(values[i] != null ? values[i] : 0d);
+    }
+    return fromFixed(DType.FLOAT64, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromBoxedBooleans(Boolean... values) {
+    ByteBuffer bb = fixedBuf(values.length, 1);
+    byte[] valid = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      bb.put((byte) (values[i] != null && values[i] ? 1 : 0));
+    }
+    return fromFixed(DType.BOOL8, values.length, bb, valid);
+  }
+
+  public static ColumnVector fromInts(int... values) {
+    Integer[] boxed = new Integer[values.length];
+    for (int i = 0; i < values.length; i++) {
+      boxed[i] = values[i];
+    }
+    return fromBoxedInts(boxed);
+  }
+
+  public static ColumnVector fromLongs(long... values) {
+    Long[] boxed = new Long[values.length];
+    for (int i = 0; i < values.length; i++) {
+      boxed[i] = values[i];
+    }
+    return fromBoxedLongs(boxed);
+  }
+
+  /** STRING column from Java strings (UTF-8); null entries become null rows. */
+  public static ColumnVector fromStrings(String... values) {
+    int n = values.length;
+    byte[] valid = new byte[n];
+    byte[][] utf8 = new byte[n][];
+    int total = 0;
+    for (int i = 0; i < n; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      utf8[i] = values[i] != null ? values[i].getBytes(StandardCharsets.UTF_8) : new byte[0];
+      total += utf8[i].length;
+    }
+    ByteBuffer offs = fixedBuf(n + 1, 4);
+    ByteBuffer chars = ByteBuffer.allocate(Math.max(total, 1)).order(ByteOrder.LITTLE_ENDIAN);
+    int off = 0;
+    for (int i = 0; i < n; i++) {
+      offs.putInt(off);
+      chars.put(utf8[i]);
+      off += utf8[i].length;
+    }
+    offs.putInt(off);
+    try (HostMemoryBuffer ob = hostOf(offs);
+         HostMemoryBuffer cb = hostOf(chars);
+         HostMemoryBuffer vb = hostOf(valid)) {
+      return fromHostStringBuffers(DType.STRING, n, ob, total > 0 ? cb : null, vb);
+    }
+  }
+
+  /** DECIMAL128 column from unscaled BigIntegers (cudf scale convention). */
+  public static ColumnVector decimalFromBigInt(int scale, BigInteger... values) {
+    int n = values.length;
+    ByteBuffer bb = fixedBuf(n, 16);
+    byte[] valid = new byte[n];
+    for (int i = 0; i < n; i++) {
+      valid[i] = (byte) (values[i] != null ? 1 : 0);
+      BigInteger v = values[i] != null ? values[i] : BigInteger.ZERO;
+      if (v.bitLength() > 127) {
+        throw new IllegalArgumentException(
+            "value does not fit in DECIMAL128: " + v);
+      }
+      byte[] be = v.toByteArray(); // big-endian two's complement
+      byte ext = (byte) (v.signum() < 0 ? 0xFF : 0x00);
+      for (int b = 0; b < 16; b++) { // little-endian, sign-extended
+        bb.put(b < be.length ? be[be.length - 1 - b] : ext);
+      }
+    }
+    return fromFixed(DType.create(DType.DTypeEnum.DECIMAL128, scale), n, bb, valid);
+  }
+
+  private static ByteBuffer fixedBuf(int n, int width) {
+    return ByteBuffer.allocate(Math.max(n * width, 1)).order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  private static HostMemoryBuffer hostOf(ByteBuffer bb) {
+    return hostOf(bb.array());
+  }
+
+  private static HostMemoryBuffer hostOf(byte[] bytes) {
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(Math.max(bytes.length, 1));
+    buf.setBytes(0, bytes, 0, bytes.length);
+    return buf;
+  }
+
+  private static ColumnVector fromFixed(DType t, int n, ByteBuffer data, byte[] valid) {
+    boolean hasNulls = false;
+    for (byte v : valid) {
+      if (v == 0) {
+        hasNulls = true;
+        break;
+      }
+    }
+    try (HostMemoryBuffer db = hostOf(data);
+         HostMemoryBuffer vb = hasNulls ? hostOf(valid) : null) {
+      return fromHostBuffers(t, n, db, vb);
+    }
   }
 
   /**
@@ -58,12 +228,50 @@ public final class ColumnVector extends ColumnView {
     return new ColumnVector(h);
   }
 
-  /** Copy this column's fixed-width data into a fresh host buffer. */
-  public HostMemoryBuffer copyDataToHost() {
-    long bytes = dataBytesNative(nativeHandle);
+  // -- host read-back (package-private statics: ColumnView's public
+  // copy*ToHost methods delegate here; the natives must live in this
+  // class because JNI binds symbols by declaring class) ---------------
+
+  static HostMemoryBuffer copyDataFromHandle(long handle) {
+    long bytes = dataBytesNative(handle);
     HostMemoryBuffer buf = HostMemoryBuffer.allocate(bytes);
     try {
-      copyDataNative(nativeHandle, buf.getAddress(), bytes);
+      copyDataNative(handle, buf.getAddress(), bytes);
+    } catch (RuntimeException | Error e) {
+      buf.close();
+      throw e;
+    }
+    return buf;
+  }
+
+  static HostMemoryBuffer copyValidityFromHandle(long handle, long rows) {
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(rows);
+    try {
+      copyValidityNative(handle, buf.getAddress(), rows);
+    } catch (RuntimeException | Error e) {
+      buf.close();
+      throw e;
+    }
+    return buf;
+  }
+
+  static HostMemoryBuffer copyOffsetsFromHandle(long handle, long rows) {
+    long bytes = (rows + 1) * 4;
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(bytes);
+    try {
+      copyOffsetsNative(handle, buf.getAddress(), bytes / 4);
+    } catch (RuntimeException | Error e) {
+      buf.close();
+      throw e;
+    }
+    return buf;
+  }
+
+  static HostMemoryBuffer copyCharsFromHandle(long handle) {
+    long bytes = charsBytesNative(handle);
+    HostMemoryBuffer buf = HostMemoryBuffer.allocate(bytes);
+    try {
+      copyCharsNative(handle, buf.getAddress(), bytes);
     } catch (RuntimeException | Error e) {
       buf.close();
       throw e;
@@ -84,5 +292,13 @@ public final class ColumnVector extends ColumnView {
 
   private static native long dataBytesNative(long handle);
 
+  private static native long charsBytesNative(long handle);
+
   private static native void copyDataNative(long handle, long outAddr, long capacity);
+
+  private static native void copyValidityNative(long handle, long outAddr, long rows);
+
+  private static native void copyOffsetsNative(long handle, long outAddr, long capacityInts);
+
+  private static native void copyCharsNative(long handle, long outAddr, long capacity);
 }

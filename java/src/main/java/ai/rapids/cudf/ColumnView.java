@@ -38,6 +38,30 @@ public class ColumnView implements AutoCloseable {
     return hasValidityNative(nativeHandle);
   }
 
+  /** Copy this column's fixed-width data into a fresh host buffer. */
+  public HostMemoryBuffer copyDataToHost() {
+    return ColumnVector.copyDataFromHandle(nativeHandle);
+  }
+
+  /**
+   * Copy this column's validity into a fresh host buffer: one byte per
+   * row, 1 = valid (a column with no validity vector reads back
+   * all-ones).
+   */
+  public HostMemoryBuffer copyValidityToHost() {
+    return ColumnVector.copyValidityFromHandle(nativeHandle, getRowCount());
+  }
+
+  /** Copy a STRING/LIST column's rows+1 int32 offsets into a fresh host buffer. */
+  public HostMemoryBuffer copyOffsetsToHost() {
+    return ColumnVector.copyOffsetsFromHandle(nativeHandle, getRowCount());
+  }
+
+  /** Copy a STRING column's character bytes into a fresh host buffer. */
+  public HostMemoryBuffer copyCharsToHost() {
+    return ColumnVector.copyCharsFromHandle(nativeHandle);
+  }
+
   @Override
   public void close() {
     if (nativeHandle != 0) {

@@ -1,0 +1,20 @@
+/*
+ * Exception type for native-layer failures (L4 tier, SURVEY §2.8 row 1):
+ * the `ai.rapids.cudf.CudfException` surface the reference bundles from
+ * the cudf submodule. The JNI bridge (native/src/jni/srjt_jni.cc
+ * throw_last_error) throws this for every srjt C-ABI error other than
+ * ANSI cast failures, which surface as the more specific
+ * com.nvidia.spark.rapids.jni.CastException.
+ */
+package ai.rapids.cudf;
+
+public class CudfException extends RuntimeException {
+
+  public CudfException(String message) {
+    super(message);
+  }
+
+  public CudfException(String message, Throwable cause) {
+    super(message, cause);
+  }
+}

@@ -1,0 +1,183 @@
+/*
+ * Typed scalar value (L4 tier, SURVEY §2.8 row 1): the
+ * `ai.rapids.cudf.Scalar` surface the reference bundles from the cudf
+ * submodule. In the reference a Scalar owns a device allocation; here
+ * the value is host-resident — the srjt engine receives scalars by
+ * value through op arguments (the C ABI takes plain ints/doubles), so
+ * no native handle is required. AutoCloseable is kept for drop-in
+ * compatibility with reference call sites (try-with-resources).
+ */
+package ai.rapids.cudf;
+
+import java.math.BigDecimal;
+import java.math.BigInteger;
+
+public final class Scalar implements AutoCloseable {
+
+  private final DType type;
+  private final boolean valid;
+  private final long longValue;       // integral / bool / decimal64 unscaled low bits
+  private final double doubleValue;   // float32/float64
+  private final String stringValue;   // STRING
+  private final BigInteger bigValue;  // DECIMAL128 unscaled
+
+  private Scalar(DType type, boolean valid, long l, double d, String s, BigInteger big) {
+    this.type = type;
+    this.valid = valid;
+    this.longValue = l;
+    this.doubleValue = d;
+    this.stringValue = s;
+    this.bigValue = big;
+  }
+
+  public static Scalar fromByte(byte v) {
+    return new Scalar(DType.INT8, true, v, 0, null, null);
+  }
+
+  public static Scalar fromShort(short v) {
+    return new Scalar(DType.INT16, true, v, 0, null, null);
+  }
+
+  public static Scalar fromInt(int v) {
+    return new Scalar(DType.INT32, true, v, 0, null, null);
+  }
+
+  public static Scalar fromLong(long v) {
+    return new Scalar(DType.INT64, true, v, 0, null, null);
+  }
+
+  public static Scalar fromBool(boolean v) {
+    return new Scalar(DType.BOOL8, true, v ? 1 : 0, 0, null, null);
+  }
+
+  public static Scalar fromFloat(float v) {
+    return new Scalar(DType.FLOAT32, true, 0, v, null, null);
+  }
+
+  public static Scalar fromDouble(double v) {
+    return new Scalar(DType.FLOAT64, true, 0, v, null, null);
+  }
+
+  public static Scalar fromString(String v) {
+    if (v == null) {
+      return new Scalar(DType.STRING, false, 0, 0, null, null);
+    }
+    return new Scalar(DType.STRING, true, 0, 0, v, null);
+  }
+
+  /** DECIMAL128 from an unscaled BigInteger; {@code scale} follows the
+   * cudf convention (negative = digits right of the point). */
+  public static Scalar fromDecimal(int scale, BigInteger unscaled) {
+    DType t = DType.create(DType.DTypeEnum.DECIMAL128, scale);
+    return new Scalar(t, true, 0, 0, null, unscaled);
+  }
+
+  public static Scalar fromBigDecimal(BigDecimal v) {
+    return fromDecimal(-v.scale(), v.unscaledValue());
+  }
+
+  /** A null scalar of the given type. */
+  public static Scalar fromNull(DType type) {
+    return new Scalar(type, false, 0, 0, null, null);
+  }
+
+  public DType getType() {
+    return type;
+  }
+
+  public boolean isValid() {
+    return valid;
+  }
+
+  public byte getByte() {
+    return (byte) longValue;
+  }
+
+  public short getShort() {
+    return (short) longValue;
+  }
+
+  public int getInt() {
+    return (int) longValue;
+  }
+
+  public long getLong() {
+    return longValue;
+  }
+
+  public boolean getBoolean() {
+    return longValue != 0;
+  }
+
+  public float getFloat() {
+    return (float) doubleValue;
+  }
+
+  public double getDouble() {
+    return doubleValue;
+  }
+
+  public String getJavaString() {
+    return stringValue;
+  }
+
+  public BigInteger getBigInteger() {
+    return bigValue;
+  }
+
+  public BigDecimal getBigDecimal() {
+    return new BigDecimal(bigValue, -type.getScale());
+  }
+
+  @Override
+  public void close() {
+    // host-resident value: nothing to release; kept for API parity
+  }
+
+  @Override
+  public boolean equals(Object o) {
+    if (!(o instanceof Scalar)) {
+      return false;
+    }
+    Scalar s = (Scalar) o;
+    if (!type.equals(s.type) || valid != s.valid) {
+      return false;
+    }
+    if (!valid) {
+      return true;
+    }
+    switch (type.getTypeId()) {
+      case FLOAT32:
+      case FLOAT64:
+        return Double.compare(doubleValue, s.doubleValue) == 0;
+      case STRING:
+        return stringValue.equals(s.stringValue);
+      case DECIMAL128:
+        return bigValue.equals(s.bigValue);
+      default:
+        return longValue == s.longValue;
+    }
+  }
+
+  @Override
+  public int hashCode() {
+    int h = type.hashCode();
+    if (valid) {
+      h = h * 31 + (stringValue != null ? stringValue.hashCode()
+          : bigValue != null ? bigValue.hashCode()
+          : Long.hashCode(longValue ^ Double.doubleToLongBits(doubleValue)));
+    }
+    return h;
+  }
+
+  @Override
+  public String toString() {
+    if (!valid) {
+      return "Scalar{" + type + ", NULL}";
+    }
+    Object v = stringValue != null ? stringValue : bigValue != null ? bigValue
+        : type.getTypeId() == DType.DTypeEnum.FLOAT32
+        || type.getTypeId() == DType.DTypeEnum.FLOAT64 ? doubleValue : longValue;
+    return "Scalar{" + type + ", " + v + "}";
+  }
+}

@@ -8,6 +8,10 @@ package ai.rapids.cudf;
 
 import com.nvidia.spark.rapids.jni.NativeDepsLoader;
 
+import java.math.BigInteger;
+import java.util.ArrayList;
+import java.util.List;
+
 public final class Table implements AutoCloseable {
 
   static {
@@ -50,6 +54,73 @@ public final class Table implements AutoCloseable {
     if (nativeHandle != 0) {
       closeNative(nativeHandle);
       nativeHandle = 0;
+    }
+  }
+
+  /**
+   * Test-data builder (SURVEY §2.8 row 1: the `Table.TestBuilder`
+   * surface the reference's JUnit tier builds inputs with). The native
+   * table snapshots its columns, so {@code build()} closes the
+   * intermediate ColumnVectors it created.
+   */
+  public static final class TestBuilder {
+
+    private final List<ColumnVector> columns = new ArrayList<>();
+
+    public TestBuilder column(Byte... values) {
+      columns.add(ColumnVector.fromBoxedBytes(values));
+      return this;
+    }
+
+    public TestBuilder column(Short... values) {
+      columns.add(ColumnVector.fromBoxedShorts(values));
+      return this;
+    }
+
+    public TestBuilder column(Integer... values) {
+      columns.add(ColumnVector.fromBoxedInts(values));
+      return this;
+    }
+
+    public TestBuilder column(Long... values) {
+      columns.add(ColumnVector.fromBoxedLongs(values));
+      return this;
+    }
+
+    public TestBuilder column(Float... values) {
+      columns.add(ColumnVector.fromBoxedFloats(values));
+      return this;
+    }
+
+    public TestBuilder column(Double... values) {
+      columns.add(ColumnVector.fromBoxedDoubles(values));
+      return this;
+    }
+
+    public TestBuilder column(Boolean... values) {
+      columns.add(ColumnVector.fromBoxedBooleans(values));
+      return this;
+    }
+
+    public TestBuilder column(String... values) {
+      columns.add(ColumnVector.fromStrings(values));
+      return this;
+    }
+
+    public TestBuilder decimal128Column(int scale, BigInteger... values) {
+      columns.add(ColumnVector.decimalFromBigInt(scale, values));
+      return this;
+    }
+
+    public Table build() {
+      try {
+        return new Table(columns.toArray(new ColumnVector[0]));
+      } finally {
+        for (ColumnVector c : columns) {
+          c.close();
+        }
+        columns.clear();
+      }
     }
   }
 

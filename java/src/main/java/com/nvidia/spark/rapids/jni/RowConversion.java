@@ -4,11 +4,9 @@
  * (convertToRows :35, convertFromRows :137; row format doc :44-117)
  * over the srjt C ABI columnar engine (native/src/columnar.cc) instead
  * of the cudf CUDA kernels. The JCUDF byte layout is identical
- * (cross-checked byte-for-byte in tests/test_native_columnar.py).
- *
- * Divergence: one call produces ONE row batch; batches beyond the 2 GiB
- * size_type limit must be split by the caller (the reference splits
- * internally, row_conversion.cu:1465-1543).
+ * (cross-checked byte-for-byte in tests/test_native_columnar.py), and
+ * batches split internally against the 2 GiB size_type ceiling like the
+ * reference (row_conversion.cu:1465-1543).
  */
 package com.nvidia.spark.rapids.jni;
 

@@ -1,0 +1,71 @@
+/*
+ * JVM-tier tests for CastStrings — the strategy of reference
+ * CastStringsTest.java:35-99 (non-ANSI garbage -> null; ANSI ->
+ * CastException carrying first bad row + string) on the plain-Java
+ * harness. Run via ci/java-tests.sh when a JDK is present.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import static com.nvidia.spark.rapids.jni.TestHarness.assertEquals;
+import static com.nvidia.spark.rapids.jni.TestHarness.assertThrows;
+import static com.nvidia.spark.rapids.jni.TestHarness.test;
+
+import ai.rapids.cudf.AssertUtils;
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.Table;
+
+public class CastStringsTest {
+
+  public static void main(String[] args) {
+    test("toIntegerNonAnsi", () -> {
+      try (ColumnVector in = ColumnVector.fromStrings(
+              " 42", "-7", "3.9", "junk", null, "2147483647", "2147483648");
+           ColumnVector out = CastStrings.toInteger(in, false, DType.INT32);
+           // Spark semantics: "3.9" truncates to 3, garbage and
+           // overflow become null
+           ColumnVector expected = ColumnVector.fromBoxedInts(
+               42, -7, 3, null, null, Integer.MAX_VALUE, null)) {
+        AssertUtils.assertColumnsAreEqual(expected, out);
+      }
+    });
+
+    test("toIntegerAnsiThrowsFirstBadRow", () -> {
+      try (ColumnVector in = ColumnVector.fromStrings("1", "2", "bogus", "alsobad")) {
+        CastException e = assertThrows(CastException.class,
+            () -> CastStrings.toInteger(in, true, DType.INT32).close());
+        assertEquals(2, e.getRowWithError(), "row with error");
+        assertEquals("bogus", e.getStringWithError(), "string with error");
+      }
+    });
+
+    test("toDecimalNonAnsi", () -> {
+      try (ColumnVector in = ColumnVector.fromStrings("1.23", "-4.5", "bad", null);
+           ColumnVector out = CastStrings.toDecimal(in, false, 9, -2)) {
+        assertEquals(DType.DTypeEnum.DECIMAL32, out.getType().getTypeId(), "precision 9 type");
+        assertEquals(-2, out.getType().getScale(), "scale");
+      }
+    });
+
+    test("toDecimalAnsiThrows", () -> {
+      try (ColumnVector in = ColumnVector.fromStrings("1.0", "oops")) {
+        CastException e = assertThrows(CastException.class,
+            () -> CastStrings.toDecimal(in, true, 9, -2).close());
+        assertEquals(1, e.getRowWithError(), "row with error");
+        assertEquals("oops", e.getStringWithError(), "string with error");
+      }
+    });
+
+    test("toIntegerOverflowFences", () -> {
+      try (ColumnVector in = ColumnVector.fromStrings(
+              "127", "128", "-128", "-129");
+           ColumnVector out = CastStrings.toInteger(in, false, DType.INT8);
+           ColumnVector expected = ColumnVector.fromBoxedBytes(
+               Byte.MAX_VALUE, null, Byte.MIN_VALUE, null)) {
+        AssertUtils.assertColumnsAreEqual(expected, out);
+      }
+    });
+
+    TestHarness.finish("CastStringsTest");
+  }
+}

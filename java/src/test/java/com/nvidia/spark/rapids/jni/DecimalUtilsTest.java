@@ -1,0 +1,91 @@
+/*
+ * JVM-tier tests for DecimalUtils — the strategy of reference
+ * DecimalUtilsTest.java (golden multiply/divide cases incl. the
+ * SPARK-40129 double-rounding scenario :151, overflow :106, div-by-zero)
+ * on the plain-Java harness. Expected values match the ctypes-verified
+ * battery in tests/test_decimal_utils.py, so the Java surface is pinned
+ * to the same engine semantics. Run via ci/java-tests.sh with a JDK.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import static com.nvidia.spark.rapids.jni.TestHarness.test;
+
+import ai.rapids.cudf.AssertUtils;
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.Table;
+import java.math.BigInteger;
+
+public class DecimalUtilsTest {
+
+  private static BigInteger big(String v) {
+    return new BigInteger(v);
+  }
+
+  public static void main(String[] args) {
+    test("simpleMultiply", () -> {
+      try (ColumnVector a = ColumnVector.decimalFromBigInt(-1, big("10"), big("37"));
+           ColumnVector b = ColumnVector.decimalFromBigInt(-1, big("10"), big("15"));
+           Table result = DecimalUtils.multiply128(a, b, -1);
+           Table expected = new Table.TestBuilder()
+               .column(false, false)
+               .decimal128Column(-1, big("10"), big("56"))
+               .build()) {
+        AssertUtils.assertTablesAreEqual(expected, result);
+      }
+    });
+
+    test("sparkCompatMultiplySpark40129", () -> {
+      // double-rounding bug-compatibility (reference
+      // DecimalUtilsTest.java:151, decimal_utils.cu:538-553)
+      try (ColumnVector a = ColumnVector.decimalFromBigInt(-10,
+               big("33583773388230965117849476564650294583"));
+           ColumnVector b = ColumnVector.decimalFromBigInt(-10, big("-120000000000"));
+           Table result = DecimalUtils.multiply128(a, b, -6);
+           Table expected = new Table.TestBuilder()
+               .column(false)
+               .decimal128Column(-6, big("-40300528065877158141419371877580354"))
+               .build()) {
+        AssertUtils.assertTablesAreEqual(expected, result);
+      }
+    });
+
+    test("multiplyOverflowFlag", () -> {
+      try (ColumnVector a = ColumnVector.decimalFromBigInt(-10,
+               big("5776949384953805890688943467625198736"));
+           ColumnVector b = ColumnVector.decimalFromBigInt(-10,
+               big("-12585082608914000056082416901564700995"));
+           Table result = DecimalUtils.multiply128(a, b, -6);
+           ColumnVector overflow = result.getColumn(0);
+           ColumnVector expectedOverflow = ColumnVector.fromBoxedBooleans(true)) {
+        AssertUtils.assertColumnsAreEqual(expectedOverflow, overflow);
+      }
+    });
+
+    test("simpleDivide", () -> {
+      try (ColumnVector a = ColumnVector.decimalFromBigInt(-1, big("10"), big("37"), big("999"));
+           ColumnVector b = ColumnVector.decimalFromBigInt(-1, big("10"), big("15"), big("45"));
+           Table result = DecimalUtils.divide128(a, b, -1);
+           Table expected = new Table.TestBuilder()
+               .column(false, false, false)
+               .decimal128Column(-1, big("10"), big("25"), big("222"))
+               .build()) {
+        AssertUtils.assertTablesAreEqual(expected, result);
+      }
+    });
+
+    test("divideByZeroSetsOverflow", () -> {
+      // div-by-zero -> overflow flag, result 0 (decimal_utils.cu:608-612)
+      try (ColumnVector a = ColumnVector.decimalFromBigInt(-1, big("10"));
+           ColumnVector b = ColumnVector.decimalFromBigInt(0, big("0"));
+           Table result = DecimalUtils.divide128(a, b, -1);
+           Table expected = new Table.TestBuilder()
+               .column(true)
+               .decimal128Column(-1, big("0"))
+               .build()) {
+        AssertUtils.assertTablesAreEqual(expected, result);
+      }
+    });
+
+    TestHarness.finish("DecimalUtilsTest");
+  }
+}

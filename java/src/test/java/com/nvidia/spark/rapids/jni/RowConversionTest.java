@@ -1,0 +1,110 @@
+/*
+ * JVM-tier round-trip tests for RowConversion — the strategy of
+ * reference RowConversionTest.java:30-94 (build a table, convert to
+ * JCUDF rows, convert back, assert equality) rebuilt on the plain-Java
+ * harness. Run via ci/java-tests.sh when a JDK is present.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import static com.nvidia.spark.rapids.jni.TestHarness.assertEquals;
+import static com.nvidia.spark.rapids.jni.TestHarness.assertTrue;
+import static com.nvidia.spark.rapids.jni.TestHarness.test;
+
+import ai.rapids.cudf.AssertUtils;
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.Table;
+
+public class RowConversionTest {
+
+  private static void roundTrip(Table t, DType... schema) {
+    ColumnVector[] rows = RowConversion.convertToRows(t);
+    try {
+      assertEquals(1, rows.length, "batches");
+      try (Table back = RowConversion.convertFromRows(rows[0], schema)) {
+        AssertUtils.assertTablesAreEqual(t, back);
+      }
+    } finally {
+      for (ColumnVector c : rows) {
+        c.close();
+      }
+    }
+  }
+
+  public static void main(String[] args) {
+    test("fixedWidthRoundTrip", () -> {
+      try (Table t = new Table.TestBuilder()
+          .column(1, 2, null, 4)
+          .column(10L, null, 30L, 40L)
+          .column(1.5, 2.5, 3.5, null)
+          .column((byte) 1, (byte) 2, (byte) 3, (byte) 4)
+          .column(true, false, null, true)
+          .build()) {
+        roundTrip(t, DType.INT32, DType.INT64, DType.FLOAT64, DType.INT8, DType.BOOL8);
+      }
+    });
+
+    test("stringsRoundTrip", () -> {
+      try (Table t = new Table.TestBuilder()
+          .column(100, 200, 300)
+          .column("hello", null, "spark rapids on tpu")
+          .column(7L, 8L, 9L)
+          .build()) {
+        roundTrip(t, DType.INT32, DType.STRING, DType.INT64);
+      }
+    });
+
+    test("fixedWidthOptimizedAgreesWithGeneral", () -> {
+      // the dual-implementation cross-check (reference
+      // row_conversion.cpp:43-60): both paths must emit identical rows
+      try (Table t = new Table.TestBuilder()
+          .column((short) 1, (short) 2, (short) 3)
+          .column(4, 5, 6)
+          .build()) {
+        ColumnVector[] a = RowConversion.convertToRows(t);
+        ColumnVector[] b = RowConversion.convertToRowsFixedWidthOptimized(t);
+        try {
+          assertEquals(a.length, b.length, "batch count");
+          for (int i = 0; i < a.length; i++) {
+            AssertUtils.assertColumnsAreEqual(a[i], b[i]);
+          }
+        } finally {
+          for (ColumnVector c : a) {
+            c.close();
+          }
+          for (ColumnVector c : b) {
+            c.close();
+          }
+        }
+      }
+    });
+
+    test("decimal128RoundTrip", () -> {
+      try (Table t = new Table.TestBuilder()
+          .decimal128Column(-2,
+              java.math.BigInteger.valueOf(12345),
+              java.math.BigInteger.valueOf(-99999),
+              null)
+          .build()) {
+        roundTrip(t, DType.create(DType.DTypeEnum.DECIMAL128, -2));
+      }
+    });
+
+    test("rowsAreListInt8", () -> {
+      try (Table t = new Table.TestBuilder().column(1, 2, 3).build()) {
+        ColumnVector[] rows = RowConversion.convertToRows(t);
+        try {
+          assertTrue(rows[0].getType().equals(DType.LIST),
+              "rows column must be LIST, got " + rows[0].getType());
+          assertEquals(3, rows[0].getRowCount(), "row count");
+        } finally {
+          for (ColumnVector c : rows) {
+            c.close();
+          }
+        }
+      }
+    });
+
+    TestHarness.finish("RowConversionTest");
+  }
+}

@@ -1,0 +1,70 @@
+/*
+ * JVM-tier tests for the ai.rapids.cudf.Scalar surface: typed factory
+ * round-trips, null semantics, and the BigDecimal view used by
+ * decimal-building test code. Run via ci/java-tests.sh with a JDK.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import static com.nvidia.spark.rapids.jni.TestHarness.assertEquals;
+import static com.nvidia.spark.rapids.jni.TestHarness.assertTrue;
+import static com.nvidia.spark.rapids.jni.TestHarness.test;
+
+import ai.rapids.cudf.DType;
+import ai.rapids.cudf.Scalar;
+import java.math.BigDecimal;
+import java.math.BigInteger;
+
+public class ScalarTest {
+
+  public static void main(String[] args) {
+    test("typedFactories", () -> {
+      try (Scalar i = Scalar.fromInt(42);
+           Scalar l = Scalar.fromLong(Long.MIN_VALUE);
+           Scalar d = Scalar.fromDouble(2.5);
+           Scalar b = Scalar.fromBool(true);
+           Scalar s = Scalar.fromString("hi")) {
+        assertEquals(42, i.getInt(), "int");
+        assertEquals(DType.INT32, i.getType(), "int type");
+        assertEquals(Long.MIN_VALUE, l.getLong(), "long");
+        assertTrue(d.getDouble() == 2.5, "double");
+        assertTrue(b.getBoolean(), "bool");
+        assertEquals("hi", s.getJavaString(), "string");
+        assertTrue(i.isValid(), "valid");
+      }
+    });
+
+    test("nullScalars", () -> {
+      try (Scalar n = Scalar.fromNull(DType.INT64);
+           Scalar ns = Scalar.fromString(null)) {
+        assertTrue(!n.isValid(), "null long invalid");
+        assertEquals(DType.INT64, n.getType(), "null keeps type");
+        assertTrue(!ns.isValid(), "null string invalid");
+      }
+    });
+
+    test("decimalView", () -> {
+      try (Scalar d = Scalar.fromDecimal(-2, new BigInteger("12345"))) {
+        assertEquals(DType.DTypeEnum.DECIMAL128, d.getType().getTypeId(), "type");
+        assertEquals(-2, d.getType().getScale(), "scale");
+        assertEquals(new BigDecimal("123.45"), d.getBigDecimal(), "big decimal");
+      }
+      try (Scalar d2 = Scalar.fromBigDecimal(new BigDecimal("-7.250"))) {
+        assertEquals(-3, d2.getType().getScale(), "scale from BigDecimal");
+        assertEquals(new BigInteger("-7250"), d2.getBigInteger(), "unscaled");
+      }
+    });
+
+    test("equality", () -> {
+      try (Scalar a = Scalar.fromInt(7); Scalar b = Scalar.fromInt(7);
+           Scalar c = Scalar.fromInt(8); Scalar n1 = Scalar.fromNull(DType.INT32);
+           Scalar n2 = Scalar.fromNull(DType.INT32)) {
+        assertEquals(a, b, "equal values");
+        assertTrue(!a.equals(c), "unequal values");
+        assertEquals(n1, n2, "null == null same type");
+        assertTrue(!a.equals(n1), "valid != null");
+      }
+    });
+
+    TestHarness.finish("ScalarTest");
+  }
+}

@@ -1,0 +1,75 @@
+/*
+ * Minimal test harness for the JVM tier (SURVEY §4.2 analog). The
+ * reference runs JUnit 5 via surefire (reference pom.xml:480-534); this
+ * image ships no JUnit jar, so each test class is a plain main() using
+ * these static helpers, and ci/java-tests.sh runs them when a JDK is
+ * present. The assertion style mirrors JUnit's so a later port to real
+ * JUnit is mechanical.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.List;
+
+public final class TestHarness {
+
+  private TestHarness() {}
+
+  public interface TestCase {
+    void run() throws Exception;
+  }
+
+  private static final List<String> failures = new ArrayList<>();
+  private static int passed = 0;
+
+  public static void test(String name, TestCase body) {
+    try {
+      body.run();
+      passed++;
+      System.out.println("  ok " + name);
+    } catch (Throwable t) {
+      failures.add(name + ": " + t);
+      System.out.println("  FAIL " + name + ": " + t);
+      t.printStackTrace(System.out);
+    }
+  }
+
+  /** Exit with the suite result; call at the end of each main(). */
+  public static void finish(String suite) {
+    System.out.println(suite + ": " + passed + " passed, " + failures.size() + " failed");
+    if (!failures.isEmpty()) {
+      System.exit(1);
+    }
+  }
+
+  public static void assertTrue(boolean cond, String message) {
+    if (!cond) {
+      throw new AssertionError(message);
+    }
+  }
+
+  public static void assertEquals(long expected, long actual, String message) {
+    if (expected != actual) {
+      throw new AssertionError(message + ": expected " + expected + ", got " + actual);
+    }
+  }
+
+  public static void assertEquals(Object expected, Object actual, String message) {
+    if (expected == null ? actual != null : !expected.equals(actual)) {
+      throw new AssertionError(message + ": expected " + expected + ", got " + actual);
+    }
+  }
+
+  /** JUnit assertThrows analog. */
+  public static <T extends Throwable> T assertThrows(Class<T> type, TestCase body) {
+    try {
+      body.run();
+    } catch (Throwable t) {
+      if (type.isInstance(t)) {
+        return type.cast(t);
+      }
+      throw new AssertionError("expected " + type.getSimpleName() + ", got " + t);
+    }
+    throw new AssertionError("expected " + type.getSimpleName() + ", nothing thrown");
+  }
+}

@@ -1,0 +1,114 @@
+/*
+ * JVM-tier tests for ZOrder — the reference-model-oracle pattern of
+ * reference ZOrderTest.java:31-67: DeltaLake's interleaveBits
+ * re-implemented in pure Java is the source of truth, compared against
+ * the native op. Run via ci/java-tests.sh when a JDK is present.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import static com.nvidia.spark.rapids.jni.TestHarness.assertEquals;
+import static com.nvidia.spark.rapids.jni.TestHarness.assertTrue;
+import static com.nvidia.spark.rapids.jni.TestHarness.test;
+
+import ai.rapids.cudf.ColumnVector;
+import ai.rapids.cudf.HostMemoryBuffer;
+
+public class ZOrderTest {
+
+  /** DeltaLake interleaveBits oracle: MSB-first round-robin across
+   * inputs; nulls read as 0 (same algorithm tests/test_zorder.py pins
+   * for the device op). */
+  private static byte[] oracleRow(long[] values, int nbits) {
+    byte[] out = new byte[values.length * nbits / 8];
+    int retByte = 0;
+    int retBit = 7;
+    int outPos = 0;
+    for (int bit = nbits - 1; bit >= 0; bit--) {
+      for (long v : values) {
+        retByte |= ((v >> bit) & 1) << retBit;
+        retBit--;
+        if (retBit == -1) {
+          out[outPos++] = (byte) retByte;
+          retByte = 0;
+          retBit = 7;
+        }
+      }
+    }
+    return out;
+  }
+
+  private static void compare(Integer[][] cols, int rows) {
+    ColumnVector[] cvs = new ColumnVector[cols.length];
+    try {
+      for (int i = 0; i < cols.length; i++) {
+        cvs[i] = ColumnVector.fromBoxedInts(cols[i]);
+      }
+      try (ColumnVector result = ZOrder.interleaveBits(rows, cvs)) {
+        assertEquals(rows, result.getRowCount(), "result rows");
+        byte[] offsRaw;
+        byte[] blob;
+        try (HostMemoryBuffer ob = result.copyOffsetsToHost()) {
+          offsRaw = new byte[(int) ob.getLength()];
+          ob.getBytes(offsRaw, 0, 0, ob.getLength());
+        }
+        try (HostMemoryBuffer cb = result.copyCharsToHost()) {
+          blob = new byte[(int) cb.getLength()];
+          cb.getBytes(blob, 0, 0, cb.getLength());
+        }
+        for (int r = 0; r < rows; r++) {
+          int start = readInt(offsRaw, r);
+          int end = readInt(offsRaw, r + 1);
+          long[] vals = new long[cols.length];
+          for (int c = 0; c < cols.length; c++) {
+            vals[c] = cols[c][r] == null ? 0 : cols[c][r] & 0xFFFFFFFFL;
+          }
+          byte[] expected = oracleRow(vals, 32);
+          assertEquals(expected.length, end - start, "row " + r + " length");
+          for (int b = 0; b < expected.length; b++) {
+            assertTrue(expected[b] == blob[start + b],
+                "row " + r + " byte " + b + ": expected " + expected[b]
+                    + ", got " + blob[start + b]);
+          }
+        }
+      }
+    } finally {
+      for (ColumnVector c : cvs) {
+        if (c != null) {
+          c.close();
+        }
+      }
+    }
+  }
+
+  private static int readInt(byte[] raw, int i) {
+    return (raw[4 * i] & 0xFF) | ((raw[4 * i + 1] & 0xFF) << 8)
+        | ((raw[4 * i + 2] & 0xFF) << 16) | ((raw[4 * i + 3] & 0xFF) << 24);
+  }
+
+  public static void main(String[] args) {
+    test("twoIntColumnsMatchOracle", () -> {
+      Integer[] a = {1, -7, Integer.MAX_VALUE, 0, 123456};
+      Integer[] b = {42, 5, -1, Integer.MIN_VALUE, 654321};
+      compare(new Integer[][] {a, b}, 5);
+    });
+
+    test("nullsReadAsZero", () -> {
+      Integer[] a = {1, null, -7};
+      Integer[] b = {null, 5, 123456};
+      compare(new Integer[][] {a, b}, 3);
+    });
+
+    test("singleColumn", () -> {
+      Integer[] a = {0, 1, 2, 3, -4};
+      compare(new Integer[][] {a}, 5);
+    });
+
+    test("emptyColumnListYieldsEmptyLists", () -> {
+      try (ColumnVector result = ZOrder.interleaveBits(4)) {
+        assertEquals(4, result.getRowCount(), "rows");
+      }
+    });
+
+    TestHarness.finish("ZOrderTest");
+  }
+}

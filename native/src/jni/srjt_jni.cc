@@ -43,7 +43,11 @@ int32_t srjt_column_scale(int64_t h);
 int64_t srjt_column_size(int64_t h);
 int64_t srjt_column_data_bytes(int64_t h);
 int32_t srjt_column_has_validity(int64_t h);
+int64_t srjt_column_chars_bytes(int64_t h);
 int32_t srjt_column_copy_data(int64_t h, uint8_t* out, int64_t capacity);
+int32_t srjt_column_copy_validity(int64_t h, uint8_t* out, int64_t capacity);
+int32_t srjt_column_copy_offsets(int64_t h, int32_t* out, int64_t capacity);
+int32_t srjt_column_copy_chars(int64_t h, uint8_t* out, int64_t capacity);
 void srjt_column_close(int64_t h);
 int64_t srjt_table_create(const int64_t* col_handles, int32_t ncols);
 int32_t srjt_table_num_columns(int64_t h);
@@ -72,7 +76,14 @@ void srjt_device_shutdown();
 namespace {
 
 void throw_last_error(JNIEnv* env) {
-  jclass ex = env->FindClass("java/lang/RuntimeException");
+  // CudfException is the contract type (reference bundles it from the
+  // cudf submodule); fall back to RuntimeException if the class is not
+  // on the classpath (e.g. a trimmed deployment jar).
+  jclass ex = env->FindClass("ai/rapids/cudf/CudfException");
+  if (ex == nullptr) {
+    env->ExceptionClear();
+    ex = env->FindClass("java/lang/RuntimeException");
+  }
   if (ex != nullptr) {
     env->ThrowNew(ex, srjt_last_error());
   }
@@ -278,6 +289,35 @@ JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyDataNative(
   }
 }
 
+JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_ColumnVector_charsBytesNative(JNIEnv* env, jclass,
+                                                                          jlong handle) {
+  jlong v = srjt_column_chars_bytes(handle);
+  if (v < 0) throw_last_error(env);
+  return v;
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyValidityNative(
+    JNIEnv* env, jclass, jlong handle, jlong out_addr, jlong rows) {
+  if (srjt_column_copy_validity(handle, reinterpret_cast<uint8_t*>(out_addr), rows) != 0) {
+    throw_last_error(env);
+  }
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyOffsetsNative(
+    JNIEnv* env, jclass, jlong handle, jlong out_addr, jlong capacity_ints) {
+  if (srjt_column_copy_offsets(handle, reinterpret_cast<int32_t*>(out_addr), capacity_ints)
+      != 0) {
+    throw_last_error(env);
+  }
+}
+
+JNIEXPORT void JNICALL Java_ai_rapids_cudf_ColumnVector_copyCharsNative(
+    JNIEnv* env, jclass, jlong handle, jlong out_addr, jlong capacity) {
+  if (srjt_column_copy_chars(handle, reinterpret_cast<uint8_t*>(out_addr), capacity) != 0) {
+    throw_last_error(env);
+  }
+}
+
 // --- ai.rapids.cudf.Table ------------------------------------------------
 
 JNIEXPORT jlong JNICALL Java_ai_rapids_cudf_Table_createNative(JNIEnv* env, jclass,
@@ -328,9 +368,15 @@ JNIEXPORT jlongArray JNICALL Java_com_nvidia_spark_rapids_jni_RowConversion_conv
     return nullptr;
   }
   jlongArray arr = env->NewLongArray(n);
-  if (arr != nullptr) {
-    env->SetLongArrayRegion(arr, 0, n, reinterpret_cast<const jlong*>(handles));
+  if (arr == nullptr) {
+    // JVM allocation failed (OutOfMemoryError pending): the registered
+    // batch columns would be unreachable from Java — release them here
+    for (int32_t i = 0; i < n; i++) {
+      srjt_column_close(handles[i]);
+    }
+    return nullptr;
   }
+  env->SetLongArrayRegion(arr, 0, n, reinterpret_cast<const jlong*>(handles));
   return arr;
 }
 

@@ -127,7 +127,17 @@ def _decompress_block(kind: int, blob: bytes, block_size: int) -> bytes:
             return runtime.snappy_uncompress(blob)
         import pyarrow as pa
 
-        return pa.Codec("snappy").decompress(blob).to_pybytes()
+        # raw snappy carries its uncompressed length as a leading
+        # varint; pyarrow's Codec requires it passed explicitly
+        n, shift, pos = 0, 0, 0
+        while True:
+            b = blob[pos]
+            n |= (b & 0x7F) << shift
+            pos += 1
+            shift += 7
+            if not (b & 0x80):
+                break
+        return pa.Codec("snappy").decompress(blob, n).to_pybytes()
     if kind == _K_LZ4:
         # LZ4 block; decompressed chunk is bounded by compressionBlockSize
         from .. import runtime

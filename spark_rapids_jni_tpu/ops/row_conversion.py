@@ -211,23 +211,26 @@ def _col_u32_parts(col: Column, var_slot_vals: dict, i: int):
     return [(1, u)]
 
 
-def _fixed_section32(
+def _fixed_planes32(
     layout: RowLayout,
     cols: Sequence[Column],
     var_slot_vals: dict,
     pad_to: int,
 ) -> jnp.ndarray:
-    """[N, ceil(pad_to/4)] uint32: column slots + padding + validity, as
-    little-endian u32 lanes of the row's first pad_to bytes.
+    """[ceil(pad_to/4), N] uint32 PLANE STACK: lane p holds bytes
+    [4p, 4p+4) of every row (column slots + padding + validity), as
+    little-endian u32 words.
 
     TPU-layout-aware build: every interleave formulation that writes
     narrow lane slices ([N, w] pieces into a wide row) runs at ~0.3 GB/s
     on TPU — sub-128-lane writes waste 64x+ of each vector store (three
     designs measured: static-permutation take, ordered 160-piece concat,
     per-group stack). Instead each u32 LANE of the row is composed
-    arithmetically as a contiguous [N] plane, the planes stack along
-    axis 0 (dense memcpy), and ONE transpose ([P, N] -> [N, P], measured
-    ~590 GB/s r+w chained) produces the row-major section."""
+    arithmetically as a contiguous [N] plane and the planes stack along
+    axis 0 (dense memcpy). Callers either transpose ONCE to row-major
+    ([P, N] -> [N, P], measured ~590 GB/s r+w chained; _fixed_section32)
+    or feed the stack straight to the sublane-expand kernel
+    (ragged_bytes.expand_u32_planes) whose u8 transpose is cheaper."""
     n = len(cols[0]) if cols else 0
     num_lanes = (pad_to + 3) // 4
     plane_parts: List[List[jnp.ndarray]] = [[] for _ in range(num_lanes)]
@@ -260,8 +263,17 @@ def _fixed_section32(
 
     zero = jnp.zeros((n,), jnp.uint32)
     planes = [_or_compose(parts, zero) for parts in plane_parts]
-    stacked = jnp.stack(planes, axis=0) if planes else jnp.zeros((0, n), jnp.uint32)
-    return stacked.T  # [N, P]
+    return jnp.stack(planes, axis=0) if planes else jnp.zeros((0, n), jnp.uint32)
+
+
+def _fixed_section32(
+    layout: RowLayout,
+    cols: Sequence[Column],
+    var_slot_vals: dict,
+    pad_to: int,
+) -> jnp.ndarray:
+    """[N, ceil(pad_to/4)] u32 row-major lanes (see _fixed_planes32)."""
+    return _fixed_planes32(layout, cols, var_slot_vals, pad_to).T
 
 
 def _or_compose(parts: List[jnp.ndarray], zero: jnp.ndarray) -> jnp.ndarray:
@@ -320,10 +332,17 @@ def _batch_boundaries(row_sizes: np.ndarray) -> List[Tuple[int, int, int]]:
 
 
 def _to_rows_fixed(layout: RowLayout, cols: Sequence[Column], n: int) -> jnp.ndarray:
-    """All-fixed-width table -> [N * row_size] uint8 blob (u32 plane
-    build; the byte view is one 1-D bitcast of the dense lanes)."""
-    from .ragged_bytes import u32_rows_to_u8_flat
+    """All-fixed-width table -> [N * row_size] uint8 blob.
 
+    TPU: plane stack [P, N] -> sublane-expand kernel -> u8 transpose ->
+    flatten (the u32 transpose is skipped entirely; round-3 profile
+    took this axis from 50.8 ms to ~9 ms at 1M x 212). Elsewhere: the
+    row-major u32 section + chunked bitcast."""
+    from .ragged_bytes import _use_pallas, expand_u32_planes, u32_rows_to_u8_flat
+
+    if _use_pallas() and n >= 8:
+        planes = _fixed_planes32(layout, cols, {}, layout.row_size_fixed)
+        return expand_u32_planes(planes).T.reshape(-1)
     f32 = _fixed_section32(layout, cols, {}, layout.row_size_fixed)
     return u32_rows_to_u8_flat(f32)
 
@@ -809,9 +828,21 @@ def convert_from_rows_grouped(rows: Column, dtypes: Sequence[DType]) -> GroupedR
 @partial(jax.jit, static_argnums=(0, 1))
 def _decode_grouped_uniform(layout: RowLayout, dtypes: Tuple[DType, ...], blob: jnp.ndarray):
     n = blob.shape[0] // layout.row_size_fixed
-    fixed = blob.reshape(n, layout.row_size_fixed)[:, : layout.fixed_end]
-    ga, vt = _decode_groups_core(layout, dtypes, fixed)
+    ga, vt = _decode_groups_core(layout, dtypes, _uniform_fixed(layout, blob, n))
     return tuple(ga.values()), vt
+
+
+def _uniform_fixed(layout: RowLayout, blob: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Row view of a uniform-stride blob. The planes path keeps the full
+    (8-aligned) row width — its transpose wants lane-aligned input and
+    the pad bytes are never read; the byte-slice path trims to
+    fixed_end so its strided slices touch fewer bytes."""
+    from .ragged_bytes import _use_pallas
+
+    rows = blob.reshape(n, layout.row_size_fixed)
+    if _use_pallas() and n >= 8:
+        return rows
+    return rows[:, : layout.fixed_end]
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -826,8 +857,7 @@ def _decode_fixed_uniform(layout: RowLayout, dtypes: Tuple[DType, ...], blob: jn
     ONE program (reshape is free; XLA fuses the slice into the group
     gathers, so bytes move HBM->HBM exactly once)."""
     n = blob.shape[0] // layout.row_size_fixed
-    fixed = blob.reshape(n, layout.row_size_fixed)[:, : layout.fixed_end]
-    return _decode_fixed_groups(layout, dtypes, fixed)
+    return _decode_fixed_groups(layout, dtypes, _uniform_fixed(layout, blob, n))
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -843,6 +873,68 @@ def _decode_fixed_cols(layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jnp.
     return _decode_fixed_groups(layout, dtypes, fixed)
 
 
+def _decode_groups_from_planes(
+    layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jnp.ndarray
+):
+    """TPU decode core: [N, W] u8 rows -> the same (group_arrays,
+    valid_t) contract as _decode_groups_core, via the sublane-pack
+    kernel instead of strided byte slices.
+
+    fixed.T IS the byte-plane stack (row j = byte j of every row), so
+    pack_u8_planes turns it into [W/4, N] u32 words — one streaming
+    kernel — and every group extraction is a contiguous ROW take of the
+    plane array plus lane-constant shifts (slot alignment guarantees
+    4-byte entries sit at lane boundaries). Replaces the 4-strided-
+    u8-slice lane build that dominated decode (14.4 of 13.6..14 ms at
+    1M x 212, round-3 profile)."""
+    from .ragged_bytes import pack_u8_planes
+
+    n, w = fixed.shape
+    pad = (-w) % 4
+    if pad:
+        fixed = jnp.pad(fixed, ((0, 0), (0, pad)))
+    planes = pack_u8_planes(fixed.T)  # [W/4, N] u32
+
+    groups, entries = _entry_plan(layout, dtypes)
+    group_arrays: dict = {}
+    for key, count in groups.items():
+        ew = _entry_width(key)
+        byte_off = np.zeros((count,), np.int64)
+        for col_entries in entries:
+            for k2, idx, row_byte in col_entries:
+                if k2 == key:
+                    byte_off[idx] = row_byte
+        b4 = jnp.asarray(byte_off // 4, jnp.int32)
+        if ew == 4:
+            lanes = jnp.take(planes, b4, axis=0)  # [k, N] u32
+        elif ew == 8:
+            lo = jnp.take(planes, b4, axis=0).astype(jnp.uint64)
+            hi = jnp.take(planes, b4 + 1, axis=0).astype(jnp.uint64)
+            lanes = lo | (hi << jnp.uint64(32))
+        else:  # ew in (1, 2): sub-word shift is constant per entry
+            base = jnp.take(planes, b4, axis=0)
+            sh = jnp.asarray((byte_off % 4) * 8, np.uint32)[:, None]
+            if ew == 2:
+                lanes = lax.convert_element_type(
+                    (base >> sh) & jnp.uint32(0xFFFF), jnp.uint16)
+            else:
+                lanes = lax.convert_element_type(
+                    (base >> sh) & jnp.uint32(0xFF), jnp.uint8)
+        if key == "u4":
+            typed = lanes
+        else:
+            target = jnp.dtype(key[key.index("_") + 1:])
+            typed = lanes if lanes.dtype == target else lax.bitcast_convert_type(lanes, target)
+        group_arrays[key] = lax.optimization_barrier(typed)  # [k, N]
+
+    c = len(dtypes)
+    vbyte = layout.validity_offset + np.arange(c) // 8
+    vbase = jnp.take(planes, jnp.asarray(vbyte // 4, jnp.int32), axis=0)  # [C, N]
+    vsh = jnp.asarray((vbyte % 4) * 8 + np.arange(c) % 8, np.uint32)[:, None]
+    valid_t = lax.optimization_barrier(((vbase >> vsh) & jnp.uint32(1)).astype(bool))
+    return group_arrays, valid_t
+
+
 def _decode_groups_core(layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jnp.ndarray):
     """[N, fixed_end] u8 -> ({group key: [k, N] typed lanes}, [C, N] validity).
 
@@ -854,6 +946,19 @@ def _decode_groups_core(layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jnp
     even locally a 212-column table costs 424 buffer registrations the
     grouped form avoids.
     """
+    from .ragged_bytes import _use_pallas
+
+    if _use_pallas() and fixed.shape[0] >= 8:
+        return _decode_groups_from_planes(layout, dtypes, fixed)
+    return _decode_groups_bytes(layout, dtypes, fixed)
+
+
+def _decode_groups_bytes(layout: RowLayout, dtypes: Tuple[DType, ...], fixed: jnp.ndarray):
+    """Byte-slice decode core (the non-Pallas implementation; see
+    _decode_groups_core for the representation contract). Kept callable
+    directly so the planes core can be cross-checked against it on any
+    backend — on a TPU host the dispatcher above would otherwise route
+    both sides of the comparison to the planes path."""
     groups, entries = _entry_plan(layout, dtypes)
 
     # NOTE on shapes: everything stays 2-D. A tempting "lane view"
