@@ -12,6 +12,7 @@
 #include "host_buffer.h"
 #include "parquet_footer.h"
 #include "lz4.h"
+#include "lzo.h"
 #include "snappy.h"
 #include "zstd_codec.h"
 
@@ -173,6 +174,13 @@ SRJT_EXPORT int64_t srjt_lz4_decompress_block(const uint8_t* src, int64_t src_le
                                               uint8_t* dst, int64_t dst_capacity) {
   return guarded(
       [&]() -> int64_t { return srjt::lz4_decompress_block(src, src_len, dst, dst_capacity); },
+      -1);
+}
+
+SRJT_EXPORT int64_t srjt_lzo1x_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                                          int64_t dst_capacity) {
+  return guarded(
+      [&]() -> int64_t { return srjt::lzo1x_decompress(src, src_len, dst, dst_capacity); },
       -1);
 }
 

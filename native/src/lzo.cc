@@ -1,0 +1,173 @@
+#include "lzo.h"
+
+#include <cstring>
+
+namespace srjt {
+
+// LZO1X stream format (decoder-side description):
+//
+//   A stream is a sequence of instructions. Each instruction byte T
+//   selects one of five encodings; runs longer than the inline field
+//   extend with zero bytes (each adding 255) plus one final byte.
+//
+//   T 0..15   literal run (only valid as the first instruction or
+//             after an instruction whose low 2 bits were 0):
+//             len = T + 3 (T == 0: extended, len = 18 + sum of
+//             extension bytes). After the FIRST literal run the next
+//             instruction interprets T 0..15 as an M1 match.
+//   T 16..31  M4 match: 3-bit len field (extended), distance
+//             16384 + ((T & 8) << 11) + next two bytes as
+//             (b0 >> 2) | (b1 << 6); len = (T & 7) + 2. The stream
+//             terminator is the M4 instruction 17,0,0 (distance
+//             exactly 16384, len 3).
+//   T 32..63  M3 match: 5-bit len field (extended), distance
+//             1 + ((b0 >> 2) | (b1 << 6)); len = (T & 31) + 2.
+//   T 64..255 M2 match: len = (T >> 5) + 1, distance
+//             1 + ((T >> 2) & 7) + (next byte << 3).
+//   M1 (T 0..15 in post-match state): 2-byte match, distance
+//             1 + (T >> 2) + (next byte << 2).
+//
+//   After every match, the low 2 bits of the second-to-last
+//   instruction byte give 0..3 trailing literals copied verbatim; a
+//   zero value returns to the literal-run state.
+//
+// First byte special case: a value > 17 encodes an immediate literal
+// run of (first - 17) bytes.
+
+namespace {
+
+inline uint8_t need(const uint8_t* src, int64_t src_len, int64_t ip) {
+  if (ip >= src_len) throw LzoError("lzo: truncated stream");
+  return src[ip];
+}
+
+inline int64_t extended_len(const uint8_t* src, int64_t src_len, int64_t& ip, int64_t base) {
+  int64_t t = 0;
+  while (need(src, src_len, ip) == 0) {
+    t += 255;
+    ip++;
+    if (t > (int64_t{1} << 40)) throw LzoError("lzo: runaway length");
+  }
+  t += base + src[ip++];
+  return t;
+}
+
+inline void copy_literals(const uint8_t* src, int64_t src_len, int64_t& ip, uint8_t* dst,
+                          int64_t dst_capacity, int64_t& op, int64_t n) {
+  if (ip + n > src_len) throw LzoError("lzo: literal run past input");
+  if (op + n > dst_capacity) throw LzoError("lzo: output overflow (literals)");
+  std::memcpy(dst + op, src + ip, static_cast<size_t>(n));
+  ip += n;
+  op += n;
+}
+
+inline void copy_match(uint8_t* dst, int64_t dst_capacity, int64_t& op, int64_t dist,
+                       int64_t len) {
+  if (dist <= 0 || dist > op) throw LzoError("lzo: match distance out of range");
+  if (op + len > dst_capacity) throw LzoError("lzo: output overflow (match)");
+  // overlapping copies are the point (run-length style): byte-by-byte
+  for (int64_t i = 0; i < len; i++) {
+    dst[op + i] = dst[op + i - dist];
+  }
+  op += len;
+}
+
+}  // namespace
+
+int64_t lzo1x_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                         int64_t dst_capacity) {
+  int64_t ip = 0;
+  int64_t op = 0;
+  int64_t t = need(src, src_len, ip);
+  int64_t state_lit = 0;  // trailing literals owed after a match
+
+  bool first_literal = false;
+  if (t > 17) {
+    ip++;
+    t -= 17;
+    if (t < 4) {
+      state_lit = t;
+      // fall through to the post-match literal copy below
+      copy_literals(src, src_len, ip, dst, dst_capacity, op, state_lit);
+    } else {
+      copy_literals(src, src_len, ip, dst, dst_capacity, op, t);
+      first_literal = true;
+    }
+  }
+
+  enum class State { Begin, FirstLiteralRun, Match };
+  State st = first_literal ? State::FirstLiteralRun
+                           : (state_lit ? State::Match : State::Begin);
+
+  while (true) {
+    t = need(src, src_len, ip);
+    ip++;
+
+    if (st != State::Match && t < 16) {
+      if (st == State::Begin) {
+        // literal run
+        int64_t len = (t == 0) ? extended_len(src, src_len, ip, 18)
+                               : t + 3;
+        copy_literals(src, src_len, ip, dst, dst_capacity, op, len);
+        st = State::FirstLiteralRun;
+        continue;
+      }
+      // after-a-literal-run state: T 0..15 is a 3-byte match at
+      // distance 2049.. (the format reserves the near distances for
+      // the post-match M1 encoding)
+      int64_t dist = 2049 + (t >> 2) + (int64_t{need(src, src_len, ip)} << 2);
+      ip++;
+      copy_match(dst, dst_capacity, op, dist, 3);
+      int64_t trail = t & 3;
+      if (trail) copy_literals(src, src_len, ip, dst, dst_capacity, op, trail);
+      st = trail ? State::Match : State::Begin;
+      continue;
+    }
+
+    if (st == State::Match && t < 16) {
+      // M1 match in post-match state: 2-byte match
+      int64_t dist = 1 + (t >> 2) + (int64_t{need(src, src_len, ip)} << 2);
+      ip++;
+      copy_match(dst, dst_capacity, op, dist, 2);
+      int64_t trail = t & 3;
+      if (trail) copy_literals(src, src_len, ip, dst, dst_capacity, op, trail);
+      st = trail ? State::Match : State::Begin;
+      continue;
+    }
+
+    int64_t len, dist, trail;
+    if (t >= 64) {  // M2
+      len = (t >> 5) + 1;
+      dist = 1 + ((t >> 2) & 7) + (int64_t{need(src, src_len, ip)} << 3);
+      ip++;
+      trail = t & 3;
+    } else if (t >= 32) {  // M3
+      len = (t & 31) ? (t & 31) + 2 : extended_len(src, src_len, ip, 33);
+      uint8_t b0 = need(src, src_len, ip);
+      ip++;
+      uint8_t b1 = need(src, src_len, ip);
+      ip++;
+      dist = 1 + ((b0 >> 2) | (int64_t{b1} << 6));
+      trail = b0 & 3;
+    } else {  // 16..31: M4
+      int64_t h = (t & 8) << 11;
+      len = (t & 7) ? (t & 7) + 2 : extended_len(src, src_len, ip, 9);
+      uint8_t b0 = need(src, src_len, ip);
+      ip++;
+      uint8_t b1 = need(src, src_len, ip);
+      ip++;
+      dist = 16384 + h + ((b0 >> 2) | (int64_t{b1} << 6));
+      trail = b0 & 3;
+      if (dist == 16384) {
+        if (len != 3) throw LzoError("lzo: bad end-of-stream marker");
+        if (ip != src_len) throw LzoError("lzo: trailing bytes after end marker");
+        return op;
+      }
+    }
+    copy_match(dst, dst_capacity, op, dist, len);
+    if (trail) copy_literals(src, src_len, ip, dst, dst_capacity, op, trail);
+    st = trail ? State::Match : State::Begin;
+  }
+}
+
+}  // namespace srjt

@@ -1,0 +1,21 @@
+// From-scratch LZO1X block decompressor (nvcomp-analog capability row,
+// SURVEY §2.8: the reference jar ships nvcomp's LZO support for ORC).
+// Implements the published LZO1X stream format — no LZO library code.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+
+namespace srjt {
+
+class LzoError : public std::runtime_error {
+ public:
+  explicit LzoError(const char* what) : std::runtime_error(what) {}
+};
+
+// Decompress one LZO1X stream into dst. Returns the decompressed size.
+// Throws LzoError on malformed input or dst_capacity overflow.
+int64_t lzo1x_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                         int64_t dst_capacity);
+
+}  // namespace srjt

@@ -161,7 +161,14 @@ def _decompress_block(kind: int, blob: bytes, block_size: int) -> bytes:
 
         # zstd frames carry no decompressed size in ORC chunks — stream
         return pa.input_stream(pa.BufferReader(blob), compression="zstd").read()
-    raise OrcReadError(f"unsupported compression kind {kind} (LZO pending)")
+    if kind == _K_LZO:
+        # LZO1X chunk; decompressed size bounded by compressionBlockSize
+        from .. import runtime
+
+        if runtime.native_available():
+            return runtime.lzo1x_decompress(blob, max(block_size, 1 << 18))
+        raise OrcReadError("LZO ORC needs the native runtime (cmake native/)")
+    raise OrcReadError(f"unsupported compression kind {kind}")
 
 
 def _deframe(data: bytes, kind: int, block_size: int = 1 << 18) -> bytes:

@@ -71,7 +71,8 @@ _P_DICTIONARY = 2
 _P_DATA_V2 = 3
 
 # compression codecs (parquet.thrift CompressionCodec)
-_CODECS = {0: None, 1: "snappy", 2: "gzip", 4: "brotli", 5: "lz4", 6: "zstd", 7: "lz4_raw"}
+_CODECS = {0: None, 1: "snappy", 2: "gzip", 3: "lzo", 4: "brotli", 5: "lz4",
+           6: "zstd", 7: "lz4_raw"}
 
 # converted types
 _C_UTF8 = 0
@@ -158,6 +159,38 @@ def _lz4_hadoop(data: bytes, uncompressed_size: int) -> Optional[bytes]:
     return b"".join(parts)
 
 
+def _lzo_hadoop(data: bytes, uncompressed_size: int) -> Optional[bytes]:
+    """Parquet codec 3 (LZO): Hadoop block framing — repeated
+    [u32 BE uncompressed size][u32 BE compressed size][raw LZO1X
+    stream]. Returns None when the framing does not validate."""
+    from .. import runtime
+
+    pos, n = 0, len(data)
+    parts: List[bytes] = []
+    total = 0
+    while pos < n:
+        if pos + 8 > n:
+            return None
+        (usize,) = struct.unpack_from(">I", data, pos)
+        (csize,) = struct.unpack_from(">I", data, pos + 4)
+        pos += 8
+        if csize == 0 or pos + csize > n or total + usize > uncompressed_size:
+            return None
+        block = data[pos : pos + csize]
+        pos += csize
+        try:
+            out = runtime.lzo1x_decompress(block, usize)
+        except Exception:
+            return None
+        if len(out) != usize:
+            return None
+        parts.append(out)
+        total += usize
+    if total != uncompressed_size:
+        return None
+    return b"".join(parts)
+
+
 def _lz4_raw_block(block: bytes, uncompressed_size: int) -> bytes:
     """One raw LZ4 block via the native decoder, pyarrow as fallback."""
     from .. import runtime
@@ -184,6 +217,19 @@ def _decompress(data: bytes, codec: Optional[str], uncompressed_size: int) -> by
         out = _lz4_hadoop(data, uncompressed_size)
         if out is not None:
             return out
+    if codec == "lzo":
+        # codec 3: Hadoop block framing around raw LZO1X blocks
+        # (native/src/lzo.cc); pyarrow ships no LZO codec, so this is
+        # native-or-error — mapping it to None would silently treat the
+        # page as uncompressed
+        from .. import runtime
+
+        if not runtime.native_available():
+            raise ParquetReadError("LZO parquet needs the native runtime (cmake native/)")
+        out = _lzo_hadoop(data, uncompressed_size)
+        if out is None:
+            raise ParquetReadError("malformed Hadoop LZO page framing")
+        return out
     if codec == "zstd":
         from .. import runtime
 

@@ -23,6 +23,10 @@ __all__ = [
     "native_lib",
     "live_handles",
     "snappy_uncompress",
+    "lz4_decompress_block",
+    "lzo1x_decompress",
+    "zstd_decompress",
+    "zstd_frame_content_size",
     "NativeParquetFooter",
     "NativeHostBuffer",
 ]
@@ -175,6 +179,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_byte_array_lens.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
     lib.srjt_lz4_decompress_block.restype = ctypes.c_int64
     lib.srjt_lz4_decompress_block.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.srjt_lzo1x_decompress.restype = ctypes.c_int64
+    lib.srjt_lzo1x_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
     lib.srjt_zstd_decompress.restype = ctypes.c_int64
     lib.srjt_zstd_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
     lib.srjt_zstd_frame_content_size.restype = ctypes.c_int64
@@ -226,6 +232,24 @@ def lz4_decompress_block(data: bytes, dst_capacity: int) -> bytes:
     out = np.empty(max(dst_capacity, 1), np.uint8)
     src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
     n = lib.srjt_lz4_decompress_block(
+        src, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(out)
+    )
+    if n < 0:
+        _raise_last(lib)
+    return out[:n].tobytes()
+
+
+def lzo1x_decompress(data: bytes, dst_capacity: int) -> bytes:
+    """Decompress one LZO1X stream via the native codec tier (ORC LZO
+    chunks, Hadoop-framed parquet LZO blocks)."""
+    import numpy as np
+
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built (run cmake in native/)")
+    out = np.empty(max(dst_capacity, 1), np.uint8)
+    src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    n = lib.srjt_lzo1x_decompress(
         src, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(out)
     )
     if n < 0:
