@@ -32,11 +32,13 @@ from jax import lax
 N_ROWS = 1 << 20  # 1M-row stepping stone
 N_KEYS = 4096  # distinct groups
 REPS = 7
-# 256 chained iterations ~= 40ms of device time at the current kernel
-# speed (0.16 ms/iter): the long-short difference must dwarf the axon
-# tunnel's +-5ms run-to-run jitter or the derived per-iter is noise
-# (round-2 regression: K_LONG=17 left a 2.5ms signal inside that jitter)
-K_SHORT, K_LONG = 1, 257
+# 1024 chained iterations ~= 72ms of device time at the current kernel
+# speed (~0.07 ms/iter after the transposed-layout MXU rewrite): the
+# long-short difference must dwarf the axon tunnel's +-5ms run-to-run
+# jitter or the derived per-iter is noise (round-2 regression:
+# K_LONG=17 left a 2.5ms signal inside that jitter; the round-3 kernel
+# made 257 marginal again)
+K_SHORT, K_LONG = 1, 1025
 
 
 @partial(jax.jit, static_argnums=(3, 4))

@@ -479,9 +479,11 @@ def padded_extract(pool: jnp.ndarray, starts: jnp.ndarray, max_len: int) -> jnp.
 
 _ASSEMBLE_BLOCK_TILES = 1 << 16  # dst tiles per lax.map block when the
 # blob is too large for the single-pass form (bounds per-block temps)
-_ASSEMBLE_SINGLE_PASS_BYTES = 256 * (1 << 20)  # single-pass gather cap:
-# above this the three [T, G] gather buffers coexisting (3x blob bytes)
-# push the 1M-row mixed axis over HBM; the lax.map path bounds them
+_ASSEMBLE_SINGLE_PASS_BYTES = 768 * (1 << 20)  # single-pass gather cap:
+# the three [T, G] gather buffers coexist (3x blob bytes, ~2.3 GB at
+# the cap) — fine on 16 GB HBM; above it the lax.map path bounds them.
+# Round-3 note: the old 256 MB cap pushed the 1M-row mixed axis
+# (537 MB blob) onto 33 SEQUENTIAL map blocks for no memory benefit.
 
 
 def assemble_rows(

@@ -401,7 +401,7 @@ def _jit_var_section(
     lane-aligned, the trailing validity bytes (``tail_lane``) ride in
     as a pseudo-column at shift 0 so the u32 pipeline never needs a
     sub-lane boundary between the fixed and variable parts."""
-    from .ragged_bytes import padded_extract, var_accumulate
+    from .ragged_bytes import _pow2_ceil, padded_extract, var_accumulate
 
     p_mats, all_shifts = [], []
     if tail_bytes:
@@ -409,19 +409,30 @@ def _jit_var_section(
         mask = (jnp.arange(4, dtype=jnp.int32) < tail_bytes)[None, :]
         p_mats.append(jnp.where(mask, tail, 0))
         all_shifts.append(jnp.zeros((tail_lane.shape[0],), jnp.int32))
-    seq = None  # serialize the per-column extractions: each one's tile
-    # windows are ~2x the payload and all K coexisting (~4 GB at the
-    # 155-col x 1M axis) tip the program over HBM when XLA runs the
-    # independent gathers concurrently
+    # Serialize the per-column extractions ONLY under memory pressure:
+    # each padded matrix is N * pow2(maxlen) bytes and the tile windows
+    # another ~2x the char payload; when all K coexist a wide axis can
+    # tip over HBM (~4 GB observed at 155-col x 1M with large
+    # maxlens) — but forcing N sequential kernels costs real wall time,
+    # so small extractions stay concurrent.
+    n_rows = tail_lane.shape[0]
+    est = sum(
+        n_rows * max(_pow2_ceil(min(_round_up(maxlens[k], 4), maxvar)), 4)
+        + 2 * int(chars[k].shape[0])
+        for k in range(len(chars))
+    )
+    serialize = est > (1 << 30)
+    seq = None
     for k in range(len(chars)):
         lc = min(_round_up(maxlens[k], 4), maxvar)
         st = starts[k].astype(jnp.int64)
-        if seq is not None:
+        if serialize and seq is not None:
             st = st + (seq[0, 0].astype(jnp.int64) & 0)
         p = padded_extract(chars[k], st, maxlens[k])[:, :lc]
         p = jnp.where(jnp.arange(lc, dtype=jnp.int32)[None, :] < lens[k][:, None], p, 0)
-        p = lax.optimization_barrier(p)
-        seq = p
+        if serialize:
+            p = lax.optimization_barrier(p)
+            seq = p
         p_mats.append(p)
         all_shifts.append(shifts[k])
     return var_accumulate(tuple(p_mats), tuple(all_shifts), maxvar)
@@ -603,19 +614,30 @@ def convert_to_rows(table: Table) -> List[Column]:
             out.append(_wrap_batch_as_list_column(blob, rel, uniform_stride=row_size))
         return out
 
-    # string path: per-row sizes -> batch split -> scatter per batch
-    lens_total = jnp.zeros((n,), dtype=jnp.int64)
-    for i in layout.variable_cols:
-        offs = cols[i].offsets
-        lens_total = lens_total + (offs[1:] - offs[:-1]).astype(jnp.int64)
-    row_sizes_dev = (
-        (lens_total + layout.fixed_end + JCUDF_ROW_ALIGNMENT - 1)
-        // JCUDF_ROW_ALIGNMENT
-        * JCUDF_ROW_ALIGNMENT
-    )
-    row_sizes = np.asarray(row_sizes_dev)  # host sync: batch metadata
-    batches = _batch_boundaries(row_sizes)
+    # string path: per-row sizes -> batch split -> encode per batch.
+    # ONE jitted program for the sizes, and the host pull is kept to
+    # TWO SCALARS (total, max) in the common single-batch case — the
+    # eager per-column accumulation plus the full [N] i64 pull cost
+    # ~1.0 s of the 1.6 s mixed-axis call through a remote tunnel
+    # (round-3 profile); offsets stay on device.
+    var_offs = tuple(cols[i].offsets for i in layout.variable_cols)
+    sizes_dev, stats = _jit_row_size_stats(layout, var_offs)
+    total, max_size = (int(v) for v in np.asarray(stats))  # host sync
     maxlens = _var_maxlens(layout, cols)
+
+    if total <= MAX_BATCH_BYTES:  # single batch: no further host pulls
+        row_offsets = _jit_offsets_from_sizes(sizes_dev)
+        maxvar = max(_round_up(max_size - layout.fixed_end, 64), 8)
+        if n * (layout.fixed_end + maxvar) <= _PADDED_ROWS_BYTE_BUDGET:
+            blob = _to_rows_strings_padded(
+                layout, tuple(cols), row_offsets, total, maxlens, maxvar
+            )
+        else:  # huge outlier strings: padded form would OOM
+            blob = _to_rows_strings(layout, cols, row_offsets[:-1], total)
+        return [_wrap_batch_as_list_column(blob, row_offsets)]
+
+    row_sizes = np.asarray(sizes_dev)  # host sync: full batch metadata
+    batches = _batch_boundaries(row_sizes)
     out = []
     for rs, re, nbytes in batches:
         batch_cols = [_slice_column(c, rs, re) for c in cols]
@@ -633,6 +655,28 @@ def convert_to_rows(table: Table) -> List[Column]:
             blob = _to_rows_strings(layout, batch_cols, row_offsets[:-1], nbytes)
         out.append(_wrap_batch_as_list_column(blob, row_offsets))
     return out
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_row_size_stats(layout: RowLayout, var_offsets: Tuple[jnp.ndarray, ...]):
+    """([N] int64 8-aligned row sizes ON DEVICE, [2] {sum, max}) for the
+    string path, one program — the caller pulls only the two scalars
+    unless the table spans multiple 2 GiB batches."""
+    n = var_offsets[0].shape[0] - 1
+    lens_total = jnp.zeros((n,), dtype=jnp.int64)
+    for offs in var_offsets:
+        lens_total = lens_total + (offs[1:] - offs[:-1]).astype(jnp.int64)
+    sizes = (
+        (lens_total + layout.fixed_end + JCUDF_ROW_ALIGNMENT - 1)
+        // JCUDF_ROW_ALIGNMENT
+        * JCUDF_ROW_ALIGNMENT
+    )
+    return sizes, jnp.stack([jnp.sum(sizes), jnp.max(sizes)])
+
+
+@jax.jit
+def _jit_offsets_from_sizes(sizes: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(sizes)])
 
 
 def _slice_column(col: Column, rs: int, re: int) -> Column:
@@ -683,11 +727,34 @@ def convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
 
 
 def _gather_fixed(layout: RowLayout, blob, starts, n: int):
-    """Gather each row's fixed section out of a ragged blob: [N, fixed_end] u8."""
+    """Gather each row's fixed section out of a ragged blob: [N, fixed_end] u8.
+
+    The naive [N, fixed_end] index-matrix gather materializes an i64
+    index array as big as 8x the fixed bytes (OOM at 1M x 1012 on a
+    16 GB chip, observed round 3): on TPU the rows come out of ONE
+    overlapping-tile gather + Pallas rotate (padded_extract), elsewhere
+    the index matrix is chunked to ~64 MB."""
+    fe = layout.fixed_end
     if not layout.variable_cols:
-        return _jit_gather_fixed(blob, starts, layout.fixed_end, n)
-    idx = starts[:, None] + jnp.arange(layout.fixed_end, dtype=jnp.int64)[None, :]
-    return blob[idx]
+        return _jit_gather_fixed(blob, starts, fe, n)
+    from .ragged_bytes import _use_pallas
+
+    if _use_pallas() and n >= 8:
+        return _jit_padded_gather(blob, starts, fe)
+    chunk = max(1, (64 << 20) // 8 // max(fe, 1))
+    span = jnp.arange(fe, dtype=jnp.int64)[None, :]
+    parts = []
+    for r0 in range(0, n, chunk):
+        idx = starts[r0 : min(r0 + chunk, n), None] + span
+        parts.append(blob[idx])
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _jit_padded_gather(blob, starts, fixed_end: int):
+    from .ragged_bytes import padded_extract
+
+    return padded_extract(blob, starts, fixed_end)[:, :fixed_end]
 
 
 @jax.jit
