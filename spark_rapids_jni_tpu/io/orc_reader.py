@@ -7,14 +7,16 @@ format parsing (no ORC library — a minimal protobuf wire reader plays
 the role thrift_compact plays for parquet), host-side decode of the
 sequential/metadata tiers, device-resident Columns out.
 
-Scope: flat struct-root schemas; BOOLEAN/BYTE/SHORT/INT/LONG/FLOAT/
-DOUBLE/STRING/BINARY/DATE columns; DIRECT + DICTIONARY (v2) string
-encodings; integer RLEv1 and RLEv2 (short-repeat, direct, delta,
-patched-base); byte-RLE and boolean bit streams; NONE/ZLIB/SNAPPY/LZ4/
-ZSTD compression framing. PRESENT streams drive validity with the same
-present-scatter shape as the parquet reader. Timestamps, decimals,
-unions, and nested types raise (documented; the parquet reader is the
-nested-format workhorse).
+Scope: flat AND nested struct-root schemas (STRUCT/LIST/MAP at any
+depth; maps assemble as LIST<STRUCT<key,value>>, the cudf shape);
+BOOLEAN/BYTE/SHORT/INT/LONG/FLOAT/DOUBLE/STRING/BINARY/DATE/TIMESTAMP/
+DECIMAL leaves; DIRECT + DICTIONARY (v2) string encodings; integer
+RLEv1 and RLEv2 (short-repeat, direct, delta, patched-base); byte-RLE
+and boolean bit streams; NONE/ZLIB/SNAPPY/LZO/LZ4/ZSTD compression
+framing. PRESENT streams drive validity with the same present-scatter
+shape as the parquet reader; nested presence composes down the type
+tree (children store values only where every ancestor is non-null).
+Unions raise (documented).
 
 Oracle for tests: pyarrow.orc.
 """
@@ -514,11 +516,11 @@ class _StripeReader:
         raw = self.streams.get((col, skind))
         return None if raw is None else _deframe(raw, self.kind, self.block_size)
 
-    def present(self, col: int) -> Optional[np.ndarray]:
+    def present(self, col: int, count: Optional[int] = None) -> Optional[np.ndarray]:
         raw = self.stream(col, _S_PRESENT)
         if raw is None:
             return None
-        return _bool_bits(raw, self.num_rows)
+        return _bool_bits(raw, self.num_rows if count is None else count)
 
     def ints(self, col: int, signed: bool, count: int) -> np.ndarray:
         return self.ints_stream(col, _S_DATA, signed, count)
@@ -538,12 +540,34 @@ class _StripeReader:
         return _rle_v1(raw, count, False)
 
 
-def _read_column(rd: _StripeReader, col: int, tnode: _TypeNode):
-    """Returns (values np/tuple, present np|None) for one stripe."""
-    present = rd.present(col)
-    n_present = int(present.sum()) if present is not None else rd.num_rows
+def _read_column(rd: _StripeReader, col: int, types: List[_TypeNode],
+                 count: Optional[int] = None):
+    """Returns (values np/tuple, present np|None) for one stripe.
+
+    ``count`` is the column's value count at its nesting level (stripe
+    rows at the root; the parent's non-null count under a STRUCT; the
+    summed lengths under a LIST/MAP) — ORC presence and data streams
+    are all relative to the parent's surviving entries.
+    """
+    tnode = types[col]
+    if count is None:
+        count = rd.num_rows
+    present = rd.present(col, count)
+    n_present = int(present.sum()) if present is not None else count
 
     k = tnode.kind
+    if k == _T_STRUCT:
+        children = [_read_column(rd, sub, types, n_present) for sub in tnode.subtypes]
+        return ("struct", children), present
+    if k in (_T_LIST, _T_MAP):
+        lens = rd.lengths(col, n_present).astype(np.int64)
+        child_count = int(lens.sum())
+        if k == _T_LIST:
+            child = _read_column(rd, tnode.subtypes[0], types, child_count)
+            return ("list", lens, child), present
+        key = _read_column(rd, tnode.subtypes[0], types, child_count)
+        val = _read_column(rd, tnode.subtypes[1], types, child_count)
+        return ("map", lens, key, val), present
     if k == _T_BYTE:  # tinyint DATA is byte-RLE, not integer RLE
         raw = rd.stream(col, _S_DATA)
         return _byte_rle(raw, n_present).view(np.int8), present
@@ -617,22 +641,87 @@ def _read_column(rd: _StripeReader, col: int, tnode: _TypeNode):
                 raise OrcReadError("decimal stored scale exceeds declared scale")
             out.append(v * (10 ** int(declared - s_)))
         return ("decimal", out), present
-    raise OrcReadError(f"unsupported ORC type kind {k} (nested/unions pending)")
+    raise OrcReadError(f"unsupported ORC type kind {k} (unions pending)")
+
+
+def _assemble_nested(
+    tnode: _TypeNode,
+    types: List[_TypeNode],
+    pieces: List,
+    presents: List[np.ndarray],
+) -> Column:
+    """Merge per-stripe pieces of one (possibly nested) column into a
+    device Column. ``presents`` are FULL-length masks at this nesting
+    level per stripe (parent presence already composed in: a child
+    stores values only where every ancestor is non-null, so masks
+    compose by scattering the child's packed mask into the parent's
+    surviving positions). MAPs assemble as LIST<STRUCT<key,value>> —
+    the cudf representation the parquet reader uses too."""
+    present_all = np.concatenate(presents) if presents else np.zeros(0, bool)
+    has_nulls = not bool(present_all.all())
+    k = tnode.kind
+
+    if k == _T_STRUCT:
+        child_cols = []
+        for ci, sub in enumerate(tnode.subtypes):
+            sub_pieces, sub_presents = [], []
+            for sp, ppres in zip(pieces, presents):
+                cpiece, cpres = sp[1][ci]
+                n_par = int(ppres.sum())
+                packed = cpres if cpres is not None else np.ones(n_par, bool)
+                full = np.zeros(len(ppres), bool)
+                full[np.flatnonzero(ppres)] = packed
+                sub_pieces.append(cpiece)
+                sub_presents.append(full)
+            child_cols.append(_assemble_nested(types[sub], types, sub_pieces, sub_presents))
+        return Column.struct_from_parts(
+            child_cols, tnode.field_names,
+            validity=jnp.asarray(present_all) if has_nulls else None,
+        )
+
+    if k in (_T_LIST, _T_MAP):
+        full_lens_parts = []
+        child_sets: List[List] = [[], []] if k == _T_MAP else [[]]
+        child_pres: List[List[np.ndarray]] = [[], []] if k == _T_MAP else [[]]
+        for sp, ppres in zip(pieces, presents):
+            lens = sp[1]
+            fl = np.zeros(len(ppres), np.int64)
+            fl[ppres] = lens
+            full_lens_parts.append(fl)
+            cc = int(lens.sum())
+            kids = (sp[2],) if k == _T_LIST else (sp[2], sp[3])
+            for ci, (cpiece, cpres) in enumerate(kids):
+                child_sets[ci].append(cpiece)
+                child_pres[ci].append(cpres if cpres is not None else np.ones(cc, bool))
+        full_lens = (
+            np.concatenate(full_lens_parts) if full_lens_parts else np.zeros(0, np.int64)
+        )
+        offsets = np.zeros(len(full_lens) + 1, np.int32)
+        np.cumsum(full_lens, out=offsets[1:])
+        if k == _T_LIST:
+            child = _assemble_nested(
+                types[tnode.subtypes[0]], types, child_sets[0], child_pres[0]
+            )
+        else:
+            key = _assemble_nested(types[tnode.subtypes[0]], types, child_sets[0], child_pres[0])
+            val = _assemble_nested(types[tnode.subtypes[1]], types, child_sets[1], child_pres[1])
+            child = Column.struct_from_parts([key, val], ["key", "value"])
+        return Column.list_from_parts(
+            offsets, child, validity=jnp.asarray(present_all) if has_nulls else None
+        )
+
+    return _to_column_normalized(pieces, present_all, tnode)
 
 
 @op_boundary("orc_read_table")
 def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
-    """Read a flat-schema ORC file into a device Table."""
+    """Read an ORC file (flat or nested schema) into a device Table."""
     if not file_bytes.startswith(b"ORC"):
         raise OrcReadError("not an ORC file")
     types, stripes, kind, _num_rows, block_size = _parse_tail(file_bytes)
     if not types or types[0].kind != _T_STRUCT:
         raise OrcReadError("ORC root must be a struct")
     root = types[0]
-    for st in root.subtypes:
-        t = types[st]
-        if t.kind in (_T_LIST, _T_MAP, _T_STRUCT, _T_UNION):
-            raise OrcReadError("nested ORC schemas unsupported (use parquet for nested)")
 
     names = root.field_names
     sel = list(range(len(names)))
@@ -650,12 +739,10 @@ def read_table(file_bytes: bytes, columns: Optional[List[str]] = None) -> Table:
         tnode = types[col_id]
         parts, presents = [], []
         for rd in readers:
-            vals, present = _read_column(rd, col_id, tnode)
+            vals, present = _read_column(rd, col_id, types)
             parts.append(vals)
             presents.append(present if present is not None else np.ones(rd.num_rows, bool))
-        # normalize: presents always materialized per stripe for concat
-        present_all = np.concatenate(presents) if presents else np.zeros(0, bool)
-        col = _to_column_normalized(parts, present_all, tnode)
+        col = _assemble_nested(tnode, types, parts, presents)
         out_cols.append(col)
         out_names.append(names[i])
     return Table(out_cols, names=out_names)

@@ -1,0 +1,117 @@
+"""ORC nested-type decode vs the pyarrow oracle (reference ORC support
+comes from cudf's reader; SURVEY §2.8 capability surface).
+
+Maps assemble as LIST<STRUCT<key,value>> — the cudf representation —
+so the oracle comparison converts pyarrow's list-of-pairs accordingly.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as paorc
+import pytest
+
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu.io.orc_reader import read_table
+
+
+def write_orc(table: pa.Table, **kw) -> bytes:
+    buf = io.BytesIO()
+    paorc.write_table(table, buf, **kw)
+    return buf.getvalue()
+
+
+def test_list_of_ints():
+    data = [[1, 2, 3], [], None, [4], [5, None, 7]]
+    t = pa.table({"a": pa.array(data, pa.list_(pa.int64()))})
+    got = read_table(write_orc(t))
+    assert got.column("a").to_pylist() == data
+
+
+def test_struct_flat():
+    data = [{"x": 1, "y": "a"}, {"x": None, "y": "b"}, None, {"x": 4, "y": None}]
+    t = pa.table({"s": pa.array(data, pa.struct([("x", pa.int32()), ("y", pa.string())]))})
+    got = read_table(write_orc(t))
+    expect = [None if d is None else d for d in data]
+    assert got.column("s").to_pylist() == expect
+
+
+def test_list_of_structs():
+    data = [
+        [{"k": 1, "v": 1.5}, {"k": 2, "v": None}],
+        None,
+        [],
+        [{"k": None, "v": -2.25}],
+    ]
+    ty = pa.list_(pa.struct([("k", pa.int64()), ("v", pa.float64())]))
+    t = pa.table({"ls": pa.array(data, ty)})
+    got = read_table(write_orc(t))
+    assert got.column("ls").to_pylist() == data
+
+
+def test_struct_of_list():
+    data = [
+        {"tags": ["a", "bb"], "n": 1},
+        {"tags": None, "n": 2},
+        {"tags": [], "n": None},
+        None,
+    ]
+    ty = pa.struct([("tags", pa.list_(pa.string())), ("n", pa.int32())])
+    t = pa.table({"sl": pa.array(data, ty)})
+    got = read_table(write_orc(t))
+    assert got.column("sl").to_pylist() == data
+
+
+def test_nested_list_of_list():
+    data = [[[1], [2, 3]], [], None, [None, [4, 5]]]
+    ty = pa.list_(pa.list_(pa.int32()))
+    t = pa.table({"ll": pa.array(data, ty)})
+    got = read_table(write_orc(t))
+    assert got.column("ll").to_pylist() == data
+
+
+def test_map_as_list_of_kv_structs():
+    data = [[("a", 1), ("b", 2)], [], None, [("z", None)]]
+    ty = pa.map_(pa.string(), pa.int64())
+    t = pa.table({"m": pa.array(data, ty)})
+    got = read_table(write_orc(t))
+    expect = [
+        None if row is None else [{"key": k, "value": v} for k, v in row]
+        for row in data
+    ]
+    assert got.column("m").to_pylist() == expect
+
+
+def test_nested_multi_stripe():
+    rng = np.random.default_rng(5)
+    n = 5000
+    data = [
+        None if rng.random() < 0.1
+        else [int(v) for v in rng.integers(0, 100, rng.integers(0, 5))]
+        for _ in range(n)
+    ]
+    t = pa.table({"a": pa.array(data, pa.list_(pa.int64())),
+                  "b": pa.array(np.arange(n, dtype=np.int64))})
+    blob = write_orc(t, stripe_size=16 * 1024)
+    got = read_table(blob)
+    assert got.column("a").to_pylist() == data
+    assert got.column("b").to_pylist() == list(range(n))
+
+
+@pytest.mark.parametrize("codec", ["zlib", "snappy", "zstd"])
+def test_nested_compressed(codec):
+    data = [[{"s": "x" * (i % 7), "i": i}] * (i % 3) for i in range(200)]
+    ty = pa.list_(pa.struct([("s", pa.string()), ("i", pa.int64())]))
+    t = pa.table({"c": pa.array(data, ty)})
+    got = read_table(write_orc(t, compression=codec))
+    assert got.column("c").to_pylist() == data
+
+
+def test_flat_columns_still_fine_next_to_nested():
+    t = pa.table({
+        "flat": pa.array([1, 2, None], pa.int64()),
+        "nest": pa.array([[1], None, [2, 3]], pa.list_(pa.int32())),
+    })
+    got = read_table(write_orc(t), columns=["flat"])
+    assert got.column("flat").to_pylist() == [1, 2, None]
