@@ -237,6 +237,17 @@ def bench_row_conversion_mixed(rows: int, reps: int, cols: int = 155, strings: b
     name = "row_conversion_mixed" + ("_strings" if strings else "")
     _report(name + "_to_rows", rows, cols, secs, nbytes)
 
+    # decode direction (the reference benches both axes,
+    # row_conversion.cpp:140-143). Known-slow: the ragged char
+    # extraction is element-granular u8 gathering — recorded honestly;
+    # the Pallas DMA compaction is the planned fix (NOTES_ROUND3).
+    row_cols = rc.convert_to_rows(table)
+    if len(row_cols) == 1:
+        secs = _time(
+            lambda: rc.convert_from_rows(row_cols[0], table.dtypes()), max(reps // 2, 1)
+        )
+        _report(name + "_from_rows", rows, cols, secs, nbytes)
+
 
 def bench_cast_string(rows: int, reps: int) -> None:
     import jax.numpy as jnp
