@@ -306,17 +306,30 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
     if how == "count_all":
         return counts_all, None
     if how == "count":
-        c = jax.ops.segment_sum(jnp.ones((n,), jnp.int64), gid_v, num_segments=num + 1)[:num]
-        return c, None
+        # ride the bounded-domain kernel (counts come from key routing;
+        # exactness guards live inside groupby_sum_bounded — the MXU
+        # path only engages while per-key counts stay f32-exact)
+        from .ops.aggregate import groupby_sum_bounded
+
+        _, c = groupby_sum_bounded(gid_v, jnp.ones((n,), jnp.float32), num)
+        return c.astype(jnp.int64), None
     x = _as_float(col)
     if how == "sum":
-        s = jax.ops.segment_sum(x, gid_v, num_segments=num + 1)[:num]
-        valid = jax.ops.segment_sum(m.astype(jnp.int32), gid_v, num_segments=num + 1)[:num] > 0
-        return s, valid
+        # one fused kernel for (sums, per-group valid counts) instead
+        # of two scatter-add passes: segment_sum lowers to the slow
+        # XLA scatter class on TPU; the MXU outer-product kernel in
+        # groupby_sum_bounded is ~17x faster at the 1M x 4096 axis and
+        # falls back to exact segment_sum off-TPU / for f64
+        from .ops.aggregate import groupby_sum_bounded
+
+        s, c = groupby_sum_bounded(gid_v, x, num)
+        return s, c > 0
     if how == "mean":
-        s = jax.ops.segment_sum(x, gid_v, num_segments=num + 1)[:num]
-        c = jax.ops.segment_sum(m.astype(x.dtype), gid_v, num_segments=num + 1)[:num]
-        return s / jnp.maximum(c, 1.0), c > 0
+        from .ops.aggregate import groupby_sum_bounded
+
+        s, c = groupby_sum_bounded(gid_v, x, num)
+        cf = c.astype(x.dtype)
+        return s / jnp.maximum(cf, 1.0), c > 0
     # min/max validity comes from the per-group valid-row COUNT, never
     # from isfinite(result): a genuine +/-inf value must survive
     has_vals = jax.ops.segment_sum(m.astype(jnp.int32), gid_v, num_segments=num + 1)[:num] > 0
