@@ -297,3 +297,22 @@ def test_optimized_rejects_strings():
     t = Table([Column.from_pylist(["x"], dt.STRING)])
     with pytest.raises(ValueError, match="fixed-width"):
         rc.convert_to_rows_fixed_width_optimized(t)
+
+
+def test_decode_zero_length_rows_share_start_offsets():
+    """Regression: the char-extraction forward-fill tags scatter values
+    by ROW INDEX — zero-length rows share their start offset with the
+    next row, and a dead row must not win the scatter-max tie. Dense
+    empty/None runs adjacent to non-empty rows exercise every tie
+    pattern in both string columns."""
+    a = ["", "", "xy", "", None, "abc", "", "", "q", None, "", "zz"]
+    b = ["k", None, "", "", "longer-string", "", "m", "", "", "n", "", ""]
+    t = Table([
+        Column.from_pylist(list(range(len(a))), dt.INT32),
+        Column.from_pylist(a, dt.STRING),
+        Column.from_pylist(b, dt.STRING),
+    ])
+    rows = rc.convert_to_rows(t)
+    back = rc.convert_from_rows(rows[0], t.dtypes())
+    assert back.columns[1].to_pylist() == a
+    assert back.columns[2].to_pylist() == b
