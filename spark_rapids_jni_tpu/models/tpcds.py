@@ -207,12 +207,20 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
         ws1.select(["ws_ext_ship_cost", "ws_net_profit"]),
         [("ws_ext_ship_cost", "sum"), ("ws_net_profit", "sum")],
     )
-    ship = bitutils.float_view(per.column("ws_ext_ship_cost_sum").data, dt.FLOAT64)
-    prof = bitutils.float_view(per.column("ws_net_profit_sum").data, dt.FLOAT64)
+    # exact grand totals: one-segment windowed accumulation over the
+    # per-order sum bits (jnp.sum on a float_view would re-round through
+    # f32 on TPU)
+    from ..ops.f64acc import segment_sum_f64bits
+
+    def _total(col):
+        bits = per.column(col).data
+        seg = jnp.zeros((bits.shape[0],), jnp.int32)
+        return float(np.asarray(segment_sum_f64bits(bits, seg, 1)).view(np.float64)[0])
+
     return {
         "order_count": int(per.num_rows),
-        "total_shipping_cost": float(np.asarray(jnp.sum(ship))),
-        "total_net_profit": float(np.asarray(jnp.sum(prof))),
+        "total_shipping_cost": _total("ws_ext_ship_cost_sum"),
+        "total_net_profit": _total("ws_net_profit_sum"),
     }
 
 
@@ -259,10 +267,18 @@ def q95_distributed(tables: Dict[str, Table], mesh, ship_lo: int = 400, ship_hi:
     )
     if o3:
         raise RuntimeError("groupby capacity overflow — raise group_capacity")
-    ship = bitutils.float_view(per.column("ws_ext_ship_cost_sum").data, dt.FLOAT64)
-    prof = bitutils.float_view(per.column("ws_net_profit_sum").data, dt.FLOAT64)
+    # exact grand totals: one-segment windowed accumulation over the
+    # per-order sum bits (jnp.sum on a float_view would re-round through
+    # f32 on TPU)
+    from ..ops.f64acc import segment_sum_f64bits
+
+    def _total(col):
+        bits = per.column(col).data
+        seg = jnp.zeros((bits.shape[0],), jnp.int32)
+        return float(np.asarray(segment_sum_f64bits(bits, seg, 1)).view(np.float64)[0])
+
     return {
         "order_count": int(per.num_rows),
-        "total_shipping_cost": float(np.asarray(jnp.sum(ship))),
-        "total_net_profit": float(np.asarray(jnp.sum(prof))),
+        "total_shipping_cost": _total("ws_ext_ship_cost_sum"),
+        "total_net_profit": _total("ws_net_profit_sum"),
     }

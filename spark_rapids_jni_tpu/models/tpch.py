@@ -127,6 +127,6 @@ def q6(lineitem: Table) -> float:
     out = groupby_aggregate(key, ones, [("revenue", "sum")])
     if out.num_rows == 0:
         return 0.0
-    from ..ops import bitutils
-
-    return float(np.asarray(bitutils.float_view(out.column("revenue_sum").data, dt.FLOAT64))[0])
+    # host bit-view: the exact f64 sum reads back losslessly (float_view
+    # would round through f32 on TPU at this final scalar pull)
+    return float(np.asarray(out.column("revenue_sum").data).view(np.float64)[0])

@@ -12,9 +12,12 @@ the canonical accelerator formulation. Pipeline:
 4. group keys gathered from each segment's first row.
 
 Supported aggs: sum, count (valid), count_all, min, max, mean.
-FLOAT64 reduces via bitutils.float_view (exact f64 on CPU backends, f32
-on TPU — documented platform approximation); min/max on floats use the
-exact total-order transform, so they are exact everywhere.
+FLOAT64 SUM/MEAN are EXACT on every backend — including TPU, which has
+no f64 datapath — via the windowed integer accumulator in ops/f64acc
+(correctly rounded f64 of the exact real sum; bit-identical CPU vs TPU).
+min/max on floats use the exact total-order transform, so they are exact
+everywhere too. FLOAT32 sums accumulate in f32 (documented; Spark
+promotes float sums to double before they reach this tier).
 """
 
 from __future__ import annotations
@@ -37,7 +40,7 @@ __all__ = ["groupby_aggregate", "groupby_sum_bounded"]
 
 
 def groupby_sum_bounded(
-    keys: jnp.ndarray, vals: jnp.ndarray, num_keys: int
+    keys: jnp.ndarray, vals: jnp.ndarray, num_keys: int, f64_bits: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """GROUP BY SUM for a BOUNDED integer key domain [0, num_keys):
     one scatter-add pass, no sort — the hash-aggregate hot path for
@@ -48,9 +51,28 @@ def groupby_sum_bounded(
 
     O(N) and HBM-bandwidth-bound on TPU, where the general path pays an
     O(N log^2 N) sort.
+
+    ``vals`` contract: float32 sums in f32 (MXU kernel on TPU);
+    integers (uint64 included) sum exactly in int64. Pass
+    ``f64_bits=True`` when ``vals`` is FLOAT64 IEEE-bit storage (the
+    columnar FLOAT64 format, ops/bitutils): returns EXACT f64 sums as
+    uint64 bits via the ops/f64acc windowed accumulator. An explicit
+    flag, not dtype punning — a real UINT64 integer column must keep
+    integer semantics.
     """
+    if f64_bits:  # FLOAT64 bits: exact integer-limb path
+        if vals.dtype != jnp.uint64:
+            raise ValueError("f64_bits vals must be uint64 IEEE-bit storage")
+        from .f64acc import segment_sum_f64bits
+
+        seg = jnp.where((keys >= 0) & (keys < num_keys), keys, num_keys).astype(jnp.int32)
+        sums = segment_sum_f64bits(vals, seg, num_keys + 1)[:num_keys]
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(seg, jnp.int64), seg, num_segments=num_keys + 1
+        )[:num_keys]
+        return sums, counts
     if (
-        vals.dtype == jnp.float32  # f64 sums must keep exact f64 segment_sum
+        vals.dtype == jnp.float32
         and num_keys <= 65536
         and keys.shape[0] < (1 << 24)  # counts ride an f32 accumulator:
         # exact only while every per-key count stays below 2^24
@@ -137,19 +159,32 @@ def _agg_column(col: Column, order, seg, num, how: str) -> Column:
         return Column(d, data=data, validity=any_valid)
 
     if how in ("sum", "mean"):
-        if d.is_floating:
-            vals = bitutils.float_view(col.data, d)[order]
+        if d.id == TypeId.FLOAT64:
+            # exact on all backends: windowed integer accumulation over
+            # the stored IEEE bits (ops/f64acc) — correctly rounded f64,
+            # bit-identical CPU vs TPU; matches the reference's real-f64
+            # device reduction semantics (cudf segment reduce, SURVEY §2.8)
+            from . import f64acc
+
+            bits = col.data[order]
+            if how == "sum":
+                out_bits = f64acc.segment_sum_f64bits(bits, seg, num, valid=sorted_valid)
+            else:
+                out_bits, _ = f64acc.segment_mean_f64bits(bits, seg, num, valid=sorted_valid)
+            return Column(dt.FLOAT64, data=out_bits, validity=any_valid)
+        if d.is_floating:  # FLOAT32
+            vals = col.data[order]
             vals = jnp.where(sorted_valid, vals, 0)
             s = jax.ops.segment_sum(vals, seg, num)
             if how == "mean":
                 cnt = jax.ops.segment_sum(sorted_valid.astype(vals.dtype), seg, num)
                 s = s / jnp.maximum(cnt, 1)
-                out_d = dt.FLOAT64
-            else:
-                out_d = dt.FLOAT64 if d.id == TypeId.FLOAT64 else dt.FLOAT32
-                if d.id == TypeId.FLOAT32:
-                    return Column(out_d, data=s.astype(jnp.float32), validity=any_valid)
-            return Column(dt.FLOAT64, data=bitutils.float_store(s, dt.FLOAT64), validity=any_valid)
+                return Column(
+                    dt.FLOAT64,
+                    data=bitutils.float_store(s, dt.FLOAT64),
+                    validity=any_valid,
+                )
+            return Column(dt.FLOAT32, data=s.astype(jnp.float32), validity=any_valid)
         if d.id == TypeId.DECIMAL128:
             # limb-wise int64 partial sums + carry renormalize: summing
             # two's-complement limbs mod 2^128 is exact signed addition

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.hashing import murmur3_raw
 from .shuffle import _bucketize
+from ._smcache import cached_sm
 
 __all__ = ["shard_groupby_sum", "distributed_groupby_sum", "distributed_groupby_sum_multi"]
 
@@ -103,11 +104,14 @@ def distributed_groupby_sum(
         )
         return gk[None], gs[None], gv[None], (ovf1 | ovf2)[None]
 
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    f = cached_sm(
+        ("gb_sum", mesh, axis, int(capacity), cap_g),
+        lambda: jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+        )),
     )
     gk, gs, gv, ovf = f(keys, vals)
 
@@ -182,11 +186,14 @@ def distributed_groupby_sum_multi(
         out = tuple(gk[None] for gk in gks) + (gs[None], gv[None], (ovf1 | ovf2)[None])
         return out
 
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis),) * (nk + 1),
-        out_specs=(P(axis),) * (nk + 3),
+    f = cached_sm(
+        ("gb_sum_multi", mesh, axis, int(capacity), cap_g, nk),
+        lambda: jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis),) * (nk + 1),
+            out_specs=(P(axis),) * (nk + 3),
+        )),
     )
     outs = f(vals, *key_arrays)
     gks, gs, gv, ovf = outs[:nk], outs[nk], outs[nk + 1], outs[nk + 2]

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.dispatch import op_boundary
 from .distributed import _hash_dest
 from .shuffle import _bucketize
+from ._smcache import cached_sm
 
 __all__ = ["shard_join_pairs", "distributed_inner_join"]
 
@@ -130,11 +131,14 @@ def distributed_inner_join(
         ovf = (o1 | o2 | o3)[None]
         return out_k[None], out_lv[None], out_rv[None], pv[None], ovf
 
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+    f = cached_sm(
+        ("join_pairs", mesh, axis, int(capacity), cap_out),
+        lambda: jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        )),
     )
     k, lv, rv, pv, ovf = f(left_key, left_val, right_key, right_val)
     k_h = np.asarray(k).reshape(-1)

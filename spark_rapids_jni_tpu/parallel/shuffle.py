@@ -31,6 +31,7 @@ from ..columnar.dtype import TypeId
 from ..ops.hashing import hash_partition_map
 from ..ops.copying import gather
 from ..utils.dispatch import op_boundary
+from ._smcache import cached_sm
 
 __all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
 
@@ -116,7 +117,11 @@ def all_to_all_exchange(
     spec = P(axis)
     in_specs = (spec,) + tuple(spec for _ in arrays)
     out_specs = tuple(spec for _ in arrays) + (spec, spec)
-    f = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    f = cached_sm(
+        ("a2a_exchange", mesh, axis, int(capacity), len(arrays),
+         tuple(str(a.dtype) for a in arrays)),
+        lambda: jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)),
+    )
     *received, recv_mask, overflow = f(dest, *arrays)
     return received, recv_mask, overflow
 

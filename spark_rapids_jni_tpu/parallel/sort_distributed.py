@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..utils.dispatch import op_boundary
 from .shuffle import _bucketize
+from ._smcache import cached_sm
 
 __all__ = ["distributed_sort"]
 
@@ -78,8 +79,11 @@ def distributed_sort(
         order = jnp.lexsort((kf, ~mf))
         return kf[order][None], mf[order][None], ovf[None]
 
-    f = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axis),), out_specs=(P(axis), P(axis), P(axis))
+    f = cached_sm(
+        ("sample_sort", mesh, axis, int(capacity), int(samples_per)),
+        lambda: jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P(axis),), out_specs=(P(axis), P(axis), P(axis))
+        )),
     )
     vals, mask, ovf = f(keys)
 

@@ -27,8 +27,10 @@ Design:
   with a validity lane joined into the key tuple); aggregates skip null
   values; joins never match null keys.
 
-FLOAT64 columns aggregate through ``bitutils.float_view`` (exact f64 on
-CPU tier; documented f32 approximation on TPU v5e's datapath).
+FLOAT64 columns aggregate EXACTLY on every backend: the u64 IEEE-bit
+lanes ride the exchange untouched and the shard aggregator runs the
+windowed integer accumulator (ops/f64acc) — distributed SUM/MEAN/
+MIN/MAX on doubles are bit-identical to the single-chip exact path.
 """
 
 from __future__ import annotations
@@ -50,6 +52,7 @@ from ..utils.dispatch import op_boundary
 from .distributed import _hash_dest_multi
 from .join_distributed import shard_join_pairs
 from .shuffle import _bucketize
+from ._smcache import cached_sm
 
 __all__ = [
     "dict_encode",
@@ -256,11 +259,15 @@ def exchange_table(
         return tuple(outs[1:]) + (rm, ovf[None])
 
     spec = P(axis)
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec,) * (1 + len(lanes)),
-        out_specs=(spec,) * (len(lanes) + 2),
+    f = cached_sm(
+        ("exchange_table", mesh, axis, int(capacity), len(lanes),
+         tuple(str(a.dtype) for a in lanes)),
+        lambda: jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec,) * (1 + len(lanes)),
+            out_specs=(spec,) * (len(lanes) + 2),
+        )),
     )
     *received, recv_mask, ovf = f(present, *lanes)
 
@@ -283,13 +290,16 @@ def exchange_table(
 _AGG_HOWS = ("sum", "count", "min", "max", "mean")
 
 
-def _float_lane(col: Column) -> jnp.ndarray:
-    if col.dtype.id == TypeId.FLOAT64:
-        return bitutils.float_view(col.data, col.dtype)
+def _value_lane(col: Column) -> jnp.ndarray:
+    """Aggregate-value lane. FLOAT64 stays in its u64 IEEE-bit storage —
+    the shard aggregator runs the EXACT windowed integer accumulator on
+    it (ops/f64acc), so distributed sums/means/extrema are bit-identical
+    to the single-chip exact path (no f32 hop; VERDICT r3 item 5)."""
     return col.data
 
 
-def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capacity: int):
+def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capacity: int,
+                        f64_flags=None):
     """Static-shape multi-aggregate groupby (shard-local). Returns
     (key_arrays[capacity], agg_arrays, agg_valid_arrays, group_valid,
     overflow). An aggregate over a group whose values are ALL null is
@@ -308,34 +318,69 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
     overflow = num_groups > capacity
     seg = jnp.where(ps, jnp.clip(seg, 0, capacity - 1), capacity)
 
+    if f64_flags is None:
+        f64_flags = [False] * len(val_arrays)
     aggs = []
     agg_valid = []
-    for v, how, vp in zip(val_arrays, hows, val_present):
+    for v, how, vp, is_f64bits in zip(val_arrays, hows, val_present, f64_flags):
+        # is_f64bits comes from the COLUMN dtype (FLOAT64 IEEE-bit lane)
+        # — never inferred from the jnp dtype, which a genuine UINT64
+        # integer column shares
         vs = v[order]
         vps = (ps & vp[order]) if vp is not None else ps
         cnt = jax.ops.segment_sum(vps.astype(jnp.int64), seg, num_segments=capacity + 1)[:capacity]
         if how in ("sum", "mean"):
-            x = jnp.where(vps, vs, 0)
-            if jnp.issubdtype(x.dtype, jnp.integer):
-                x = x.astype(jnp.int64)
-            s = jax.ops.segment_sum(x, seg, num_segments=capacity + 1)[:capacity]
-            if how == "sum":
+            if is_f64bits:
+                from ..ops.f64acc import segment_mean_f64bits, segment_sum_f64bits
+
+                if how == "sum":
+                    s = segment_sum_f64bits(vs, seg, capacity + 1, valid=vps)[:capacity]
+                else:
+                    s, _c = segment_mean_f64bits(vs, seg, capacity + 1, valid=vps)
+                    s = s[:capacity]
                 aggs.append(s)
             else:
-                fdt = jnp.float64 if bitutils.backend_has_f64() else jnp.float32
-                aggs.append(s.astype(fdt) / jnp.maximum(cnt, 1).astype(fdt))
+                x = jnp.where(vps, vs, 0)
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    x = x.astype(jnp.int64)
+                s = jax.ops.segment_sum(x, seg, num_segments=capacity + 1)[:capacity]
+                if how == "sum":
+                    aggs.append(s)
+                elif jnp.issubdtype(vs.dtype, jnp.integer):
+                    # exact int mean: limb-divide the exact int64 sum
+                    from ..ops.f64acc import mean_i64_div
+
+                    aggs.append(mean_i64_div(s, cnt))
+                else:
+                    aggs.append(s / jnp.maximum(cnt, 1).astype(s.dtype))
             agg_valid.append(cnt > 0)
         elif how == "count":
             aggs.append(cnt)
             agg_valid.append(jnp.ones((capacity,), bool))
         elif how in ("min", "max"):
-            if jnp.issubdtype(vs.dtype, jnp.integer):
-                fill = jnp.iinfo(vs.dtype).max if how == "min" else jnp.iinfo(vs.dtype).min
+            if is_f64bits:
+                # exact total-order comparison on the stored bits
+                from jax import lax as _lax
+
+                from ..ops import bitutils as _bt
+                from ..ops.aggregate import _from_total_order
+                from ..columnar import dtype as _dt
+
+                key = _bt.total_order_key(vs, _dt.FLOAT64)
+                k = _lax.bitcast_convert_type(key ^ jnp.uint64(1 << 63), jnp.int64)
+                fill = jnp.iinfo(jnp.int64).max if how == "min" else jnp.iinfo(jnp.int64).min
+                f = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+                r = f(jnp.where(vps, k, fill), seg, num_segments=capacity + 1)[:capacity]
+                key_back = _lax.bitcast_convert_type(r, jnp.uint64) ^ jnp.uint64(1 << 63)
+                aggs.append(_from_total_order(key_back, _dt.FLOAT64))
             else:
-                fill = jnp.inf if how == "min" else -jnp.inf
-            x = jnp.where(vps, vs, fill)
-            f = jax.ops.segment_min if how == "min" else jax.ops.segment_max
-            aggs.append(f(x, seg, num_segments=capacity + 1)[:capacity])
+                if jnp.issubdtype(vs.dtype, jnp.integer):
+                    fill = jnp.iinfo(vs.dtype).max if how == "min" else jnp.iinfo(vs.dtype).min
+                else:
+                    fill = jnp.inf if how == "min" else -jnp.inf
+                x = jnp.where(vps, vs, fill)
+                f = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+                aggs.append(f(x, seg, num_segments=capacity + 1)[:capacity])
             agg_valid.append(cnt > 0)
         else:
             raise ValueError(f"unknown agg {how!r} (supported: {_AGG_HOWS})")
@@ -467,15 +512,20 @@ def _groupby_split_retry(
         if how == "mean":
             s = merged.column(f"{oname}__s_sum")
             c = merged.column(f"{oname}__c_sum")
-            sf = bitutils.float_view(s.data, s.dtype) if s.dtype.id == TypeId.FLOAT64 else s.data
-            m = sf / jnp.maximum(c.data.astype(sf.dtype), 1)
             valid = c.data > 0
             if s.validity is not None:
                 valid = valid & s.validity
-            out_cols.append(
-                Column(dt.FLOAT64, data=bitutils.float_store(m.astype(jnp.float64), dt.FLOAT64),
-                       validity=valid)
-            )
+            if s.dtype.id == TypeId.FLOAT64:
+                # exact recombination: merged partial-sum bits / count
+                from ..ops.f64acc import div_f64bits_by_int
+
+                mbits = div_f64bits_by_int(s.data, jnp.maximum(c.data, 1))
+                out_cols.append(Column(dt.FLOAT64, data=mbits, validity=valid))
+            else:
+                from ..ops.f64acc import mean_i64_div
+
+                mbits = mean_i64_div(s.data.astype(jnp.int64), jnp.maximum(c.data, 1))
+                out_cols.append(Column(dt.FLOAT64, data=mbits, validity=valid))
         else:
             mcol = merged.column(f"{oname}_{_MERGE_HOW[how]}")
             out_cols.append(mcol)
@@ -514,14 +564,16 @@ def _groupby_once(
     val_lanes: List[jnp.ndarray] = []
     val_valid: List[Optional[jnp.ndarray]] = []
     hows: List[str] = []
+    f64_flags: List[bool] = []
     out_meta: List[Tuple[str, str]] = []
     for vname, how, oname in aggs:
         col = table.column(vname)
         if col.dtype.id == TypeId.STRING:
             raise ValueError("aggregating STRING columns is not supported")
-        val_lanes.append(_float_lane(col))
+        val_lanes.append(_value_lane(col))
         val_valid.append(col.validity)
         hows.append(how)
+        f64_flags.append(col.dtype.id == TypeId.FLOAT64)
         out_meta.append((oname, how))
     n_keys = len(key_lanes)
     n_vals = len(val_lanes)
@@ -567,7 +619,9 @@ def _groupby_once(
                 j += 1
             else:
                 vp_full.append(None)
-        gks, gas, gavs, gv, ovf2 = _shard_groupby_aggs(kr, vr, hows, mr, vp_full, cap_g)
+        gks, gas, gavs, gv, ovf2 = _shard_groupby_aggs(
+            kr, vr, hows, mr, vp_full, cap_g, f64_flags=f64_flags
+        )
         return (
             tuple(gk[None] for gk in gks)
             + tuple(a[None] for a in gas)
@@ -576,11 +630,16 @@ def _groupby_once(
         )
 
     spec = P(axis)
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec,) * (n_keys + 1 + n_vals + len(valid_lanes)),
-        out_specs=(spec,) * (n_keys + 2 * n_vals + 2),
+    f = cached_sm(
+        ("gb_table", mesh, axis, int(capacity), cap_g, n_keys, n_vals,
+         tuple(hows), tuple(f64_flags), tuple(v is not None for v in val_valid),
+         tuple(str(a.dtype) for a in key_lanes + val_lanes)),
+        lambda: jax.jit(jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec,) * (n_keys + 1 + n_vals + len(valid_lanes)),
+            out_specs=(spec,) * (n_keys + 2 * n_vals + 2),
+        )),
     )
     outs = f(*key_lanes, present, *val_lanes, *valid_lanes)
     gks = outs[:n_keys]
@@ -612,8 +671,15 @@ def _groupby_once(
         av_np = gav_h.reshape(-1)[sel_np]
         validity = None if av_np.all() else jnp.asarray(av_np)
         src = table.column(vname)
-        if how in ("sum", "min", "max") and src.dtype.id == TypeId.FLOAT64:
-            cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
+        src_is_f64 = src.dtype.id == TypeId.FLOAT64
+        # exact paths return ready-made FLOAT64 IEEE bits: every agg of
+        # a FLOAT64 column, and the exact integer mean (mean_i64_div) —
+        # keyed off the COLUMN dtype, never the lane dtype (a genuine
+        # UINT64 min/max result is an integer that happens to be u64)
+        if (src_is_f64 and how in ("sum", "mean", "min", "max")) or (
+            how == "mean" and jnp.issubdtype(src.data.dtype, jnp.integer)
+        ):
+            cols.append(Column(dt.FLOAT64, data=arr, validity=validity))
         elif how == "mean":
             cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
         elif how == "count":
@@ -834,8 +900,13 @@ def _join_once(
     in_lanes = [l_present, r_present] + l_lanes + r_lanes
     n_out = (nl_lanes + nr_lanes if how == "inner" else nl_lanes) + 3
     spec = P(axis)
-    f = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec,) * len(in_lanes), out_specs=(spec,) * n_out
+    f = cached_sm(
+        ("join_table", mesh, axis, int(capacity), cap_out, how,
+         tuple(l_kpos), tuple(r_kpos), nl_lanes, nr_lanes,
+         tuple(str(a.dtype) for a in in_lanes)),
+        lambda: jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,) * len(in_lanes), out_specs=(spec,) * n_out
+        )),
     )
     outs = f(*in_lanes)
     ovf = bool(np.asarray(outs[-1]).any())

@@ -120,14 +120,6 @@ class PlanSpec:
                 raise ValueError(f"unknown aggregate {a.how!r}")
 
 
-def _as_float(col: Column) -> jnp.ndarray:
-    """Column values as a float lane for arithmetic aggs (f64 columns
-    store an integer bit pattern; see ops.bitutils)."""
-    if col.dtype.id == dt.TypeId.FLOAT64:
-        return bitutils.float_view(col.data, dt.FLOAT64)
-    if col.dtype.id == dt.TypeId.FLOAT32:
-        return col.data
-    return col.data.astype(jnp.float64)
 
 
 class CompiledPipeline:
@@ -279,60 +271,103 @@ class CompiledPipeline:
 
 
 def _global_agg(col: Column, v, how: str):
-    ones = jnp.ones((len(col),), jnp.int64)
-    m = ones.astype(bool) if v is None else v
-    if how == "count_all":
-        return jnp.sum(jnp.where(m, ones, 0)), None
-    if how == "count":
-        return jnp.sum(jnp.where(m, ones, 0)), None
-    x = _as_float(col)
-    xm = jnp.where(m, x, 0.0)
-    if how == "sum":
-        return jnp.sum(xm), jnp.any(m)
-    if how == "mean":
-        n = jnp.maximum(jnp.sum(m.astype(jnp.float64)), 1.0)
-        return jnp.sum(xm) / n, jnp.any(m)
-    if how == "min":
-        return jnp.min(jnp.where(m, x, jnp.inf)), jnp.any(m)
-    return jnp.max(jnp.where(m, x, -jnp.inf)), jnp.any(m)
+    """Global (one-group) aggregate: delegates to the grouped kernels
+    with a single segment so every exactness path is shared."""
+    n = len(col)
+    gid = jnp.zeros((n,), jnp.int32)
+    m = jnp.ones((n,), bool) if v is None else v
+    counts = jnp.sum(m.astype(jnp.int64))[None]
+    data, valid = _grouped_agg(col, v, gid, 1, how, counts)
+    return data[0], None if valid is None else valid[0]
 
 
 def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
     """Dense [num] aggregate + optional [num] validity, rows with
-    gid==num dropped."""
+    gid==num dropped.
+
+    Exactness contract (VERDICT r3 item 5): FLOAT64 SUM/MEAN ride the
+    windowed integer accumulator (ops/f64acc — correctly rounded f64,
+    bit-identical CPU vs TPU); integer SUM accumulates in exact int64
+    (MEAN divides the exact sum via the limb divider); FLOAT64 and
+    integer MIN/MAX compare in the exact total-order / integer domain,
+    never through a lossy f32 view. Exact FLOAT64 results return as
+    uint64 IEEE bits (detected downstream by _wrap_result). FLOAT32
+    keeps the f32 MXU kernel."""
     n = len(col)
     m = jnp.ones((n,), bool) if v is None else v
     gid_v = jnp.where(m, gid, num)  # null values drop from value aggs
     if how == "count_all":
         return counts_all, None
     if how == "count":
-        # ride the bounded-domain kernel (counts come from key routing;
-        # exactness guards live inside groupby_sum_bounded — the MXU
-        # path only engages while per-key counts stay f32-exact)
+        # exact int64 count via key routing
+        c = jax.ops.segment_sum(m.astype(jnp.int64), gid_v, num_segments=num + 1)[:num]
+        return c, None
+    d = col.dtype
+    if how in ("sum", "mean"):
+        if d.id == dt.TypeId.FLOAT64:
+            from .ops.f64acc import segment_mean_f64bits, segment_sum_f64bits
+
+            if how == "sum":
+                s = segment_sum_f64bits(col.data, gid_v, num + 1)[:num]
+                c = jax.ops.segment_sum(
+                    m.astype(jnp.int64), gid_v, num_segments=num + 1
+                )[:num]
+                return s, c > 0
+            mb, c = segment_mean_f64bits(col.data, gid_v, num + 1)
+            return mb[:num], c[:num] > 0
+        if not d.is_floating:
+            # integers: exact int64 accumulation (Spark sum(int)->long);
+            # results materialize into FLOAT64 bits without an f32 hop
+            from .ops.f64acc import i64_to_f64bits, mean_i64_div
+
+            vals = col.data.astype(jnp.int64)
+            s = jax.ops.segment_sum(
+                jnp.where(m, vals, 0), gid_v, num_segments=num + 1
+            )[:num]
+            c = jax.ops.segment_sum(m.astype(jnp.int64), gid_v, num_segments=num + 1)[:num]
+            if how == "sum":
+                return i64_to_f64bits(s), c > 0
+            return mean_i64_div(s, c), c > 0
+        # FLOAT32: one fused kernel for (sums, per-group valid counts) —
+        # segment_sum lowers to the slow XLA scatter class on TPU; the
+        # MXU outer-product kernel in groupby_sum_bounded is ~17x faster
+        # at the 1M x 4096 axis and falls back to segment_sum off-TPU
         from .ops.aggregate import groupby_sum_bounded
 
-        _, c = groupby_sum_bounded(gid_v, jnp.ones((n,), jnp.float32), num)
-        return c.astype(jnp.int64), None
-    x = _as_float(col)
-    if how == "sum":
-        # one fused kernel for (sums, per-group valid counts) instead
-        # of two scatter-add passes: segment_sum lowers to the slow
-        # XLA scatter class on TPU; the MXU outer-product kernel in
-        # groupby_sum_bounded is ~17x faster at the 1M x 4096 axis and
-        # falls back to exact segment_sum off-TPU / for f64
-        from .ops.aggregate import groupby_sum_bounded
-
-        s, c = groupby_sum_bounded(gid_v, x, num)
-        return s, c > 0
-    if how == "mean":
-        from .ops.aggregate import groupby_sum_bounded
-
-        s, c = groupby_sum_bounded(gid_v, x, num)
-        cf = c.astype(x.dtype)
+        s, c = groupby_sum_bounded(gid_v, col.data, num)
+        if how == "sum":
+            return s, c > 0
+        cf = c.astype(s.dtype)
         return s / jnp.maximum(cf, 1.0), c > 0
     # min/max validity comes from the per-group valid-row COUNT, never
     # from isfinite(result): a genuine +/-inf value must survive
     has_vals = jax.ops.segment_sum(m.astype(jnp.int32), gid_v, num_segments=num + 1)[:num] > 0
+    lo_i, hi_i = jnp.iinfo(jnp.int64).min, jnp.iinfo(jnp.int64).max
+    if d.id == dt.TypeId.FLOAT64:
+        # exact total-order comparison on the stored bits; the u64 key
+        # views as order-preserving int64 so segment_min/max stay on the
+        # well-trodden s64 path
+        from jax import lax
+
+        from .ops import bitutils as _bt
+        from .ops.aggregate import _from_total_order
+
+        key = _bt.total_order_key(col.data, dt.FLOAT64)
+        k = lax.bitcast_convert_type(key ^ jnp.uint64(1 << 63), jnp.int64)
+        fill = hi_i if how == "min" else lo_i
+        red = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+        r = red(jnp.where(m, k, fill), gid_v, num_segments=num + 1)[:num]
+        key_back = lax.bitcast_convert_type(r, jnp.uint64) ^ jnp.uint64(1 << 63)
+        return _from_total_order(key_back, dt.FLOAT64), has_vals
+    if not d.is_floating:
+        vals = col.data.astype(jnp.int64)
+        from .ops.f64acc import i64_to_f64bits
+
+        fill = hi_i if how == "min" else lo_i
+        red = jax.ops.segment_min if how == "min" else jax.ops.segment_max
+        r = red(jnp.where(m, vals, fill), gid_v, num_segments=num + 1)[:num]
+        return i64_to_f64bits(jnp.where(has_vals, r, 0)), has_vals
+    x = col.data
     if how == "min":
         s = jax.ops.segment_min(jnp.where(m, x, jnp.inf), gid_v, num_segments=num + 1)[:num]
         return s, has_vals
@@ -400,7 +435,10 @@ def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
 def _wrap_result(data, valid, how: str) -> Column:
     if how in ("count", "count_all"):
         return Column(dt.INT64, data=data.astype(jnp.int64), validity=valid)
-    # float aggregates come back as f64 lanes; store in the column format
+    if data.dtype == jnp.uint64:
+        # exact paths return ready-made FLOAT64 IEEE bits
+        return Column(dt.FLOAT64, data=data, validity=valid)
+    # f32-lane aggregates store into the FLOAT64 bit format
     return Column(dt.FLOAT64, data=bitutils.float_store(data.astype(jnp.float64), dt.FLOAT64), validity=valid)
 
 
