@@ -173,3 +173,79 @@ def test_padded_vs_scatter_encode_parity(rng):
     )
     slow = np.asarray(rc._to_rows_strings(layout, cols, offsets[:-1], total))
     np.testing.assert_array_equal(fast, slow)
+
+
+# ---------------------------------------------------------------------------
+# ragged_compact: the word-granular decode compaction (round 4)
+# ---------------------------------------------------------------------------
+
+from spark_rapids_jni_tpu.ops.ragged_bytes import flat_u8_to_u32, ragged_compact
+
+
+class TestRaggedCompact:
+    def _oracle(self, pool, base, lens):
+        out = [pool[b : b + ln] for b, ln in zip(base, lens)]
+        return np.concatenate(out) if out else np.zeros((0,), np.uint8)
+
+    def _run(self, pool, base, lens):
+        offs = np.zeros(len(base) + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        got = ragged_compact(
+            jnp.asarray(pool), jnp.asarray(base, jnp.int64), jnp.asarray(offs), int(offs[-1])
+        )
+        want = self._oracle(pool, np.asarray(base), np.asarray(lens))
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_simple(self):
+        pool = np.arange(64, dtype=np.uint8)
+        self._run(pool, [0, 10, 30], [5, 8, 20])
+
+    def test_zero_length_rows(self):
+        pool = np.arange(64, dtype=np.uint8)
+        self._run(pool, [0, 3, 3, 3, 20], [3, 0, 0, 5, 9])
+
+    def test_all_zero(self):
+        pool = np.arange(16, dtype=np.uint8)
+        self._run(pool, [0, 4, 8], [0, 0, 0])
+
+    def test_tiny_rows_within_words(self):
+        # many 1-3 byte rows: multiple head chunks share output words
+        r = np.random.default_rng(3)
+        lens = r.integers(0, 4, 50)
+        base = np.cumsum(np.concatenate([[0], lens[:-1] + r.integers(0, 5, 49)]))
+        pool = r.integers(0, 256, int(base[-1]) + 16).astype(np.uint8)
+        self._run(pool, base, lens)
+
+    def test_word_straddles(self):
+        pool = np.arange(200, dtype=np.uint8)
+        self._run(pool, [1, 9, 33, 77], [7, 13, 21, 40])
+
+    def test_aligned_and_unaligned_mix(self):
+        r = np.random.default_rng(11)
+        for _trial in range(10):
+            n = int(r.integers(1, 80))
+            lens = r.integers(0, 40, n)
+            gaps = r.integers(0, 9, n)
+            base = np.cumsum(np.concatenate([[0], (lens + gaps)[:-1]]))
+            pool = r.integers(0, 256, int(base[-1] + lens[-1]) + 16).astype(np.uint8)
+            self._run(pool, base, lens)
+
+    def test_large_random(self):
+        r = np.random.default_rng(42)
+        n = 5000
+        lens = r.integers(0, 64, n)
+        gaps = r.integers(0, 16, n)
+        base = np.cumsum(np.concatenate([[0], (lens + gaps)[:-1]]))
+        pool = r.integers(0, 256, int(base[-1] + lens[-1]) + 16).astype(np.uint8)
+        self._run(pool, base, lens)
+
+    def test_single_giant_row(self):
+        r = np.random.default_rng(5)
+        pool = r.integers(0, 256, 100_000).astype(np.uint8)
+        self._run(pool, [17], [99_000])
+
+    def test_flat_u8_to_u32(self):
+        b = np.arange(32, dtype=np.uint8)
+        np.testing.assert_array_equal(
+            np.asarray(flat_u8_to_u32(jnp.asarray(b))), b.view(np.uint32)
+        )
