@@ -68,14 +68,23 @@ def to_padded(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def from_padded(padded: jnp.ndarray, lens: jnp.ndarray, validity=None) -> Column:
-    """[N, L] bytes + [N] lengths -> ragged STRING column (compaction)."""
-    from .bitutils import ragged_positions
+    """[N, L] bytes + [N] lengths -> ragged STRING column (compaction).
 
-    offs, row_of, pos, total = ragged_positions(lens)
+    Rides ragged_compact (word-granular funnel gathers, ~2 ns/B): the
+    padded matrix flattens to a pool whose per-row base r*L is monotone
+    — exactly the compaction contract. The old padded[row_of, pos] form
+    was one element gather per CHARACTER (~8 ns/B, the slow class)."""
+    from .ragged_bytes import ragged_compact
+
+    lens = lens.astype(jnp.int32)
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    total = int(offs[-1])  # host sync: chars allocation size
     if total == 0:
         chars = jnp.zeros((0,), jnp.uint8)
     else:
-        chars = padded[row_of, pos]
+        n, width = padded.shape
+        base = jnp.arange(n, dtype=jnp.int64) * width
+        chars = ragged_compact(padded.reshape(-1), base, offs.astype(jnp.int64), total)
     return Column(dt.STRING, validity=validity, offsets=offs, chars=chars)
 
 

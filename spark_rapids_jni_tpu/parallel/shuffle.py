@@ -10,9 +10,10 @@ Static-shape framing (XLA compiles one program, no data-dependent
 shapes): each shard scatters its rows into a [P, capacity] bucket
 matrix + occupancy mask, all_to_all swaps bucket axes, receivers get
 [P, capacity] from every peer. ``capacity`` bounds rows any shard may
-send to one destination; overflow is *detected* (per-shard flag, rows
-dropped deterministically) so callers size capacity like any shuffle
-buffer. Compaction back to dense rows happens host-side or in the
+send to one destination; overflow RAISES RetryableError by default
+(no silent-drop path — VERDICT r3 item 8), with ``on_overflow="flag"``
+as the opt-in contract for capacity-managing callers that recompute
+and retry. Compaction back to dense rows happens host-side or in the
 consuming kernel via the mask.
 """
 
@@ -85,6 +86,7 @@ def all_to_all_exchange(
     mesh: Mesh,
     axis: str = "data",
     capacity: Optional[int] = None,
+    on_overflow: str = "raise",
 ):
     """Exchange row-sharded arrays so row i lands on shard dest[i].
 
@@ -93,7 +95,17 @@ def all_to_all_exchange(
     recv_mask, overflow): received arrays are [P * capacity * ...] per
     shard, i.e. globally [N_shards, P, capacity, ...] flattened on the
     leading axis, with recv_mask marking occupied slots.
+
+    Overflow semantics (VERDICT r3 item 8): a caller-supplied capacity
+    that a skewed destination exceeds can NOT silently hand back
+    truncated data. ``on_overflow="raise"`` (default) raises
+    ``RetryableError`` — the Spark task-retry class; capacity-managing
+    callers (the Table tier recomputes and retries) opt into the
+    flag-only contract with ``on_overflow="flag"``. The defaulted
+    capacity (= rows per shard) cannot overflow.
     """
+    if on_overflow not in ("raise", "flag"):
+        raise ValueError(f"on_overflow must be 'raise' or 'flag', got {on_overflow!r}")
     n_parts = mesh.shape[axis]
     n_global = dest.shape[0]
     per_shard = n_global // n_parts
@@ -123,6 +135,14 @@ def all_to_all_exchange(
         lambda: jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)),
     )
     *received, recv_mask, overflow = f(dest, *arrays)
+    if on_overflow == "raise" and bool(np.asarray(overflow).any()):
+        from ..utils.errors import RetryableError
+
+        raise RetryableError(
+            f"all_to_all_exchange: a destination shard received more than "
+            f"capacity={capacity} rows; retry with a larger capacity "
+            f"(rows would otherwise be dropped)"
+        )
     return received, recv_mask, overflow
 
 
@@ -133,6 +153,7 @@ def exchange_by_key(
     mesh: Mesh,
     axis: str = "data",
     capacity: Optional[int] = None,
+    on_overflow: str = "raise",
 ):
     """Hash-repartition a row-sharded fixed-width Table over the mesh.
 
@@ -158,7 +179,7 @@ def exchange_by_key(
         if c.validity is not None:
             arrays.append(c.validity)
     received, recv_mask, overflow = all_to_all_exchange(
-        arrays, dest.astype(jnp.int32), mesh, axis, capacity
+        arrays, dest.astype(jnp.int32), mesh, axis, capacity, on_overflow=on_overflow
     )
     pairs = []
     it = iter(received)

@@ -80,15 +80,34 @@ def test_all_to_all_rows_land_on_dest_shard(mesh8, rng):
     assert got_per_shard == expect_per_shard
 
 
-def test_exchange_overflow_detected(mesh8):
+def test_exchange_overflow_raises_retryable(mesh8):
+    # VERDICT r3 item 8: a skewed destination exceeding a caller-chosen
+    # capacity must ESCALATE, never hand back silently truncated data
+    import pytest
+
+    from spark_rapids_jni_tpu.utils.errors import RetryableError
+
     n = 8 * 8
     vals = jnp.arange(n, dtype=jnp.int64)
     dest = jnp.zeros((n,), jnp.int32)  # everything to shard 0
     sh = mesh_mod.row_sharding(mesh8)
+    with pytest.raises(RetryableError):
+        shuffle.all_to_all_exchange(
+            [jax.device_put(vals, sh)], jax.device_put(dest, sh), mesh8, capacity=4
+        )
+    # capacity-managing callers opt into the flag contract explicitly
     (recv,), mask, overflow = shuffle.all_to_all_exchange(
-        [jax.device_put(vals, sh)], jax.device_put(dest, sh), mesh8, capacity=4
+        [jax.device_put(vals, sh)], jax.device_put(dest, sh), mesh8,
+        capacity=4, on_overflow="flag",
     )
     assert bool(np.asarray(overflow).any())
+    # retrying at the escalated capacity succeeds with every row intact
+    (recv,), mask, overflow = shuffle.all_to_all_exchange(
+        [jax.device_put(vals, sh)], jax.device_put(dest, sh), mesh8, capacity=n
+    )
+    assert not bool(np.asarray(overflow).any())
+    got = sorted(np.asarray(recv)[np.asarray(mask)].tolist())
+    assert got == list(range(n))
 
 
 def test_shard_groupby_sum_static():
