@@ -27,6 +27,19 @@ from ..ops.sort import sort_by_key
 __all__ = ["gen_store", "gen_web", "q3", "q95"]
 
 
+
+def _exact_total(col) -> float:
+    """Exact grand total of a FLOAT64-bit column: one-segment windowed
+    accumulation (jnp.sum on a float_view would re-round through f32 on
+    TPU) + lossless host bit-view readback."""
+    from ..ops.f64acc import segment_sum_f64bits
+
+    bits = col.data
+    if bits.shape[0] == 0:
+        return 0.0
+    seg = jnp.zeros((bits.shape[0],), jnp.int32)
+    return float(np.asarray(segment_sum_f64bits(bits, seg, 1)).view(np.float64)[0])
+
 def _int_col(arr: np.ndarray, d=dt.INT32) -> Column:
     return Column(d, data=jnp.asarray(arr.astype(np.dtype(jnp.dtype(d.jnp_dtype).name))))
 
@@ -207,20 +220,10 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
         ws1.select(["ws_ext_ship_cost", "ws_net_profit"]),
         [("ws_ext_ship_cost", "sum"), ("ws_net_profit", "sum")],
     )
-    # exact grand totals: one-segment windowed accumulation over the
-    # per-order sum bits (jnp.sum on a float_view would re-round through
-    # f32 on TPU)
-    from ..ops.f64acc import segment_sum_f64bits
-
-    def _total(col):
-        bits = per.column(col).data
-        seg = jnp.zeros((bits.shape[0],), jnp.int32)
-        return float(np.asarray(segment_sum_f64bits(bits, seg, 1)).view(np.float64)[0])
-
     return {
         "order_count": int(per.num_rows),
-        "total_shipping_cost": _total("ws_ext_ship_cost_sum"),
-        "total_net_profit": _total("ws_net_profit_sum"),
+        "total_shipping_cost": _exact_total(per.column("ws_ext_ship_cost_sum")),
+        "total_net_profit": _exact_total(per.column("ws_net_profit_sum")),
     }
 
 
@@ -267,18 +270,8 @@ def q95_distributed(tables: Dict[str, Table], mesh, ship_lo: int = 400, ship_hi:
     )
     if o3:
         raise RuntimeError("groupby capacity overflow — raise group_capacity")
-    # exact grand totals: one-segment windowed accumulation over the
-    # per-order sum bits (jnp.sum on a float_view would re-round through
-    # f32 on TPU)
-    from ..ops.f64acc import segment_sum_f64bits
-
-    def _total(col):
-        bits = per.column(col).data
-        seg = jnp.zeros((bits.shape[0],), jnp.int32)
-        return float(np.asarray(segment_sum_f64bits(bits, seg, 1)).view(np.float64)[0])
-
     return {
         "order_count": int(per.num_rows),
-        "total_shipping_cost": _total("ws_ext_ship_cost_sum"),
-        "total_net_profit": _total("ws_net_profit_sum"),
+        "total_shipping_cost": _exact_total(per.column("ws_ext_ship_cost_sum")),
+        "total_net_profit": _exact_total(per.column("ws_net_profit_sum")),
     }

@@ -53,12 +53,13 @@ def groupby_sum_bounded(
     O(N log^2 N) sort.
 
     ``vals`` contract: float32 sums in f32 (MXU kernel on TPU);
-    integers (uint64 included) sum exactly in int64. Pass
-    ``f64_bits=True`` when ``vals`` is FLOAT64 IEEE-bit storage (the
-    columnar FLOAT64 format, ops/bitutils): returns EXACT f64 sums as
-    uint64 bits via the ops/f64acc windowed accumulator. An explicit
-    flag, not dtype punning — a real UINT64 integer column must keep
-    integer semantics.
+    integers sum in two's-complement int64 (uint64 keeps its low 64
+    sum bits — wrap past 2^63 is the caller's to reinterpret, as in
+    cudf's u64 accumulator). Pass ``f64_bits=True`` when ``vals`` is
+    FLOAT64 IEEE-bit storage (the columnar FLOAT64 format,
+    ops/bitutils): returns EXACT f64 sums as uint64 bits via the
+    ops/f64acc windowed accumulator. An explicit flag, not dtype
+    punning — a real UINT64 integer column must keep integer semantics.
     """
     if f64_bits:  # FLOAT64 bits: exact integer-limb path
         if vals.dtype != jnp.uint64:

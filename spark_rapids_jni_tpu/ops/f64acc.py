@@ -406,13 +406,21 @@ def segment_mean_f64bits(
     return out, cnt
 
 
+def u64_to_f64bits(x: jnp.ndarray) -> jnp.ndarray:
+    """uint64 -> IEEE-754 double bits, nearest-even (exact < 2^53)."""
+    return _abs64_to_f64bits(x.astype(_U64), jnp.zeros(x.shape, bool))
+
+
 def i64_to_f64bits(x: jnp.ndarray) -> jnp.ndarray:
     """int64 -> IEEE-754 double bits, nearest-even (exact for |x| < 2^53).
 
     Integer-only, for materializing exact integer aggregates into
     FLOAT64 columns on the f64-less tier."""
     neg = x < 0
-    a = jnp.where(neg, -x, x).astype(_U64)
+    return _abs64_to_f64bits(jnp.where(neg, -x, x).astype(_U64), neg)
+
+
+def _abs64_to_f64bits(a: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
     msb = jnp.zeros(a.shape, _I32)
     v = a
     for shift in (32, 16, 8, 4, 2, 1):
@@ -439,14 +447,20 @@ def i64_to_f64bits(x: jnp.ndarray) -> jnp.ndarray:
     return bits
 
 
-def mean_i64_div(sums: jnp.ndarray, cnt: jnp.ndarray) -> jnp.ndarray:
+def mean_i64_div(sums: jnp.ndarray, cnt: jnp.ndarray, unsigned: bool = False) -> jnp.ndarray:
     """Exact f64 mean of integer aggregates: |sums| rides the window
     shifted up to the mantissa anchor (bit 108, via _element_limbs with
     shift 0), so the long division yields 108 FRACTIONAL quotient bits
     below the integer point before the shared nearest-even rounding.
-    E = 1075 makes window bit 108 weigh 2^0. [G] i64 / [G] i64 -> u64."""
-    neg = sums < 0
-    a = jnp.where(neg, -sums, sums).astype(_U64)
+    E = 1075 makes window bit 108 weigh 2^0. [G] i64 / [G] i64 -> u64.
+    ``unsigned=True`` reads ``sums`` as uint64 magnitudes (UINT64
+    aggregates whose two's-complement sum bits exceed 2^63)."""
+    if unsigned:
+        neg = jnp.zeros(sums.shape, bool)
+        a = sums.astype(_U64)
+    else:
+        neg = sums < 0
+        a = jnp.where(neg, -sums, sums).astype(_U64)
     e = jnp.full(sums.shape, 1075, _I32)
     mag = jnp.stack(_element_limbs(a, jnp.zeros_like(e)), axis=-1)
     q, rem = _limb_divide(mag, cnt)
@@ -559,15 +573,7 @@ class DD(NamedTuple):
         # integer part (hi int, lo < 0).
         o = dd_from_any(o)
         q = self / o
-        t_hi = jnp.trunc(q.hi)
-        t_lo = jnp.where(t_hi == q.hi, jnp.trunc(q.lo), jnp.float32(0))
-        # hi integral and lo negative with a fraction: value sits just
-        # below hi, so the truncation toward zero steps down (positive
-        # q) / up (negative q) by one
-        frac_lo = (t_hi == q.hi) & (q.lo != t_lo)
-        adj = jnp.where(frac_lo & (q.hi > 0) & (q.lo < 0), jnp.float32(-1), jnp.float32(0))
-        adj = adj + jnp.where(frac_lo & (q.hi < 0) & (q.lo > 0), jnp.float32(1), jnp.float32(0))
-        t = DD(t_hi, t_lo + adj)
+        t = q.trunc()
         r = self - t * o
         # one correction step absorbs the dd division's ulp-level error
         babs = DD(jnp.abs(o.hi), jnp.where(o.hi < 0, -o.lo, o.lo))
@@ -615,13 +621,31 @@ class DD(NamedTuple):
     def shape(self):
         return self.hi.shape
 
+    def trunc(self) -> "DD":
+        """Truncate the PAIR VALUE toward zero (not the halves
+        separately): when hi is already integral, a fractional lo of
+        the opposite sign pulls the value past the integer, so the
+        truncation steps hi by one."""
+        t_hi = jnp.trunc(self.hi)
+        t_lo = jnp.where(t_hi == self.hi, jnp.trunc(self.lo), jnp.float32(0))
+        frac_lo = (t_hi == self.hi) & (self.lo != t_lo)
+        adj = jnp.where(
+            frac_lo & (self.hi > 0) & (self.lo < 0), jnp.float32(-1), jnp.float32(0)
+        )
+        adj = adj + jnp.where(
+            frac_lo & (self.hi < 0) & (self.lo > 0), jnp.float32(1), jnp.float32(0)
+        )
+        return DD(t_hi, t_lo + adj)
+
     def astype(self, dtype):
         """Narrowing view for casts out of FLOAT64."""
-        v = self.hi.astype(dtype)
         if jnp.issubdtype(dtype, jnp.integer):
-            # split the integer part across both halves to keep 48-bit ints
-            return self.hi.astype(dtype) + self.lo.astype(dtype)
-        return v
+            # truncate the pair value first (per-half truncation casts
+            # 2.9999999999 to 3, not 2), then split across both halves
+            # to keep ~48-bit integers exact
+            t = self.trunc()
+            return t.hi.astype(dtype) + t.lo.astype(dtype)
+        return self.hi.astype(dtype)
 
 
 def dd_from_any(x) -> DD:

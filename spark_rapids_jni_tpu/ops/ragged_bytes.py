@@ -46,6 +46,7 @@ __all__ = [
     "pack_u8_planes",
     "u32_rows_to_u8_flat",
     "flat_u8_to_u32",
+    "build_pool32",
     "ragged_compact",
 ]
 
@@ -482,12 +483,24 @@ def padded_extract(pool: jnp.ndarray, starts: jnp.ndarray, max_len: int) -> jnp.
 def flat_u8_to_u32(buf: jnp.ndarray) -> jnp.ndarray:
     """[L] u8 (L % 4 == 0) -> [L/4] u32 little-endian words.
 
-    Routed through the u8 transpose + sublane-pack kernel on TPU: the
-    naive [L/4, 4]-view bitcast charges a 32x tile-padded temp (GBs at
-    blob scale). Elsewhere the view bitcast is free."""
+    TPU: the decode twin of u32_rows_to_u8_flat — transpose ->
+    sublane-pack kernel -> transpose, three streaming passes over a
+    free [R, 512] view. Both the naive [L/4, 4]-view bitcast AND a
+    [L/4, 4] transpose charge a 32x tile-padded temp (measured: a
+    1.3 GB blob tried to allocate 43 GB and OOMed the compile).
+    Elsewhere the view bitcast is free."""
     n4 = buf.shape[0] // 4
     if _use_pallas() and n4 >= 128:
-        return pack_u8_planes(buf.reshape(n4, 4).T)[0]
+        lanes = 512
+        rows = (buf.shape[0] + lanes - 1) // lanes
+        padded = (
+            jnp.zeros((rows * lanes,), jnp.uint8).at[: buf.shape[0]].set(buf)
+            if rows * lanes != buf.shape[0]
+            else buf
+        )
+        m = padded.reshape(rows, lanes).T  # [512, R]: byte b of row r
+        packed = pack_u8_planes(m)  # [128, R]: LE word j of row r
+        return packed.T.reshape(-1)[:n4]
     return lax.bitcast_convert_type(buf.reshape(n4, 4), jnp.uint32)
 
 
@@ -503,88 +516,132 @@ def _funnel_u64(pool64: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     return (g0 >> rb) | hi
 
 
+def build_pool32(pool: jnp.ndarray) -> jnp.ndarray:
+    """[L] u8 -> flat little-endian u32 word view, padded two words past
+    the end (the funnel's q+1 read). Build ONCE per pool and share
+    across every ragged_compact over it — the relayout walks the whole
+    pool (a GB-scale blob when decoding rows), and 16 string columns
+    rebuilding it dominated the first on-chip measurement."""
+    plen = int(pool.shape[0])
+    pwords = (plen + 4) // 4 + 2
+    pool_pad = jnp.zeros((pwords * 4,), jnp.uint8).at[:plen].set(pool)
+    return flat_u8_to_u32(pool_pad)
+
+
+def _funnel_u32(p32: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """u32 little-endian word containing pool bytes [s, s+4) for each
+    byte address s (p32 must extend one word past any s): two monotone
+    element gathers + a byte funnel shift. All flat 1-D — any 2-D view
+    with a tiny minor dim tile-pads 32-64x on TPU (measured 43 GB and
+    64 GB compile-time OOMs from [N,4]-u8 / [N,2]-u32 views)."""
+    q = (s >> 2).astype(jnp.int32)
+    g0 = p32[q]
+    g1 = p32[q + 1]
+    rb = ((s & 3) * 8).astype(jnp.uint32)
+    hi = jnp.where(
+        rb == 0, jnp.uint32(0), g1 << (jnp.uint32(32) - jnp.maximum(rb, jnp.uint32(1)))
+    )
+    return (g0 >> rb) | hi
+
+
 def ragged_compact(
-    pool: jnp.ndarray, base: jnp.ndarray, offs: jnp.ndarray, total: int
+    pool: jnp.ndarray,
+    base: jnp.ndarray,
+    offs: jnp.ndarray,
+    total: int,
+    pool32: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Dense ragged gather: out[offs[r] + j] = pool[base[r] + j] for
     j < offs[r+1] - offs[r] — the reference's warp-per-row memcpy
     (row_conversion.cu:1141 copy_strings_from_rows) as REGULAR ops.
 
     ``offs`` [N+1] must be dense (cumsum of lengths); ``base`` [N] must
-    be nondecreasing over rows with nonzero length (true for every
-    row-blob layout: row starts advance by at least the row's own
-    payload). Both i64.
+    be nondecreasing over rows with nonzero length AND source rows must
+    not overlap in row order (base[r+1] >= base[r] + len[r]) — true for
+    every row-blob layout (a row contains its own strings) and for
+    padded matrices (base = r*W, len <= W). Both i64, addresses < 2^31
+    (cudf size_type discipline). The no-overlap form makes
+    c = base - offs[r] nondecreasing, so ONE packed scatter-max
+    ((c << 31) | end_offset) + one cummax resolves the whole
+    owner/source mapping.
 
     Formulation (the decode twin of assemble_rows): per-element u8
     gathers cost ~8 ns/ELEMENT regardless of width (round-3 memo), so
-    the unit of movement is the u64 WORD — 2 gathers + a funnel shift
-    per 8 output bytes (~2 ns/byte). Because dst is DENSE, each output
+    the unit of movement is the u32 WORD — 2 gathers + a funnel shift
+    per 4 output bytes (~4 ns/byte). Because dst is DENSE, each output
     word splits between one OWNER row (the last row whose span covers
     the word's first byte — computed wholesale by the scatter + cummax
     forward-fill trick) and the sub-word HEAD chunks of later rows
-    (<= 7 bytes each, disjoint byte lanes, scatter-ADDed). Pure jnp: the
-    hermetic CPU tier runs the exact code the chip runs.
+    (<= 3 bytes each, disjoint byte lanes, scatter-ADDed). Pure jnp: the
+    hermetic CPU tier runs the exact code the chip runs. Everything
+    stays FLAT 1-D (see _funnel_u32 on why).
     """
     n = base.shape[0]
     if total == 0 or n == 0:
         return jnp.zeros((0,), jnp.uint8)
     lens = offs[1:] - offs[:-1]
-    nw = (total + 7) // 8 + 1
+    nw = (total + 3) // 4 + 1
 
-    # pool as u64 words, padded one word past any reachable address
+    if pool32 is None:
+        pool32 = build_pool32(pool)
     plen = int(pool.shape[0])
-    pwords = (plen + 8) // 8 + 2
-    pool_pad = jnp.zeros((pwords * 8,), jnp.uint8).at[:plen].set(pool)
-    p32 = flat_u8_to_u32(pool_pad)
-    pool64 = p32[0::2].astype(jnp.uint64) | (p32[1::2].astype(jnp.uint64) << jnp.uint64(32))
 
-    # owner row per output word: scatter each nonzero row at the first
-    # word starting inside its span, forward-fill. The companion arrays
-    # (end offset, dst offset, src base) are each monotone over nonzero
-    # rows, so per-array scatter-max + cummax stays consistent.
+    # Owner-row resolution, all in 32-bit lanes (i64 scans on the
+    # emulated-64 datapath cost ~2x):
+    # - c_w: the owner's src-minus-dst shift, scatter-MAX of the
+    #   nondecreasing c = base - offs[r] at each row's first owned word
+    #   + cummax forward-fill (s = c_w + 4w addresses the source).
+    # - nb_w: valid bytes of word w before the next row takes over =
+    #   scatter-MIN of in-word boundary positions (dense dst: the
+    #   owner's bytes always end at the FIRST content start inside the
+    #   word; word-aligned boundaries need no mask). The final end
+    #   (total) joins as a sentinel boundary.
     nonzero = lens > 0
-    wfirst = ((offs[:-1] + 7) >> 3).astype(jnp.int32)
+    wfirst = ((offs[:-1] + 3) >> 2).astype(jnp.int32)
     widx = jnp.where(nonzero, wfirst, nw)  # park zero rows off the end
-    e_w = lax.cummax(jnp.zeros((nw + 1,), jnp.int64).at[widx].max(offs[1:], mode="drop")[:nw])
-    o_w = lax.cummax(jnp.zeros((nw + 1,), jnp.int64).at[widx].max(offs[:-1], mode="drop")[:nw])
-    b_w = lax.cummax(jnp.zeros((nw + 1,), jnp.int64).at[widx].max(base, mode="drop")[:nw])
+    c_row = (base - offs[:-1]).astype(jnp.int32)  # nondecreasing, >= 0
+    c_w = lax.cummax(
+        jnp.zeros((nw + 1,), jnp.int32).at[widx].max(c_row, mode="drop")[:nw]
+    )
 
-    w0 = jnp.arange(nw, dtype=jnp.int64) * 8
-    nb = jnp.clip(e_w - w0, 0, 8)
-    s = jnp.clip(b_w + (w0 - o_w), 0, plen)  # clip: words past content
-    cand = _funnel_u64(pool64, s)
+    # every boundary (row starts AND the final total) is an entry of offs
+    bpos = (offs & 3).astype(jnp.uint32)
+    bword = (offs >> 2).astype(jnp.int32)
+    bidx = jnp.where(bpos > 0, bword, nw)  # aligned boundaries: no mask
+    nb = (
+        jnp.full((nw + 1,), 4, jnp.uint32).at[bidx].min(bpos, mode="drop")[:nw]
+    )
+
+    w0 = jnp.arange(nw, dtype=jnp.int64) * 4
+    s = jnp.clip(c_w.astype(jnp.int64) + w0, 0, plen)
+    cand = _funnel_u32(pool32, s)
     keep = jnp.where(
-        nb >= 8,
-        ~jnp.uint64(0),
-        (jnp.uint64(1) << (nb.astype(jnp.uint64) * 8)) - jnp.uint64(1),
+        nb >= 4, ~jnp.uint32(0), (jnp.uint32(1) << (nb * 8)) - jnp.uint32(1)
     )
     words = cand & keep
 
-    # head chunks: bytes [offs[r], min(offs[r+1], align8up(offs[r])))
-    # of each row land in its start word at byte offset offs[r] % 8 —
+    # head chunks: bytes [offs[r], min(offs[r+1], align4up(offs[r])))
+    # of each row land in its start word at byte offset offs[r] % 4 —
     # disjoint lanes across rows, so scatter-add composes them
     x = offs[:-1]
-    xa = (x + 7) & ~jnp.int64(7)
-    chunk = jnp.clip(jnp.minimum(offs[1:], xa) - x, 0, 7)
+    xa = (x + 3) & ~jnp.int64(3)
+    chunk = jnp.clip(jnp.minimum(offs[1:], xa) - x, 0, 3).astype(jnp.uint32)
     has = nonzero & (chunk > 0)
-    hsrc = _funnel_u64(pool64, jnp.clip(base, 0, plen))
-    hmask = (jnp.uint64(1) << (chunk.astype(jnp.uint64) * 8)) - jnp.uint64(1)
-    contrib = (hsrc & hmask) << ((x & 7).astype(jnp.uint64) * 8)
-    hidx = jnp.where(has, (x >> 3).astype(jnp.int32), nw)
+    hsrc = _funnel_u32(pool32, jnp.clip(base, 0, plen))
+    hmask = (jnp.uint32(1) << (chunk * 8)) - jnp.uint32(1)
+    contrib = (hsrc & hmask) << ((x & 3).astype(jnp.uint32) * 8)
+    hidx = jnp.where(has, (x >> 2).astype(jnp.int32), nw)
     words = (
-        jnp.concatenate([words, jnp.zeros((1,), jnp.uint64)])
+        jnp.concatenate([words, jnp.zeros((1,), jnp.uint32)])
         .at[hidx]
-        .add(jnp.where(has, contrib, jnp.uint64(0)), mode="drop")[:nw]
+        .add(jnp.where(has, contrib, jnp.uint32(0)), mode="drop")[:nw]
     )
 
-    # u64 words -> u8 stream via the u32 expand path (direct u64->u8
-    # bitcast charges the 32x padded temp)
-    lo = (words & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = (words >> jnp.uint64(32)).astype(jnp.uint32)
-    w32 = jnp.stack([lo, hi], axis=-1).reshape(-1)  # little-endian u32 order
+    # flat u32 words -> u8 stream via the sublane-expand path (a direct
+    # u32 -> u8 bitcast charges the 32x padded temp)
     lanes = 512
-    rows = (w32.shape[0] + lanes - 1) // lanes
-    w32p = jnp.zeros((rows * lanes,), jnp.uint32).at[: w32.shape[0]].set(w32)
+    rows = (nw + lanes - 1) // lanes
+    w32p = jnp.zeros((rows * lanes,), jnp.uint32).at[:nw].set(words)
     return u32_rows_to_u8_flat(w32p.reshape(rows, lanes))[:total]
 
 

@@ -824,15 +824,18 @@ def _jit_string_chars(
     (starts[r] + in_off[r]) are monotone over rows, exactly
     ragged_compact's contract. Reference analog: the warp-per-row
     copy_strings_from_rows (row_conversion.cu:1141)."""
-    from .ragged_bytes import ragged_compact
+    from .ragged_bytes import build_pool32, ragged_compact
 
+    pool32 = build_pool32(blob) if any(totals) else None  # ONCE per blob
     outs = []
     for k, total in enumerate(totals):
         if total == 0:
             outs.append(jnp.zeros((0,), jnp.uint8))
             continue
         base = starts + in_offs[k]
-        outs.append(ragged_compact(blob, base, offs[k].astype(jnp.int64), total))
+        outs.append(
+            ragged_compact(blob, base, offs[k].astype(jnp.int64), total, pool32=pool32)
+        )
     return tuple(outs)
 
 

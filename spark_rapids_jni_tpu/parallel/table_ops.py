@@ -261,7 +261,8 @@ def exchange_table(
     spec = P(axis)
     f = cached_sm(
         ("exchange_table", mesh, axis, int(capacity), len(lanes),
-         tuple(str(a.dtype) for a in lanes)),
+         tuple(str(a.dtype) for a in lanes),
+         tuple(key_pos), tuple(has_v)),  # body statics: which lanes hash as keys
         lambda: jax.jit(jax.shard_map(
             body,
             mesh=mesh,
@@ -341,16 +342,30 @@ def _shard_groupby_aggs(key_arrays, val_arrays, hows, present, val_present, capa
                 aggs.append(s)
             else:
                 x = jnp.where(vps, vs, 0)
-                if jnp.issubdtype(x.dtype, jnp.integer):
+                is_u64 = x.dtype == jnp.uint64
+                if is_u64:
+                    # same two's-complement sum bits (mod 2^64); the
+                    # mean re-reads them unsigned
+                    x = lax.bitcast_convert_type(x, jnp.int64)
+                elif jnp.issubdtype(x.dtype, jnp.integer):
                     x = x.astype(jnp.int64)
                 s = jax.ops.segment_sum(x, seg, num_segments=capacity + 1)[:capacity]
                 if how == "sum":
-                    aggs.append(s)
+                    aggs.append(
+                        lax.bitcast_convert_type(s, jnp.uint64) if is_u64 else s
+                    )
                 elif jnp.issubdtype(vs.dtype, jnp.integer):
                     # exact int mean: limb-divide the exact int64 sum
                     from ..ops.f64acc import mean_i64_div
 
-                    aggs.append(mean_i64_div(s, cnt))
+                    if is_u64:
+                        aggs.append(
+                            mean_i64_div(
+                                lax.bitcast_convert_type(s, jnp.uint64), cnt, unsigned=True
+                            )
+                        )
+                    else:
+                        aggs.append(mean_i64_div(s, cnt))
                 else:
                     aggs.append(s / jnp.maximum(cnt, 1).astype(s.dtype))
             agg_valid.append(cnt > 0)
@@ -521,11 +536,21 @@ def _groupby_split_retry(
 
                 mbits = div_f64bits_by_int(s.data, jnp.maximum(c.data, 1))
                 out_cols.append(Column(dt.FLOAT64, data=mbits, validity=valid))
-            else:
+            elif jnp.issubdtype(s.data.dtype, jnp.integer):
                 from ..ops.f64acc import mean_i64_div
 
                 mbits = mean_i64_div(s.data.astype(jnp.int64), jnp.maximum(c.data, 1))
                 out_cols.append(Column(dt.FLOAT64, data=mbits, validity=valid))
+            else:
+                # FLOAT32 partials divide in their own float lane
+                m = s.data / jnp.maximum(c.data, 1).astype(s.data.dtype)
+                out_cols.append(
+                    Column(
+                        dt.FLOAT64,
+                        data=bitutils.float_store(m, dt.FLOAT64),
+                        validity=valid,
+                    )
+                )
         else:
             mcol = merged.column(f"{oname}_{_MERGE_HOW[how]}")
             out_cols.append(mcol)
@@ -684,6 +709,8 @@ def _groupby_once(
             cols.append(Column(dt.FLOAT64, data=bitutils.float_store(arr, dt.FLOAT64), validity=validity))
         elif how == "count":
             cols.append(Column(dt.INT64, data=arr))
+        elif arr.dtype == jnp.uint64 and how == "sum":
+            cols.append(Column(dt.UINT64, data=arr, validity=validity))
         elif jnp.issubdtype(arr.dtype, jnp.integer) and how == "sum":
             cols.append(Column(dt.INT64, data=arr.astype(jnp.int64), validity=validity))
         else:

@@ -317,16 +317,29 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
             return mb[:num], c[:num] > 0
         if not d.is_floating:
             # integers: exact int64 accumulation (Spark sum(int)->long);
-            # results materialize into FLOAT64 bits without an f32 hop
-            from .ops.f64acc import i64_to_f64bits, mean_i64_div
+            # results materialize into FLOAT64 bits without an f32 hop.
+            # UINT64 sums share the same two's-complement bits (mod
+            # 2^64) — only the final interpretation reads them unsigned
+            from jax import lax as _lax
 
-            vals = col.data.astype(jnp.int64)
+            from .ops.f64acc import (
+                i64_to_f64bits,
+                mean_i64_div,
+                u64_to_f64bits,
+            )
+
+            is_u64 = col.data.dtype == jnp.uint64
+            vals = _lax.bitcast_convert_type(col.data, jnp.int64) if is_u64 else col.data.astype(jnp.int64)
             s = jax.ops.segment_sum(
                 jnp.where(m, vals, 0), gid_v, num_segments=num + 1
             )[:num]
             c = jax.ops.segment_sum(m.astype(jnp.int64), gid_v, num_segments=num + 1)[:num]
             if how == "sum":
+                if is_u64:
+                    return u64_to_f64bits(_lax.bitcast_convert_type(s, jnp.uint64)), c > 0
                 return i64_to_f64bits(s), c > 0
+            if is_u64:
+                return mean_i64_div(_lax.bitcast_convert_type(s, jnp.uint64), c, unsigned=True), c > 0
             return mean_i64_div(s, c), c > 0
         # FLOAT32: one fused kernel for (sums, per-group valid counts) —
         # segment_sum lowers to the slow XLA scatter class on TPU; the
@@ -360,13 +373,27 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
         key_back = lax.bitcast_convert_type(r, jnp.uint64) ^ jnp.uint64(1 << 63)
         return _from_total_order(key_back, dt.FLOAT64), has_vals
     if not d.is_floating:
-        vals = col.data.astype(jnp.int64)
-        from .ops.f64acc import i64_to_f64bits
+        from jax import lax as _lax
 
+        from .ops.f64acc import i64_to_f64bits, u64_to_f64bits
+
+        is_u64 = col.data.dtype == jnp.uint64
+        if is_u64:
+            # order-preserving signed view (flip the top bit) so the
+            # comparison stays correct past 2^63
+            vals = _lax.bitcast_convert_type(
+                col.data ^ jnp.uint64(1 << 63), jnp.int64
+            )
+        else:
+            vals = col.data.astype(jnp.int64)
         fill = hi_i if how == "min" else lo_i
         red = jax.ops.segment_min if how == "min" else jax.ops.segment_max
         r = red(jnp.where(m, vals, fill), gid_v, num_segments=num + 1)[:num]
-        return i64_to_f64bits(jnp.where(has_vals, r, 0)), has_vals
+        r = jnp.where(has_vals, r, 0)
+        if is_u64:
+            back = _lax.bitcast_convert_type(r, jnp.uint64) ^ jnp.uint64(1 << 63)
+            return u64_to_f64bits(jnp.where(has_vals, back, jnp.uint64(0))), has_vals
+        return i64_to_f64bits(r), has_vals
     x = col.data
     if how == "min":
         s = jax.ops.segment_min(jnp.where(m, x, jnp.inf), gid_v, num_segments=num + 1)[:num]

@@ -323,3 +323,27 @@ class TestDD:
             % dd_from_f64bits(_bits([2.0, 2.0, 0.5, 3.0]))
         ))
         np.testing.assert_allclose(g2, exact, atol=1e-12)
+
+
+class TestBoundedDomainF64:
+    def test_groupby_sum_bounded_f64_bits(self, rng):
+        from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+
+        vals = rng.standard_normal(2000) * (10.0 ** rng.uniform(-10, 10, 2000))
+        keys = jnp.asarray(rng.integers(-1, 8, 2000), jnp.int64)  # -1 = dropped
+        sums, counts = groupby_sum_bounded(keys, _bits(vals), 8, f64_bits=True)
+        kh = np.asarray(keys)
+        for g in range(8):
+            want = exact_sum(vals[kh == g])
+            assert _vals(sums)[g] == want
+            assert int(counts[g]) == int((kh == g).sum())
+
+    def test_f64_bits_requires_u64(self):
+        import pytest as _pytest
+
+        from spark_rapids_jni_tpu.ops.aggregate import groupby_sum_bounded
+
+        with _pytest.raises(ValueError):
+            groupby_sum_bounded(
+                jnp.zeros((4,), jnp.int64), jnp.zeros((4,), jnp.float32), 2, f64_bits=True
+            )
