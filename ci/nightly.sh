@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Nightly gate: the FULL hermetic suite (premerge runs the fast tier
+# only) plus the driver entries. Run from the repo root.
+set -euo pipefail
+
+cmake -S native -B native/build -G Ninja
+ninja -C native/build
+
+python -m pytest tests/ -q
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python __graft_entry__.py
+
+python benchmarks/microbench.py --bench groupby --rows 65536 --reps 3

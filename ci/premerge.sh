@@ -7,7 +7,10 @@ set -euo pipefail
 cmake -S native -B native/build -G Ninja
 ninja -C native/build
 
-python -m pytest tests/ -q
+# fast tier: the measured heavy tail (tests/conftest.py _SLOW_TESTS)
+# runs nightly (ci/nightly.sh); this keeps the premerge gate usable on
+# a 1-core box (VERDICT r3 item 9)
+python -m pytest tests/ -q -m "not slow"
 
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py

@@ -534,7 +534,25 @@ SRJT_EXPORT int32_t srjt_convert_to_rows_batched(int64_t table_h, int64_t max_ba
                                                  int64_t* out_handles, int32_t capacity) {
   return static_cast<int32_t>(guarded(
       [&]() -> int64_t {
-        auto batches = srjt::convert_to_rows_batched(table_ref(table_h), max_batch_bytes);
+        // DEVICE-FIRST (VERDICT r3 item 2): the batched entry is what
+        // RowConversion.convertToRows actually calls — with a sidecar
+        // connected it must reach the chip, not the executor CPU. The
+        // worker applies the same 2 GiB default ceiling internally, so
+        // the dispatch covers the default request; a custom ceiling
+        // stays on the host engine (both engines batch identically).
+        std::vector<std::unique_ptr<srjt::NativeColumn>> batches;
+        bool device_done = false;
+        auto client = sidecar_ref();
+        if (client && (max_batch_bytes <= 0 || max_batch_bytes == srjt::MAX_BATCH_BYTES)) {
+          try {
+            batches = client->convert_to_rows(table_ref(table_h));
+            device_done = true;
+          } catch (const std::exception&) {
+            // worker failure: the op must not become less available
+          }
+        }
+        if (!device_done)
+          batches = srjt::convert_to_rows_batched(table_ref(table_h), max_batch_bytes);
         if (static_cast<int32_t>(batches.size()) > capacity) {
           throw std::runtime_error("batch handle capacity too small");
         }
@@ -550,6 +568,15 @@ SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* ty
                                            const int32_t* scales, int32_t ncols) {
   return guarded(
       [&]() -> int64_t {
+        auto client = sidecar_ref();
+        if (client) {
+          try {
+            auto t = client->convert_from_rows(col_ref(rows_col_h), type_ids, scales, ncols);
+            return tables().put(std::make_unique<srjt::NativeTable>(std::move(t)));
+          } catch (const std::exception&) {
+            // fall back to host engine below
+          }
+        }
         std::vector<srjt::TypeId> types;
         std::vector<int32_t> scales_v;
         for (int32_t i = 0; i < ncols; ++i) {
@@ -564,6 +591,16 @@ SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* ty
 SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode,
                                                 int32_t out_type_id) {
   return guarded_cast([&]() -> int64_t {
+    auto client = sidecar_ref();
+    if (client) {
+      try {
+        return put_column(client->cast_to_integer(col_ref(col_h), ansi_mode != 0, out_type_id));
+      } catch (const srjt::CastError&) {
+        throw;  // semantic ANSI failure: propagate, never re-run on host
+      } catch (const std::exception&) {
+        // worker failure: host engine below
+      }
+    }
     return put_column(srjt::string_to_integer(
         col_ref(col_h), static_cast<srjt::TypeId>(out_type_id), ansi_mode != 0));
   });
@@ -572,6 +609,15 @@ SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode
 SRJT_EXPORT int64_t srjt_cast_string_to_decimal(int64_t col_h, int32_t ansi_mode,
                                                 int32_t precision, int32_t scale) {
   return guarded_cast([&]() -> int64_t {
+    auto client = sidecar_ref();
+    if (client) {
+      try {
+        return put_column(client->cast_to_decimal(col_ref(col_h), ansi_mode != 0, precision, scale));
+      } catch (const srjt::CastError&) {
+        throw;
+      } catch (const std::exception&) {
+      }
+    }
     return put_column(srjt::string_to_decimal(col_ref(col_h), ansi_mode != 0, precision, scale));
   });
 }
@@ -584,7 +630,16 @@ SRJT_EXPORT const char* srjt_last_cast_string() { return g_cast_error_value.c_st
 
 SRJT_EXPORT int64_t srjt_zorder_interleave_bits(int64_t table_h) {
   return guarded(
-      [&]() -> int64_t { return put_column(srjt::interleave_bits(table_ref(table_h))); },
+      [&]() -> int64_t {
+        auto client = sidecar_ref();
+        if (client) {
+          try {
+            return put_column(client->zorder(table_ref(table_h)));
+          } catch (const std::exception&) {
+          }
+        }
+        return put_column(srjt::interleave_bits(table_ref(table_h)));
+      },
       0);
 }
 
@@ -595,6 +650,14 @@ SRJT_EXPORT int64_t srjt_live_columnar_handles() {
 SRJT_EXPORT int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t product_scale) {
   return guarded(
       [&]() -> int64_t {
+        auto client = sidecar_ref();
+        if (client) {
+          try {
+            auto t = client->decimal128_binary(col_ref(a_h), col_ref(b_h), product_scale, false);
+            return tables().put(std::make_unique<srjt::NativeTable>(std::move(t)));
+          } catch (const std::exception&) {
+          }
+        }
         return tables().put(srjt::multiply_decimal128(col_ref(a_h), col_ref(b_h), product_scale));
       },
       0);
@@ -603,6 +666,14 @@ SRJT_EXPORT int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t p
 SRJT_EXPORT int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quotient_scale) {
   return guarded(
       [&]() -> int64_t {
+        auto client = sidecar_ref();
+        if (client) {
+          try {
+            auto t = client->decimal128_binary(col_ref(a_h), col_ref(b_h), quotient_scale, true);
+            return tables().put(std::make_unique<srjt::NativeTable>(std::move(t)));
+          } catch (const std::exception&) {
+          }
+        }
         return tables().put(srjt::divide_decimal128(col_ref(a_h), col_ref(b_h), quotient_scale));
       },
       0);

@@ -21,7 +21,16 @@ namespace {
 constexpr uint32_t OP_PING = 0;
 constexpr uint32_t OP_GROUPBY_SUM_F32 = 1;
 constexpr uint32_t OP_CONVERT_TO_ROWS = 2;
+constexpr uint32_t OP_CONVERT_FROM_ROWS = 3;
+constexpr uint32_t OP_CAST_TO_INTEGER = 4;
+constexpr uint32_t OP_CAST_TO_DECIMAL = 5;
+constexpr uint32_t OP_ZORDER = 6;
+constexpr uint32_t OP_DECIMAL128_MUL = 7;
+constexpr uint32_t OP_DECIMAL128_DIV = 8;
 constexpr uint32_t OP_SHUTDOWN = 255;
+
+constexpr uint32_t STATUS_OK = 0;
+constexpr uint32_t STATUS_CAST_ERROR = 2;
 
 void append(std::vector<uint8_t>& buf, const void* p, size_t n) {
   const uint8_t* b = static_cast<const uint8_t*>(p);
@@ -32,6 +41,82 @@ template <typename T>
 void append_val(std::vector<uint8_t>& buf, T v) {
   append(buf, &v, sizeof(T));
 }
+
+void append_column(std::vector<uint8_t>& payload, const NativeColumn& col) {
+  append_val<int32_t>(payload, static_cast<int32_t>(col.type));
+  append_val<int32_t>(payload, col.scale);
+  append_val<uint64_t>(payload, static_cast<uint64_t>(col.size));
+  uint8_t has_validity = col.validity.empty() ? 0 : 1;
+  append_val<uint8_t>(payload, has_validity);
+  if (has_validity) append(payload, col.validity.data(), col.validity.size());
+  if (col.type == TypeId::STRING || col.type == TypeId::LIST) {
+    append(payload, col.offsets.data(), col.offsets.size() * 4);
+    append_val<uint64_t>(payload, static_cast<uint64_t>(col.chars.size()));
+    append(payload, col.chars.data(), col.chars.size());
+  } else {
+    append_val<uint64_t>(payload, static_cast<uint64_t>(col.data.size()));
+    append(payload, col.data.data(), col.data.size());
+  }
+}
+
+void append_table(std::vector<uint8_t>& payload, const NativeTable& table) {
+  append_val<uint32_t>(payload, static_cast<uint32_t>(table.columns.size()));
+  for (const auto& col : table.columns) append_column(payload, *col);
+}
+
+// symmetric parser of the worker's _write_table responses
+class TableParser {
+ public:
+  explicit TableParser(const std::vector<uint8_t>& buf) : buf_(buf) {}
+
+  NativeTable parse_table() {
+    uint32_t ncols = read<uint32_t>();
+    NativeTable t;
+    for (uint32_t i = 0; i < ncols; ++i) t.columns.push_back(parse_column());
+    return t;
+  }
+
+  std::shared_ptr<NativeColumn> parse_column() {
+    auto col = std::make_shared<NativeColumn>();
+    col->type = static_cast<TypeId>(read<int32_t>());
+    col->scale = read<int32_t>();
+    col->size = static_cast<int64_t>(read<uint64_t>());
+    uint8_t has_validity = read<uint8_t>();
+    if (has_validity) {
+      col->validity.resize(static_cast<size_t>(col->size));
+      read_bytes(col->validity.data(), col->validity.size());
+    }
+    if (col->type == TypeId::STRING || col->type == TypeId::LIST) {
+      col->offsets.resize(static_cast<size_t>(col->size) + 1);
+      read_bytes(col->offsets.data(), col->offsets.size() * 4);
+      uint64_t clen = read<uint64_t>();
+      col->chars.resize(clen);
+      read_bytes(col->chars.data(), clen);
+    } else {
+      uint64_t dlen = read<uint64_t>();
+      col->data.resize(dlen);
+      read_bytes(col->data.data(), dlen);
+    }
+    return col;
+  }
+
+  bool done() const { return pos_ == buf_.size(); }
+
+ private:
+  template <typename T>
+  T read() {
+    T v;
+    read_bytes(&v, sizeof(T));
+    return v;
+  }
+  void read_bytes(void* dst, size_t n) {
+    if (pos_ + n > buf_.size()) throw std::runtime_error("sidecar: truncated table response");
+    std::memcpy(dst, buf_.data() + pos_, n);
+    pos_ += n;
+  }
+  const std::vector<uint8_t>& buf_;
+  size_t pos_ = 0;
+};
 
 }  // namespace
 
@@ -165,7 +250,18 @@ std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8
   std::memcpy(&rlen, rhdr + 4, 8);
   std::vector<uint8_t> resp(rlen);
   if (rlen) recv_all(resp.data(), rlen);
-  if (status != 0) {
+  if (status == STATUS_CAST_ERROR) {
+    // semantic ANSI failure: payload = i64 row, u8 is_null, utf-8
+    // value. Re-raise as srjt::CastError so guarded_cast translates it
+    // into the JNI CastException protocol — never a host-engine rerun.
+    if (resp.size() < 9) throw std::runtime_error("sidecar: malformed cast error");
+    int64_t row;
+    std::memcpy(&row, resp.data(), 8);
+    bool is_null = resp[8] != 0;
+    std::string value(resp.begin() + 9, resp.end());
+    throw CastError(row, std::move(value), is_null);
+  }
+  if (status != STATUS_OK) {
     throw std::runtime_error("sidecar op failed: " +
                              std::string(resp.begin(), resp.end()));
   }
@@ -193,23 +289,7 @@ std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
     const NativeTable& table) {
   std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
-  append_val<uint32_t>(payload, static_cast<uint32_t>(table.columns.size()));
-  for (const auto& col : table.columns) {
-    append_val<int32_t>(payload, static_cast<int32_t>(col->type));
-    append_val<int32_t>(payload, col->scale);
-    append_val<uint64_t>(payload, static_cast<uint64_t>(col->size));
-    uint8_t has_validity = col->validity.empty() ? 0 : 1;
-    append_val<uint8_t>(payload, has_validity);
-    if (has_validity) append(payload, col->validity.data(), col->validity.size());
-    if (col->type == TypeId::STRING) {
-      append(payload, col->offsets.data(), col->offsets.size() * 4);
-      append_val<uint64_t>(payload, static_cast<uint64_t>(col->chars.size()));
-      append(payload, col->chars.data(), col->chars.size());
-    } else {
-      append_val<uint64_t>(payload, static_cast<uint64_t>(col->data.size()));
-      append(payload, col->data.data(), col->data.size());
-    }
-  }
+  append_table(payload, table);
   auto resp = request(OP_CONVERT_TO_ROWS, payload);
 
   size_t pos = 0;
@@ -244,6 +324,87 @@ std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
     out.push_back(std::move(col));
   }
   return out;
+}
+
+NativeTable SidecarClient::convert_from_rows(const NativeColumn& rows,
+                                             const int32_t* type_ids, const int32_t* scales,
+                                             int32_t ncols) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  std::vector<uint8_t> payload;
+  append_val<uint32_t>(payload, static_cast<uint32_t>(ncols));
+  append(payload, type_ids, static_cast<size_t>(ncols) * 4);
+  if (scales) {
+    append(payload, scales, static_cast<size_t>(ncols) * 4);
+  } else {
+    payload.resize(payload.size() + static_cast<size_t>(ncols) * 4, 0);
+  }
+  append_val<uint64_t>(payload, static_cast<uint64_t>(rows.size));
+  append(payload, rows.offsets.data(), rows.offsets.size() * 4);
+  append_val<uint64_t>(payload, static_cast<uint64_t>(rows.chars.size()));
+  append(payload, rows.chars.data(), rows.chars.size());
+  auto resp = request(OP_CONVERT_FROM_ROWS, payload);
+  TableParser p(resp);
+  auto t = p.parse_table();
+  if (!p.done()) throw std::runtime_error("sidecar: trailing bytes in table response");
+  return t;
+}
+
+std::unique_ptr<NativeColumn> SidecarClient::cast_to_integer(const NativeColumn& col,
+                                                             bool ansi, int32_t out_type_id) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  std::vector<uint8_t> payload;
+  append_val<uint8_t>(payload, ansi ? 1 : 0);
+  append_val<int32_t>(payload, out_type_id);
+  append_val<uint32_t>(payload, 1);
+  append_column(payload, col);
+  auto resp = request(OP_CAST_TO_INTEGER, payload);
+  TableParser p(resp);
+  auto t = p.parse_table();
+  if (t.columns.size() != 1) throw std::runtime_error("sidecar: cast expected one column");
+  return std::make_unique<NativeColumn>(std::move(*t.columns[0]));
+}
+
+std::unique_ptr<NativeColumn> SidecarClient::cast_to_decimal(const NativeColumn& col,
+                                                             bool ansi, int32_t precision,
+                                                             int32_t scale) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  std::vector<uint8_t> payload;
+  append_val<uint8_t>(payload, ansi ? 1 : 0);
+  append_val<int32_t>(payload, precision);
+  append_val<int32_t>(payload, scale);
+  append_val<uint32_t>(payload, 1);
+  append_column(payload, col);
+  auto resp = request(OP_CAST_TO_DECIMAL, payload);
+  TableParser p(resp);
+  auto t = p.parse_table();
+  if (t.columns.size() != 1) throw std::runtime_error("sidecar: cast expected one column");
+  return std::make_unique<NativeColumn>(std::move(*t.columns[0]));
+}
+
+std::unique_ptr<NativeColumn> SidecarClient::zorder(const NativeTable& table) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  std::vector<uint8_t> payload;
+  append_table(payload, table);
+  auto resp = request(OP_ZORDER, payload);
+  TableParser p(resp);
+  auto t = p.parse_table();
+  if (t.columns.size() != 1) throw std::runtime_error("sidecar: zorder expected one column");
+  return std::make_unique<NativeColumn>(std::move(*t.columns[0]));
+}
+
+NativeTable SidecarClient::decimal128_binary(const NativeColumn& a, const NativeColumn& b,
+                                             int32_t out_scale, bool divide) {
+  std::lock_guard<std::mutex> lock(op_mu_);
+  std::vector<uint8_t> payload;
+  append_val<int32_t>(payload, out_scale);
+  append_val<uint32_t>(payload, 2);
+  append_column(payload, a);
+  append_column(payload, b);
+  auto resp = request(divide ? OP_DECIMAL128_DIV : OP_DECIMAL128_MUL, payload);
+  TableParser p(resp);
+  auto t = p.parse_table();
+  if (!p.done()) throw std::runtime_error("sidecar: trailing bytes in table response");
+  return t;
 }
 
 }  // namespace srjt

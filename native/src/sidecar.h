@@ -44,6 +44,28 @@ class SidecarClient {
   // column per <=2GiB batch.
   std::vector<std::unique_ptr<NativeColumn>> convert_to_rows(const NativeTable& table);
 
+  // -- round 4: the full operator surface (VERDICT r3 item 2) --------------
+  // Every op throws on transport/worker failure (callers fall back to
+  // the host engine) EXCEPT semantic ANSI cast failures, which arrive
+  // as srjt::CastError and must propagate (status 2 on the wire).
+
+  // JCUDF rows -> columns on the device.
+  NativeTable convert_from_rows(const NativeColumn& rows, const int32_t* type_ids,
+                                const int32_t* scales, int32_t ncols);
+
+  // ANSI/non-ANSI string casts on the device.
+  std::unique_ptr<NativeColumn> cast_to_integer(const NativeColumn& col, bool ansi,
+                                                int32_t out_type_id);
+  std::unique_ptr<NativeColumn> cast_to_decimal(const NativeColumn& col, bool ansi,
+                                                int32_t precision, int32_t scale);
+
+  // DeltaLake Z-order interleave on the device.
+  std::unique_ptr<NativeColumn> zorder(const NativeTable& table);
+
+  // 128-bit decimal multiply/divide on the device: (overflow, result).
+  NativeTable decimal128_binary(const NativeColumn& a, const NativeColumn& b,
+                                int32_t out_scale, bool divide);
+
  private:
   std::vector<uint8_t> request(uint32_t op, const std::vector<uint8_t>& payload);
 

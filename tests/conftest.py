@@ -26,3 +26,65 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
+
+
+# ---------------------------------------------------------------------------
+# premerge fast tier (VERDICT r3 item 9)
+# ---------------------------------------------------------------------------
+# The full hermetic suite takes ~25 min on this 1-core box; ci/premerge.sh
+# runs `-m "not slow"` (<~8 min) and ci/nightly.sh runs everything. The
+# set below is the measured top of the duration report (>=10 s each,
+# ~1030 s combined, round-4 run); a renamed test silently drops back
+# into the fast tier, which is the safe failure mode.
+_SLOW_TESTS = {
+    "test_cast_decimal.py::test_edges",
+    "test_cast_decimal.py::test_type_dispatch_by_precision",
+    "test_concurrency.py::test_concurrent_executor_threads_isolated",
+    "test_decimal_utils.py::test_large_pos_multiply_ten_by_ten",
+    "test_decimal_utils.py::test_simple_neg_multiply_one_by_one",
+    "test_decimal_utils.py::test_simple_pos_multiply_one_by_one",
+    "test_decimal_utils.py::test_simple_pos_multiply_one_by_zero",
+    "test_decimal_utils.py::test_simple_pos_multiply_zero_by_neg_one",
+    "test_decimal_utils.py::test_spark_compat_multiply",
+    "test_f64acc.py::TestDD::test_exact_f32_values_roundtrip_exactly",
+    "test_f64acc.py::TestDD::test_mod",
+    "test_f64acc.py::TestDD::test_roundtrip_bits",
+    "test_f64acc.py::TestExactMean::test_correctly_rounded_mean",
+    "test_f64acc.py::TestExactSum::test_bit_identical_small_span",
+    "test_f64acc.py::TestExactSum::test_wide_span_relative_bound",
+    "test_graft_entry.py::test_dryrun_multichip_from_unforced_process",
+    "test_models.py::TestFusedPipelines::test_q1_fused_matches_op_tier",
+    "test_models.py::TestFusedPipelines::test_q6_fused_matches_op_tier",
+    "test_models.py::TestTpcds::test_q95_matches_pandas",
+    "test_models.py::TestTpch::test_q1_exact_f64_adversarial_magnitudes",
+    "test_models.py::TestTpch::test_q1_matches_pandas",
+    "test_native_columnar.py::test_cast_to_decimal_matches_python_op",
+    "test_native_columnar.py::test_decimal128_native_matches_python[mul--1]",
+    "test_native_columnar.py::test_decimal128_native_matches_python[mul--20]",
+    "test_native_columnar.py::test_decimal128_native_matches_python[mul--6]",
+    "test_operators.py::test_full_join_string_keys_matches_pandas",
+    "test_parquet_reader.py::test_deep_nesting_row_groups",
+    "test_parquet_reader.py::test_multiple_row_groups",
+    "test_ragged_bytes.py::TestRaggedCompact::test_aligned_and_unaligned_mix",
+    "test_regex.py::test_replace_re[\\d+-#]",
+    "test_row_conversion.py::test_grouped_decode_matches_per_column",
+    "test_row_conversion.py::test_roundtrip_wide",
+    "test_sidecar.py::test_convert_to_rows_dispatches_device_and_matches_host",
+    "test_table_ops.py::test_distributed_join_semi_anti[left_anti]",
+    "test_table_ops.py::test_distributed_join_semi_anti[left_semi]",
+    "test_table_ops.py::test_distributed_join_string_key",
+    "test_table_ops.py::test_memory_budget_split_retry",
+    "test_table_ops.py::test_q95_distributed_matches_single_chip",
+}
+
+
+# parametrized ids with regex metacharacters escape unpredictably in
+# nodeids — match those families by prefix instead of exact id
+_SLOW_PREFIXES = ("test_regex.py::test_replace_re[",)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        nodeid = item.nodeid.replace("tests/", "")
+        if nodeid in _SLOW_TESTS or nodeid.startswith(_SLOW_PREFIXES):
+            item.add_marker(pytest.mark.slow)
