@@ -543,7 +543,11 @@ SRJT_EXPORT int32_t srjt_convert_to_rows_batched(int64_t table_h, int64_t max_ba
         std::vector<std::unique_ptr<srjt::NativeColumn>> batches;
         bool device_done = false;
         auto client = sidecar_ref();
-        if (client && (max_batch_bytes <= 0 || max_batch_bytes == srjt::MAX_BATCH_BYTES)) {
+        if (client && (max_batch_bytes <= 0 || max_batch_bytes == srjt::MAX_BATCH_BYTES) &&
+            srjt::rows_total_bytes(table_ref(table_h)) <= srjt::MAX_BATCH_BYTES) {
+          // same ceiling discipline as srjt_convert_to_rows: shipping a
+          // multi-GiB table over the UDS just to have the worker split
+          // it again is all cost, no benefit
           try {
             batches = client->convert_to_rows(table_ref(table_h));
             device_done = true;

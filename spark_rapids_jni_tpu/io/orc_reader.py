@@ -16,7 +16,8 @@ and boolean bit streams; NONE/ZLIB/SNAPPY/LZO/LZ4/ZSTD compression
 framing. PRESENT streams drive validity with the same present-scatter
 shape as the parquet reader; nested presence composes down the type
 tree (children store values only where every ancestor is non-null).
-Unions raise (documented).
+UNIONs decode as STRUCT<tag INT8, f0, f1, ...> (sparse mapping of the
+dense union; cudf has no union type).
 
 Oracle for tests: pyarrow.orc.
 """
@@ -641,7 +642,17 @@ def _read_column(rd: _StripeReader, col: int, types: List[_TypeNode],
                 raise OrcReadError("decimal stored scale exceeds declared scale")
             out.append(v * (10 ** int(declared - s_)))
         return ("decimal", out), present
-    raise OrcReadError(f"unsupported ORC type kind {k} (unions pending)")
+    if k == _T_UNION:
+        # DATA: byte-RLE variant tags; each child carries only the
+        # values whose tag selects it (ORC dense-union layout)
+        raw = rd.stream(col, _S_DATA)
+        tags = _byte_rle(raw, n_present)
+        children = []
+        for ci, sub in enumerate(tnode.subtypes):
+            ccount = int((tags == ci).sum())
+            children.append(_read_column(rd, sub, types, ccount))
+        return ("union", tags, children), present
+    raise OrcReadError(f"unsupported ORC type kind {k}")
 
 
 def _assemble_nested(
@@ -708,6 +719,40 @@ def _assemble_nested(
             child = Column.struct_from_parts([key, val], ["key", "value"])
         return Column.list_from_parts(
             offsets, child, validity=jnp.asarray(present_all) if has_nulls else None
+        )
+
+    if k == _T_UNION:
+        # Dense union -> STRUCT<tag INT8, f0, f1, ...>: cudf (and the
+        # Table tier here) has no union type, so each variant
+        # materializes full-length with validity tag==ci — the sparse
+        # mapping of an arrow dense union. The tag field preserves
+        # lossless round-tripping.
+        tag_parts = []
+        child_sets: List[List] = [[] for _ in tnode.subtypes]
+        child_pres: List[List[np.ndarray]] = [[] for _ in tnode.subtypes]
+        for sp, ppres in zip(pieces, presents):
+            tags = sp[1]  # packed to this level's surviving entries
+            full_tags = np.zeros(len(ppres), np.int8)
+            full_tags[np.flatnonzero(ppres)] = tags.astype(np.int8)
+            tag_parts.append(full_tags)
+            surv = np.flatnonzero(ppres)
+            for ci, (cpiece, cpres) in enumerate(sp[2]):
+                n_ci = int((tags == ci).sum())
+                packed = cpres if cpres is not None else np.ones(n_ci, bool)
+                full = np.zeros(len(ppres), bool)
+                full[surv[tags == ci]] = packed
+                child_sets[ci].append(cpiece)
+                child_pres[ci].append(full)
+        tags_all = (
+            np.concatenate(tag_parts) if tag_parts else np.zeros(0, np.int8)
+        )
+        fields = [Column(dt.INT8, data=jnp.asarray(tags_all))]
+        names = ["tag"]
+        for ci, sub in enumerate(tnode.subtypes):
+            fields.append(_assemble_nested(types[sub], types, child_sets[ci], child_pres[ci]))
+            names.append(f"f{ci}")
+        return Column.struct_from_parts(
+            fields, names, validity=jnp.asarray(present_all) if has_nulls else None
         )
 
     return _to_column_normalized(pieces, present_all, tnode)

@@ -24,7 +24,7 @@ from ..ops.expressions import col, lit
 from ..ops.join import left_semi_join
 from ..ops.sort import sort_by_key
 
-__all__ = ["gen_store", "gen_web", "q3", "q95"]
+__all__ = ["gen_store", "gen_web", "q3", "q55", "q55_distributed", "q95"]
 
 
 
@@ -66,8 +66,9 @@ def gen_store(num_sales: int, seed: int = 42) -> Dict[str, Table]:
             _int_col(np.arange(n_items)),  # i_item_sk
             _int_col(rng.integers(1, 1000, n_items)),  # i_manufact_id
             _int_col(rng.integers(1, 500, n_items)),  # i_brand_id (dict code)
+            _int_col(rng.integers(1, 100, n_items)),  # i_manager_id
         ],
-        ["i_item_sk", "i_manufact_id", "i_brand_id"],
+        ["i_item_sk", "i_manufact_id", "i_brand_id", "i_manager_id"],
     )
     store_sales = Table(
         [
@@ -150,6 +151,87 @@ def _q3_pipeline(year_lo: int, n_years: int, n_brands: int, n_dates: int, n_item
     )
 
 
+
+
+def q55(tables: Dict[str, Table], manager_id: int = 28, month: int = 11, year: int = 1999) -> Table:
+    """TPC-DS q55 (brand revenue for one manager-month). SQL:
+
+        SELECT i_brand_id, sum(ss_ext_sales_price) ext_price
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = :mgr AND d_moy = :moy AND d_year = :yr
+        GROUP BY i_brand_id ORDER BY ext_price DESC, i_brand_id
+
+    Exercises the SORT-MERGE JoinSpec lowering (num_keys=None): both
+    star joins binary-search sorted build keys inside the one compiled
+    program — no bounded-domain declaration anywhere, matching cudf's
+    general hash join (SURVEY §2.8)."""
+    item = tables["item"]
+    dates = tables["date_dim"]
+    ss = tables["store_sales"]
+    n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
+    agg = _q55_pipeline(n_brands, int(manager_id), int(month), int(year))(
+        ss, {"date_dim": dates, "item": item}
+    )
+    order_keys = Table(
+        [agg.column("ext_price"), agg.column("i_brand_id")], ["p", "b"]
+    )
+    return sort_by_key(agg, order_keys, ascending=[False, True])
+
+
+@functools.lru_cache(maxsize=16)
+def _q55_pipeline(n_brands: int, manager_id: int, month: int, year: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
+
+    return compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk",
+                    build_key="d_date_sk", num_keys=None,  # sort-merge
+                    build_filter=(col("d_moy") == lit(month)) & (col("d_year") == lit(year)),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk",
+                    build_key="i_item_sk", num_keys=None,  # sort-merge
+                    payload=("i_brand_id",),
+                    build_filter=col("i_manager_id") == lit(manager_id),
+                ),
+            ),
+            group_by=(GroupKey("i_brand_id", n_brands),),
+            aggregates=(Agg("ss_ext_sales_price", "sum", "ext_price"),),
+        )
+    )
+
+
+def q55_distributed(tables: Dict[str, Table], mesh, manager_id: int = 28, month: int = 11, year: int = 1999) -> Table:
+    """q55 on the Table-level distributed operators: filtered dim tables
+    inner-join the fact across the mesh, then a distributed group-by.
+    Must produce results identical to single-chip ``q55``."""
+    from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+
+    item = tables["item"]
+    dates = tables["date_dim"]
+    ss = tables["store_sales"]
+
+    dsel = ((col("d_moy") == lit(month)) & (col("d_year") == lit(year))).evaluate(dates)
+    d1 = copying.apply_boolean_mask(dates, dsel).select(["d_date_sk"])
+    d1 = Table(d1.columns, ["ss_sold_date_sk"])
+    isel = (col("i_manager_id") == lit(manager_id)).evaluate(item)
+    i1 = copying.apply_boolean_mask(item, isel).select(["i_item_sk", "i_brand_id"])
+    i1 = Table(i1.columns, ["ss_item_sk", "i_brand_id"])
+
+    j1, o1 = distributed_join_table(ss, d1, on=["ss_sold_date_sk"], mesh=mesh, how="inner")
+    j2, o2 = distributed_join_table(j1, i1, on=["ss_item_sk"], mesh=mesh, how="inner")
+    if o1 or o2:
+        raise RuntimeError("join capacity overflow — raise capacity")
+    agg, o3 = distributed_groupby_table(
+        j2, ["i_brand_id"], [("ss_ext_sales_price", "sum", "ext_price")], mesh
+    )
+    if o3:
+        raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    order_keys = Table([agg.column("ext_price"), agg.column("i_brand_id")], ["p", "b"])
+    return sort_by_key(agg, order_keys, ascending=[False, True])
 
 def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:
     """web_sales + web_returns + date_dim for q95. Orders have 1-4 line

@@ -65,24 +65,33 @@ class GroupKey:
 
 @dataclasses.dataclass(frozen=True)
 class JoinSpec:
-    """Bounded-domain hash join against a BUILD table (the broadcast
-    dim-join Spark offloads per stage; q3's star joins, q95's EXISTS /
-    NOT EXISTS). TPU-first execution: the build side scatters into a
-    DENSE [num_keys] presence/payload map (dim keys are bounded), and
-    the probe is a row gather — no sort, no dynamic shapes, and probe
-    misses flow into the same trash-segment mask the filter uses.
+    """Join against a BUILD table (the broadcast dim-join Spark offloads
+    per stage; q3's star joins, q95's EXISTS / NOT EXISTS). Two
+    TPU-first lowerings, both static-shape inside the one compiled
+    program:
+
+    - ``num_keys`` set — bounded-domain: the build side scatters into a
+      DENSE [num_keys] presence/payload map (dim keys are bounded) and
+      the probe is a row gather.
+    - ``num_keys=None`` — SORT-MERGE fallback for arbitrary int64 keys
+      (cudf's general hash join has no domain restriction, SURVEY
+      §2.8): the build side sorts once (excluded rows park at a +inf
+      sentinel), the probe binary-searches (log2 |build| gathers), and
+      every candidate verifies raw key equality, so sentinel collisions
+      are impossible. Probe misses flow into the same trash-segment
+      mask either way.
 
     ``how``: "inner" gathers ``payload`` columns into the working
     schema and drops probe misses; "semi"/"anti" keep/drop rows by
     presence only (payload must be empty). Build keys must be UNIQUE
-    among rows passing ``build_filter`` for inner joins with payload —
+    among rows passing ``build_filter`` for inner joins —
     duplicates are surfaced as a loud error, like out-of-domain group
     keys."""
 
     build: str  # name of the build table passed to __call__
     probe_key: str  # column in the working (fact-side) schema
     build_key: str  # column in the build table
-    num_keys: int  # bounded domain of the build key
+    num_keys: Optional[int] = None  # bounded domain; None = sort-merge
     payload: Tuple[str, ...] = ()
     how: str = "inner"
     build_filter: Optional[Expression] = None
@@ -139,7 +148,10 @@ class CompiledPipeline:
 
         n_bad_build = jnp.zeros((), jnp.int64)
         for js in plan.joins:
-            hit, joined, dups, bad_build = _dense_join(js, cols, builds[js.build])
+            if js.num_keys is None:
+                hit, joined, dups, bad_build = _sorted_join(js, cols, builds[js.build])
+            else:
+                hit, joined, dups, bad_build = _dense_join(js, cols, builds[js.build])
             n_dup = n_dup + dups
             n_bad_build = n_bad_build + bad_build
             keep = ~hit if js.how == "anti" else hit
@@ -402,12 +414,10 @@ def _grouped_agg(col: Column, v, gid, num: int, how: str, counts_all):
     return s, has_vals
 
 
-def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
-    """One bounded-domain join: scatter the (filtered) build side into
-    dense presence/payload maps, probe by row gather. Returns
-    (hit [N] bool, {name: joined Column}, duplicate-key count,
-    out-of-domain build-row count — both loud mis-declaration errors)."""
-    num = js.num_keys
+def _build_enter_mask(js: JoinSpec, bt: Table) -> jnp.ndarray:
+    """Build-side liveness: valid key AND build_filter (with its own
+    null semantics) — shared by both join lowerings so filter handling
+    can never diverge between them."""
     bk = bt.column(js.build_key)
     enter = bk.valid_mask()
     if js.build_filter is not None:
@@ -416,6 +426,90 @@ def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
         if bf.validity is not None:
             bfm = bfm & bf.validity
         enter = enter & bfm
+    return enter
+
+
+def _sorted_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
+    """Sort-merge lowering for unbounded build keys (JoinSpec
+    num_keys=None): lexsort the build side by (parked-last, key) so
+    entered rows form a sorted prefix at every key — including a
+    genuine INT64_MAX key, which therefore cannot collide with the
+    parked sentinel — then binary-search every probe and verify raw
+    equality AND build-row liveness. Same (hit, joined, dups,
+    bad_build) contract as _dense_join (payload columns are always
+    emitted, null-filled when the build is empty); bad_build is always
+    0 (there is no declared domain to escape)."""
+    bk = bt.column(js.build_key)
+    n_b = len(bk)
+    enter = _build_enter_mask(js, bt)
+    keys = bk.data.astype(jnp.int64)
+    big = jnp.int64((1 << 63) - 1)
+
+    pcol = cols[js.probe_key]
+    pk = pcol.data.astype(jnp.int64)
+    n_p = pk.shape[0]
+
+    def null_payloads():
+        out: Dict[str, Column] = {}
+        for pname in js.payload:
+            src_c = bt.column(pname)
+            d = src_c.dtype
+            if not d.is_fixed_width or d.id == dt.TypeId.DECIMAL128:
+                raise ValueError(f"join payload {pname!r}: only plain fixed-width columns")
+            shape = (n_p,) + src_c.data.shape[1:]
+            out[pname] = Column(
+                d,
+                data=jnp.zeros(shape, src_c.data.dtype),
+                validity=jnp.zeros((n_p,), bool),
+            )
+        return out
+
+    dups = jnp.zeros((), jnp.int64)
+    if n_b == 0:
+        hit = jnp.zeros((n_p,), bool)
+        return hit, null_payloads(), dups, jnp.zeros((), jnp.int64)
+
+    # parked rows sort AFTER every entered row, entered rows by key:
+    # searchsorted(side='left') therefore always lands on an entered
+    # row when one exists for the probe key
+    order = jnp.lexsort((keys, ~enter)).astype(jnp.int32)
+    ks = keys[order]
+    es = enter[order]
+    sk = jnp.where(es, ks, big)
+
+    if js.how == "inner" and n_b > 1:
+        dups = jnp.sum(((ks[1:] == ks[:-1]) & es[1:] & es[:-1]).astype(jnp.int64))
+
+    idx = jnp.clip(
+        jnp.searchsorted(sk, pk, side="left"), 0, n_b - 1
+    ).astype(jnp.int32)
+    src = order[idx]
+    hit = (ks[idx] == pk) & es[idx] & pcol.valid_mask()
+
+    joined: Dict[str, Column] = {}
+    for pname in js.payload:
+        pc = bt.column(pname)
+        d = pc.dtype
+        if not d.is_fixed_width or d.id == dt.TypeId.DECIMAL128:
+            raise ValueError(f"join payload {pname!r}: only plain fixed-width columns")
+        data = jnp.where(
+            hit.reshape(hit.shape + (1,) * (pc.data.ndim - 1)),
+            pc.data[src],
+            jnp.zeros((), pc.data.dtype),
+        )
+        pv = pc.valid_mask()[src] & hit
+        joined[pname] = Column(d, data=data, validity=pv)
+    return hit, joined, dups, jnp.zeros((), jnp.int64)
+
+
+def _dense_join(js: JoinSpec, cols: Dict[str, Column], bt: Table):
+    """One bounded-domain join: scatter the (filtered) build side into
+    dense presence/payload maps, probe by row gather. Returns
+    (hit [N] bool, {name: joined Column}, duplicate-key count,
+    out-of-domain build-row count — both loud mis-declaration errors)."""
+    num = js.num_keys
+    bk = bt.column(js.build_key)
+    enter = _build_enter_mask(js, bt)
     # domain guard BEFORE the i32 narrowing: an int64 key >= 2^31 must
     # miss, not wrap into the valid domain. A build row INSIDE the
     # filter but OUTSIDE the declared domain is a mis-declaration

@@ -240,3 +240,52 @@ class TestFusedPipelines:
         np.testing.assert_array_equal(
             np.asarray(op.column("qty_count_all").data), fused["count"]
         )
+
+
+class TestQ55:
+    def test_q55_sortmerge_matches_pandas(self):
+        tabs = tpcds.gen_store(30_000, seed=21)
+        out = tpcds.q55(tabs, manager_id=28, month=11, year=1999)
+
+        ss = pd.DataFrame({
+            "d": np.asarray(tabs["store_sales"].column(0).data),
+            "i": np.asarray(tabs["store_sales"].column(1).data),
+            "p": _f64(tabs["store_sales"].column(2)),
+        })
+        dd = pd.DataFrame({
+            "d": np.asarray(tabs["date_dim"].column(0).data),
+            "y": np.asarray(tabs["date_dim"].column(1).data),
+            "m": np.asarray(tabs["date_dim"].column(2).data),
+        })
+        it = pd.DataFrame({
+            "i": np.asarray(tabs["item"].column(0).data),
+            "b": np.asarray(tabs["item"].column(2).data),
+            "mgr": np.asarray(tabs["item"].column(3).data),
+        })
+        j = ss.merge(dd[(dd.y == 1999) & (dd.m == 11)], on="d").merge(
+            it[it.mgr == 28], on="i"
+        )
+        want = (
+            j.groupby("b").p.sum().reset_index()
+            .sort_values(["p", "b"], ascending=[False, True])
+        )
+        got_b = np.asarray(out.column("i_brand_id").data)
+        got_p = _f64(out.column("ext_price"))
+        assert got_b.tolist() == want.b.tolist()
+        np.testing.assert_allclose(got_p, want.p.values, rtol=1e-12)
+
+    def test_q55_distributed_matches_single_chip(self):
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        tabs = tpcds.gen_store(20_000, seed=22)
+        single = tpcds.q55(tabs)
+        dist = tpcds.q55_distributed(tabs, mesh)
+        assert np.asarray(single.column("i_brand_id").data).tolist() == \
+            np.asarray(dist.column("i_brand_id").data).tolist()
+        # exact f64 sums: distributed must be BIT-identical to single-chip
+        np.testing.assert_array_equal(
+            np.asarray(single.column("ext_price").data),
+            np.asarray(dist.column("ext_price").data),
+        )

@@ -206,3 +206,59 @@ def test_decimals_vs_pyarrow():
     assert got.column("v").to_pylist() == [
         None if v is None else int(v.scaleb(3, ctx)) for v in big
     ]
+
+
+def test_union_as_tagged_struct():
+    """ORC UNION decodes as STRUCT<tag, f0, f1> (sparse dense-union
+    mapping; cudf has no union type)."""
+    import numpy as np
+
+    tags = pa.array([0, 1, 0, 1, 0], pa.int8())
+    offsets = pa.array([0, 0, 1, 1, 2], pa.int32())
+    ints = pa.array([7, 9, -3], pa.int64())
+    strs = pa.array(["x", "yy"], pa.string())
+    arr = pa.UnionArray.from_dense(tags, offsets, [ints, strs])
+    data = write(pa.table({"u": arr}))
+
+    from spark_rapids_jni_tpu.io.orc_reader import read_table
+
+    t = read_table(data)
+    u = t.column(0)
+    vals = u.to_pylist()
+    assert [v["tag"] for v in vals] == [0, 1, 0, 1, 0]
+    assert [v["f0"] for v in vals] == [7, None, 9, None, -3]
+    assert [v["f1"] for v in vals] == [None, "x", None, "yy", None]
+
+
+def test_union_multi_stripe_and_nested_child():
+    import numpy as np
+
+    n = 3000
+    rng = np.random.default_rng(8)
+    tags_np = rng.integers(0, 2, n).astype(np.int8)
+    n0 = int((tags_np == 0).sum())
+    n1 = n - n0
+    offs_np = np.zeros(n, np.int32)
+    offs_np[tags_np == 0] = np.arange(n0)
+    offs_np[tags_np == 1] = np.arange(n1)
+    ints_np = rng.integers(-(2**40), 2**40, n0)
+    strs_py = [f"s{i % 13}" for i in range(n1)]
+    arr = pa.UnionArray.from_dense(
+        pa.array(tags_np, pa.int8()),
+        pa.array(offs_np, pa.int32()),
+        [pa.array(ints_np, pa.int64()), pa.array(strs_py, pa.string())],
+    )
+    data = write(pa.table({"u": arr}), stripe_size=64 * 1024)
+
+    from spark_rapids_jni_tpu.io.orc_reader import read_table
+
+    t = read_table(data)
+    vals = t.column(0).to_pylist()
+    i0 = i1 = 0
+    for r in range(n):
+        if tags_np[r] == 0:
+            assert vals[r]["f0"] == int(ints_np[i0]) and vals[r]["f1"] is None
+            i0 += 1
+        else:
+            assert vals[r]["f1"] == strs_py[i1] and vals[r]["f0"] is None
+            i1 += 1
