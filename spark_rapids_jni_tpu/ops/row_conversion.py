@@ -446,33 +446,21 @@ def _jit_assemble(fixed32, var32, row_offsets, total_bytes: int, min_row: int):
     return assemble_rows((fixed32, var32), sizes, row_offsets, total_bytes, min_row)
 
 
-def _to_rows_strings_padded(
+_FUSED_ENCODE_BROKEN = False
+
+
+def _encode_strings_impl(
     layout: RowLayout,
     cols: Tuple[Column, ...],
-    row_offsets: jnp.ndarray,  # [N+1] int64 dst offsets (cumsum of sizes)
+    row_offsets: jnp.ndarray,
     total_bytes: int,
-    maxlens: Tuple[int, ...],  # static per-string-col max byte length
-    maxvar: int,  # static padded width of the variable section
+    maxlens: Tuple[int, ...],
+    maxvar: int,
 ) -> jnp.ndarray:
-    """Mixed fixed+string table -> [total_bytes] u8 blob, ALL regular
-    ops (ops/ragged_bytes design memo): replaces the element-granular
-    scatters that ran this axis at 0.016 GB/s.
-
-    1. fixed sections assemble as before ([N, fixed_end]),
-    2. each string column extracts to a padded [N, L_k] matrix with ONE
-       overlapping-tile gather + per-row rotate (~100 GB/s measured),
-    3. the variable section accumulates by per-row byte shifts (strings
-       are disjoint per row, so sum == placement),
-    4. padded rows compact to the exact 8-aligned ragged blob with the
-       dst-centric two-source tile assembly (monotonic gathers).
-
-    The reference does step 2-4 with a warp-per-row memcpy
-    (row_conversion.cu:827-874); on TPU the same movement is expressed
-    as gathers of fixed-width tiles + lane arithmetic. Four separately
-    jitted stages — one fused program of this size crashes the XLA:TPU
-    compiler (observed), and the stage outputs are genuine
-    materialization points anyway.
-    """
+    """Shared staging body for the mixed encode. Called DIRECTLY, each
+    stage function's own jit gives the staged pipeline (one dispatch
+    per stage); called under _jit_encode_strings_fused, the nested jits
+    inline into ONE program."""
     var_cols = [cols[i] for i in layout.variable_cols]
     fixed32, var_starts, lens = _jit_fixed_and_slots(layout, tuple(cols))
     n = len(cols[0])
@@ -507,12 +495,76 @@ def _to_rows_strings_padded(
             tuple(chars), tuple(starts), tuple(lens_in), tuple(shifts),
             tail_lane, rem, tuple(mls), region,
         )
-
     fixed_part = fixed32[:, :fe4] if rem else fixed32  # avoid a 1 GB slice copy
     return _jit_assemble(
         fixed_part, var32, row_offsets, total_bytes,
         _round_up(layout.fixed_end, JCUDF_ROW_ALIGNMENT),
     )
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4, 5))
+def _jit_encode_strings_fused(
+    layout: RowLayout,
+    cols: Tuple[Column, ...],
+    row_offsets: jnp.ndarray,
+    total_bytes: int,
+    maxlens: Tuple[int, ...],
+    maxvar: int,
+) -> jnp.ndarray:
+    """The whole mixed encode as ONE program (nested stage jits inline)
+    — the staged pipeline minus three dispatch round trips (~90 ms each
+    through the dev tunnel)."""
+    return _encode_strings_impl(layout, cols, row_offsets, total_bytes, maxlens, maxvar)
+
+
+def _to_rows_strings_padded(
+    layout: RowLayout,
+    cols: Tuple[Column, ...],
+    row_offsets: jnp.ndarray,  # [N+1] int64 dst offsets (cumsum of sizes)
+    total_bytes: int,
+    maxlens: Tuple[int, ...],  # static per-string-col max byte length
+    maxvar: int,  # static padded width of the variable section
+) -> jnp.ndarray:
+    """Mixed fixed+string table -> [total_bytes] u8 blob, ALL regular
+    ops (ops/ragged_bytes design memo): replaces the element-granular
+    scatters that ran this axis at 0.016 GB/s.
+
+    1. fixed sections assemble as before ([N, fixed_end]),
+    2. each string column extracts to a padded [N, L_k] matrix with ONE
+       overlapping-tile gather + per-row rotate (~100 GB/s measured),
+    3. the variable section accumulates by per-row byte shifts (strings
+       are disjoint per row, so sum == placement),
+    4. padded rows compact to the exact 8-aligned ragged blob with the
+       dst-centric two-source tile assembly (monotonic gathers).
+
+    The reference does step 2-4 with a warp-per-row memcpy
+    (row_conversion.cu:827-874); on TPU the same movement is expressed
+    as gathers of fixed-width tiles + lane arithmetic. The fused
+    single-program form is tried first (dispatch count 2 instead of 5);
+    a compile/runtime failure — very wide axes have crashed the XLA:TPU
+    compiler on fully fused forms (round-3 observation) — demotes the
+    process to the staged pipeline, whose stage outputs are genuine
+    materialization points.
+    """
+    n = len(cols[0])
+    # ONE fused program for fixed+slots+var+assemble (3 fewer ~90 ms
+    # dispatches through a remote tunnel); very wide axes have crashed
+    # the XLA:TPU compiler on the fully fused form before (round-3
+    # observation), so a compile failure falls back to the staged path
+    global _FUSED_ENCODE_BROKEN
+    if not _FUSED_ENCODE_BROKEN:
+        try:
+            out = _jit_encode_strings_fused(
+                layout, tuple(cols), row_offsets, total_bytes, maxlens, maxvar
+            )
+            # force execution INSIDE the try: async dispatch would defer
+            # a runtime failure past this handler and the fallback would
+            # never engage
+            return jax.block_until_ready(out)
+        except Exception:
+            _FUSED_ENCODE_BROKEN = True  # pay the probe once per process
+
+    return _encode_strings_impl(layout, cols, row_offsets, total_bytes, maxlens, maxvar)
 
 
 def _to_rows_strings(
@@ -621,12 +673,12 @@ def convert_to_rows(table: Table) -> List[Column]:
     # ~1.0 s of the 1.6 s mixed-axis call through a remote tunnel
     # (round-3 profile); offsets stay on device.
     var_offs = tuple(cols[i].offsets for i in layout.variable_cols)
-    sizes_dev, stats = _jit_row_size_stats(layout, var_offs)
+    sizes_dev, offsets_dev, stats = _jit_row_size_stats(layout, var_offs)
     total, max_size = (int(v) for v in np.asarray(stats))  # host sync
     maxlens = _var_maxlens(layout, cols)
 
     if total <= MAX_BATCH_BYTES:  # single batch: no further host pulls
-        row_offsets = _jit_offsets_from_sizes(sizes_dev)
+        row_offsets = offsets_dev
         maxvar = max(_round_up(max_size - layout.fixed_end, 64), 8)
         if n * (layout.fixed_end + maxvar) <= _PADDED_ROWS_BYTE_BUDGET:
             blob = _to_rows_strings_padded(
@@ -671,12 +723,10 @@ def _jit_row_size_stats(layout: RowLayout, var_offsets: Tuple[jnp.ndarray, ...])
         // JCUDF_ROW_ALIGNMENT
         * JCUDF_ROW_ALIGNMENT
     )
-    return sizes, jnp.stack([jnp.sum(sizes), jnp.max(sizes)])
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(sizes)])
+    return sizes, offsets, jnp.stack([jnp.sum(sizes), jnp.max(sizes)])
 
 
-@jax.jit
-def _jit_offsets_from_sizes(sizes: jnp.ndarray) -> jnp.ndarray:
-    return jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(sizes)])
 
 
 def _slice_column(col: Column, rs: int, re: int) -> Column:
