@@ -382,9 +382,13 @@ def bench_tpch(rows: int, reps: int) -> None:
     # chained (trusted) variants; q6's per-iteration time is tiny, so
     # its chain must be long enough that the long-short difference
     # dwarfs the tunnel's +-5 ms jitter
-    secs = _chained_pipeline_secs(q6, li, "l_extendedprice", max(reps // 2, 2), 513)
+    # chain lengths sized for the round-4 exact-f64 per-iteration cost
+    # (~0.34 s at 1M): the old 513-iteration q6 chain ran minutes and
+    # crashed the TPU worker ("kernel fault") — 17 iterations already
+    # dwarf the +-5 ms tunnel jitter at this per-iter scale
+    secs = _chained_pipeline_secs(q6, li, "l_extendedprice", max(reps // 2, 2), 17)
     _report("tpch_q6_fused_chained", rows, 4, secs, q6_bytes, "chained")
-    secs = _chained_pipeline_secs(q1, li, "l_extendedprice", max(reps // 2, 2), 33)
+    secs = _chained_pipeline_secs(q1, li, "l_extendedprice", max(reps // 2, 2), 9)
     _report("tpch_q1_fused_chained", rows, li.num_columns, secs, nbytes, "chained")
 
 
