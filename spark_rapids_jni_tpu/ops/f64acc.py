@@ -143,6 +143,10 @@ class _GroupSum(NamedTuple):
 
 
 def _accumulate(bits, valid, seg, num_segments) -> _GroupSum:
+    if num_segments == 0:  # zero groups (e.g. a fully filtered batch)
+        z64 = jnp.zeros((0, LIMBS), _I64)
+        zb = jnp.zeros((0,), bool)
+        return _GroupSum(z64, jnp.zeros((0,), _I32), zb, zb, zb)
     neg, e_eff, mant, is_nan, is_pinf, is_ninf = _decompose(bits)
     if valid is not None:
         live = valid
@@ -419,7 +423,9 @@ def segment_mean_f64bits(
     (mean_bits [G] u64, count [G] i64)."""
     gs = _accumulate(bits, valid, seg, num_segments)
     live = valid if valid is not None else jnp.ones(bits.shape, bool)
-    if num_segments <= 16:  # masked reductions beat the scatter class
+    if num_segments == 0:
+        cnt = jnp.zeros((0,), _I64)
+    elif num_segments <= 16:  # masked reductions beat the scatter class
         cnt = jnp.stack(
             [
                 jnp.sum(jnp.where(seg == g, live, False).astype(_I64))
