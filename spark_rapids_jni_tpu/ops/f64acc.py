@@ -143,10 +143,13 @@ class _GroupSum(NamedTuple):
 
 
 def _accumulate(bits, valid, seg, num_segments) -> _GroupSum:
-    if num_segments == 0:  # zero groups (e.g. a fully filtered batch)
-        z64 = jnp.zeros((0, LIMBS), _I64)
-        zb = jnp.zeros((0,), bool)
-        return _GroupSum(z64, jnp.zeros((0,), _I32), zb, zb, zb)
+    if num_segments == 0 or bits.shape[0] == 0:
+        # zero groups (fully filtered batch) or zero rows with live
+        # groups: every group sums to +0.0. The small-G masked path
+        # below would jnp.max over a zero-size array, which errors.
+        z64 = jnp.zeros((num_segments, LIMBS), _I64)
+        zb = jnp.zeros((num_segments,), bool)
+        return _GroupSum(z64, jnp.ones((num_segments,), _I32), zb, zb, zb)
     neg, e_eff, mant, is_nan, is_pinf, is_ninf = _decompose(bits)
     if valid is not None:
         live = valid

@@ -561,8 +561,24 @@ def _to_rows_strings_padded(
             # a runtime failure past this handler and the fallback would
             # never engage
             return jax.block_until_ready(out)
-        except Exception:
-            _FUSED_ENCODE_BROKEN = True  # pay the probe once per process
+        except jax.errors.JaxRuntimeError as e:
+            import logging
+
+            # A transient RESOURCE_EXHAUSTED (memory pressure from a
+            # concurrent batch) must not demote every later encode in
+            # the process: fall back for THIS call only and retry the
+            # fused form next time. Genuine compile/internal failures
+            # latch once per process.
+            transient = "RESOURCE_EXHAUSTED" in str(e)
+            logging.getLogger(__name__).warning(
+                "fused string-encode program failed (%s: %s); falling "
+                "back to the staged pipeline %s",
+                type(e).__name__,
+                e,
+                "for this call" if transient else "for this process",
+            )
+            if not transient:
+                _FUSED_ENCODE_BROKEN = True  # pay the probe once per process
 
     return _encode_strings_impl(layout, cols, row_offsets, total_bytes, maxlens, maxvar)
 

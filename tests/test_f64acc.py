@@ -141,6 +141,18 @@ class TestExactSum:
         out = _vals(segment_sum_f64bits(_bits([1.0]), jnp.zeros((1,), jnp.int32), 3))
         assert out[0] == 1.0 and out[1] == 0.0 and out[2] == 0.0
 
+    @pytest.mark.parametrize("num_segments", [1, 3, 16, 17])
+    def test_zero_rows_any_group_count(self, num_segments):
+        # regression (ADVICE r4): 0 rows with 1 <= G <= 16 crashed the
+        # small-G masked path with a zero-size jnp.max
+        empty_bits = jnp.zeros((0,), jnp.uint64)
+        empty_seg = jnp.zeros((0,), jnp.int32)
+        out = _vals(segment_sum_f64bits(empty_bits, empty_seg, num_segments))
+        assert out.shape == (num_segments,) and (out == 0.0).all()
+        mean, cnt = segment_mean_f64bits(empty_bits, empty_seg, num_segments)
+        assert _vals(mean).shape == (num_segments,)
+        assert (np.asarray(cnt) == 0).all()
+
     def test_large_n_exactness(self, rng):
         # adversarial magnitudes at scale: 100k values across 25 decades
         n = 100_000
