@@ -96,10 +96,48 @@ def _report(
         "mrows_per_s": round(rows / secs / 1e6, 2),
         "gb_per_s": round(nbytes / secs / 1e9, 3),
         "protocol": protocol,
+        "fingerprint": _platform_fingerprint(),
     }
     if protocol != "chained" and rec["gb_per_s"] > _HBM_ROOFLINE_GBS:
         rec["suspect_rawsync"] = True
     print(json.dumps(rec), flush=True)
+    out_path = os.environ.get("SRJT_RESULTS")
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+_FP = None
+
+
+def _platform_fingerprint() -> dict:
+    """Attached to EVERY artifact row (VERDICT r4 weak #7): identical
+    code measured 118.4 -> 72.9 GB/s across rounds with no fingerprint
+    to attribute the drift to; this pins {versions, backend, host,
+    date} so cross-round comparisons are anchored."""
+    global _FP
+    if _FP is None:
+        import datetime
+        import socket
+
+        import jaxlib
+
+        try:
+            from importlib.metadata import version
+
+            libtpu = version("libtpu")
+        except Exception:
+            libtpu = None
+        _FP = {
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "libtpu": libtpu,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "host": socket.gethostname(),
+            "date": datetime.date.today().isoformat(),
+        }
+    return _FP
 
 
 def _chained_secs(run, reps: int, k_short: int = 1, k_long: int = 9) -> float:
@@ -381,14 +419,13 @@ def bench_tpch(rows: int, reps: int) -> None:
 
     # chained (trusted) variants; q6's per-iteration time is tiny, so
     # its chain must be long enough that the long-short difference
-    # dwarfs the tunnel's +-5 ms jitter
-    # chain lengths sized for the round-4 exact-f64 per-iteration cost
-    # (~0.34 s at 1M): the old 513-iteration q6 chain ran minutes and
-    # crashed the TPU worker ("kernel fault") — 17 iterations already
-    # dwarf the +-5 ms tunnel jitter at this per-iter scale
-    secs = _chained_pipeline_secs(q6, li, "l_extendedprice", max(reps // 2, 2), 17)
+    # dwarfs the tunnel's +-5 ms jitter. Round 5's int8-MXU limb
+    # kernel + elementwise add2 put exact-f64 pipelines back at ~3
+    # ms/iter (from ~0.34 s in r4), so the long chains are safe again
+    # (513-iteration survival verified on chip, NOTES_ROUND5)
+    secs = _chained_pipeline_secs(q6, li, "l_extendedprice", max(reps // 2, 2), 129)
     _report("tpch_q6_fused_chained", rows, 4, secs, q6_bytes, "chained")
-    secs = _chained_pipeline_secs(q1, li, "l_extendedprice", max(reps // 2, 2), 9)
+    secs = _chained_pipeline_secs(q1, li, "l_extendedprice", max(reps // 2, 2), 129)
     _report("tpch_q1_fused_chained", rows, li.num_columns, secs, nbytes, "chained")
 
 

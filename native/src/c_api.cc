@@ -8,6 +8,7 @@
 #include <string>
 #include <vector>
 
+#include "faultinj.h"
 #include "handle_registry.h"
 #include "host_buffer.h"
 #include "parquet_footer.h"
@@ -67,6 +68,7 @@ SRJT_EXPORT int64_t srjt_footer_read_and_filter(
     int32_t n_elems, int32_t parent_num_children, int32_t ignore_case) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_footer_read_and_filter");
         std::vector<std::string> names_v;
         std::vector<int32_t> nc_v(num_children, num_children + n_elems);
         std::vector<int32_t> tags_v(tags, tags + n_elems);
@@ -503,6 +505,7 @@ SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* va
 SRJT_EXPORT int64_t srjt_convert_to_rows(int64_t table_h) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_convert_to_rows");
         // device path when a sidecar owns a chip; host engine
         // otherwise (and on any sidecar failure — the op must not
         // become less available because a worker died). Tables over
@@ -534,6 +537,7 @@ SRJT_EXPORT int32_t srjt_convert_to_rows_batched(int64_t table_h, int64_t max_ba
                                                  int64_t* out_handles, int32_t capacity) {
   return static_cast<int32_t>(guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_convert_to_rows_batched");
         // DEVICE-FIRST (VERDICT r3 item 2): the batched entry is what
         // RowConversion.convertToRows actually calls — with a sidecar
         // connected it must reach the chip, not the executor CPU. The
@@ -572,6 +576,7 @@ SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* ty
                                            const int32_t* scales, int32_t ncols) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_convert_from_rows");
         auto client = sidecar_ref();
         if (client) {
           try {
@@ -595,6 +600,7 @@ SRJT_EXPORT int64_t srjt_convert_from_rows(int64_t rows_col_h, const int32_t* ty
 SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode,
                                                 int32_t out_type_id) {
   return guarded_cast([&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_cast_string_to_integer");
     auto client = sidecar_ref();
     if (client) {
       try {
@@ -613,6 +619,7 @@ SRJT_EXPORT int64_t srjt_cast_string_to_integer(int64_t col_h, int32_t ansi_mode
 SRJT_EXPORT int64_t srjt_cast_string_to_decimal(int64_t col_h, int32_t ansi_mode,
                                                 int32_t precision, int32_t scale) {
   return guarded_cast([&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_cast_string_to_decimal");
     auto client = sidecar_ref();
     if (client) {
       try {
@@ -635,6 +642,7 @@ SRJT_EXPORT const char* srjt_last_cast_string() { return g_cast_error_value.c_st
 SRJT_EXPORT int64_t srjt_zorder_interleave_bits(int64_t table_h) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_zorder_interleave_bits");
         auto client = sidecar_ref();
         if (client) {
           try {
@@ -654,6 +662,7 @@ SRJT_EXPORT int64_t srjt_live_columnar_handles() {
 SRJT_EXPORT int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t product_scale) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_multiply_decimal128");
         auto client = sidecar_ref();
         if (client) {
           try {
@@ -670,6 +679,7 @@ SRJT_EXPORT int64_t srjt_multiply_decimal128(int64_t a_h, int64_t b_h, int32_t p
 SRJT_EXPORT int64_t srjt_divide_decimal128(int64_t a_h, int64_t b_h, int32_t quotient_scale) {
   return guarded(
       [&]() -> int64_t {
+        srjt::faultinj::maybe_inject("srjt_divide_decimal128");
         auto client = sidecar_ref();
         if (client) {
           try {
@@ -704,4 +714,21 @@ SRJT_EXPORT int64_t srjt_byte_array_lens(const uint8_t* data, int64_t size, int3
   }
   if (pos != size) return -1;
   return count;
+}
+
+// -- fault injection control (utils/faultinj.py schema; VERDICT r4 #3) ------
+
+SRJT_EXPORT int32_t srjt_faultinj_configure(const char* path) {
+  return static_cast<int32_t>(guarded(
+      [&]() -> int64_t {
+        srjt::faultinj::configure_from_file(path);
+        return 0;
+      },
+      -1));
+}
+
+SRJT_EXPORT void srjt_faultinj_disable() { srjt::faultinj::disable(); }
+
+SRJT_EXPORT int32_t srjt_faultinj_enabled() {
+  return srjt::faultinj::is_enabled() ? 1 : 0;
 }

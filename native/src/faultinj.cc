@@ -1,0 +1,318 @@
+#include "faultinj.h"
+
+#include <sys/stat.h>
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <stdexcept>
+
+namespace srjt {
+namespace faultinj {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal JSON reader for the flat faultinj schema (objects, strings,
+// numbers, bools/null tolerated) — no external dependency
+// ---------------------------------------------------------------------------
+
+struct JValue {
+  enum Kind { OBJ, STR, NUM, BOOL, NUL } kind = NUL;
+  std::map<std::string, JValue> obj;
+  std::string str;
+  double num = 0;
+  bool b = false;
+};
+
+class JParser {
+ public:
+  explicit JParser(const std::string& s) : s_(s) {}
+
+  JValue parse() {
+    JValue v = value();
+    ws();
+    if (pos_ != s_.size()) fail("trailing bytes");
+    return v;
+  }
+
+ private:
+  void fail(const char* what) {
+    std::ostringstream os;
+    os << "faultinj config parse error at byte " << pos_ << ": " << what;
+    throw std::runtime_error(os.str());
+  }
+  void ws() {
+    while (pos_ < s_.size() && std::isspace(static_cast<unsigned char>(s_[pos_]))) pos_++;
+  }
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end");
+    return s_[pos_];
+  }
+  char next() {
+    char c = peek();
+    pos_++;
+    return c;
+  }
+  void expect(char c) {
+    if (next() != c) fail("unexpected character");
+  }
+
+  JValue value() {
+    ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '"') {
+      JValue v;
+      v.kind = JValue::STR;
+      v.str = string();
+      return v;
+    }
+    if (c == '-' || std::isdigit(static_cast<unsigned char>(c))) return number();
+    if (s_.compare(pos_, 4, "true") == 0) {
+      pos_ += 4;
+      JValue v;
+      v.kind = JValue::BOOL;
+      v.b = true;
+      return v;
+    }
+    if (s_.compare(pos_, 5, "false") == 0) {
+      pos_ += 5;
+      JValue v;
+      v.kind = JValue::BOOL;
+      return v;
+    }
+    if (s_.compare(pos_, 4, "null") == 0) {
+      pos_ += 4;
+      return JValue{};
+    }
+    fail("unexpected token");
+    return JValue{};
+  }
+
+  JValue object() {
+    JValue v;
+    v.kind = JValue::OBJ;
+    expect('{');
+    ws();
+    if (peek() == '}') {
+      pos_++;
+      return v;
+    }
+    while (true) {
+      ws();
+      std::string key = string();
+      ws();
+      expect(':');
+      v.obj[key] = value();
+      ws();
+      char c = next();
+      if (c == '}') return v;
+      if (c != ',') fail("expected , or }");
+    }
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case '\\': out += '\\'; break;
+          case '"': out += '"'; break;
+          case '/': out += '/'; break;
+          default: fail("unsupported escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  JValue number() {
+    size_t start = pos_;
+    if (peek() == '-') pos_++;
+    while (pos_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '+' || s_[pos_] == '-')) {
+      pos_++;
+    }
+    JValue v;
+    v.kind = JValue::NUM;
+    v.num = std::strtod(s_.substr(start, pos_ - start).c_str(), nullptr);
+    return v;
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// rule state (mirrors utils/faultinj.py semantics)
+// ---------------------------------------------------------------------------
+
+struct Rule {
+  enum Kind { FATAL, RETRYABLE, EXCEPTION } kind = RETRYABLE;
+  double percent = 100.0;
+  int64_t budget = -1;  // -1 == unlimited
+};
+
+struct State {
+  std::mutex mu;
+  std::map<std::string, Rule> rules;
+  uint64_t rng = 0x853c49e6748fea9bULL;  // pcg-ish LCG state
+  std::string path;
+  time_t mtime = 0;
+  bool enabled = false;
+  bool env_checked = false;
+};
+
+State& state() {
+  static State s;
+  return s;
+}
+
+double rng_uniform100(State& s) {
+  // deterministic LCG (same stream for a given seed, like the Python
+  // tier's random.Random(seed))
+  s.rng = s.rng * 6364136223846793005ULL + 1442695040888963407ULL;
+  return static_cast<double>((s.rng >> 11) % 1000000) / 10000.0;  // [0, 100)
+}
+
+void parse_into(State& s, const std::string& text) {
+  JValue root = JParser(text).parse();
+  if (root.kind != JValue::OBJ) throw std::runtime_error("faultinj: config must be an object");
+  s.rules.clear();
+  uint64_t seed = 0x853c49e6748fea9bULL;
+  auto it = root.obj.find("seed");
+  if (it != root.obj.end() && it->second.kind == JValue::NUM) {
+    seed = static_cast<uint64_t>(it->second.num) * 2654435761ULL + 1;
+  }
+  s.rng = seed;
+  auto fit = root.obj.find("faults");
+  if (fit != root.obj.end() && fit->second.kind == JValue::OBJ) {
+    for (const auto& [name, spec] : fit->second.obj) {
+      if (spec.kind != JValue::OBJ) continue;
+      Rule r;
+      auto t = spec.obj.find("type");
+      if (t != spec.obj.end() && t->second.kind == JValue::STR) {
+        if (t->second.str == "fatal") {
+          r.kind = Rule::FATAL;
+        } else if (t->second.str == "retryable") {
+          r.kind = Rule::RETRYABLE;
+        } else if (t->second.str == "exception") {
+          r.kind = Rule::EXCEPTION;
+        } else {
+          throw std::runtime_error("faultinj: unknown fault type " + t->second.str);
+        }
+      }
+      auto p = spec.obj.find("percent");
+      if (p != spec.obj.end() && p->second.kind == JValue::NUM) r.percent = p->second.num;
+      auto c = spec.obj.find("interceptionCount");
+      if (c != spec.obj.end() && c->second.kind == JValue::NUM) {
+        r.budget = static_cast<int64_t>(c->second.num);
+      }
+      s.rules[name] = r;
+    }
+  }
+}
+
+void load_file(State& s, const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("faultinj: cannot open " + path);
+  std::ostringstream os;
+  os << f.rdbuf();
+  parse_into(s, os.str());
+  s.path = path;
+  struct stat st{};
+  s.mtime = stat(path.c_str(), &st) == 0 ? st.st_mtime : 0;
+  // file-backed configs stay active even when currently empty so the
+  // hot-reload poll keeps running (Python tier does the same)
+  s.enabled = true;
+}
+
+void reload_if_changed(State& s) {
+  if (s.path.empty()) return;
+  struct stat st{};
+  if (stat(s.path.c_str(), &st) != 0) return;
+  if (st.st_mtime != s.mtime) {
+    try {
+      load_file(s, s.path);
+    } catch (...) {
+      // malformed rewrite mid-poll: keep the previous rules
+    }
+  }
+}
+
+}  // namespace
+
+void configure_from_file(const std::string& path) {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mu);
+  load_file(s, path);
+}
+
+void disable() {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mu);
+  s.rules.clear();
+  s.path.clear();
+  s.enabled = false;
+}
+
+bool is_enabled() {
+  State& s = state();
+  std::lock_guard<std::mutex> lock(s.mu);
+  return s.enabled;
+}
+
+void maybe_inject(const char* op_name) {
+  State& s = state();
+  Rule::Kind kind;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    if (!s.env_checked) {
+      s.env_checked = true;
+      const char* env = std::getenv("SRJT_FAULTINJ_CONFIG");
+      if (env != nullptr && env[0] != '\0' && !s.enabled) {
+        try {
+          load_file(s, env);
+        } catch (...) {
+          // a bad config degrades the injector, never the host process
+        }
+      }
+    }
+    if (!s.enabled) return;
+    reload_if_changed(s);
+    auto it = s.rules.find(op_name);
+    if (it == s.rules.end()) it = s.rules.find("*");
+    if (it == s.rules.end()) return;
+    Rule& r = it->second;
+    if (r.budget == 0) return;
+    if (rng_uniform100(s) >= r.percent) return;
+    if (r.budget > 0) r.budget--;
+    kind = r.kind;
+  }
+  switch (kind) {
+    case Rule::FATAL:
+      throw std::runtime_error(std::string("FATAL: injected fatal fault in ") + op_name);
+    case Rule::RETRYABLE:
+      throw std::runtime_error(std::string("RETRYABLE: injected retryable fault in ") +
+                               op_name);
+    default:
+      throw std::runtime_error(std::string("injected exception in ") + op_name);
+  }
+}
+
+}  // namespace faultinj
+}  // namespace srjt

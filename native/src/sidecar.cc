@@ -2,6 +2,7 @@
 
 #include <fcntl.h>
 #include <signal.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <sys/wait.h>
@@ -27,7 +28,12 @@ constexpr uint32_t OP_CAST_TO_DECIMAL = 5;
 constexpr uint32_t OP_ZORDER = 6;
 constexpr uint32_t OP_DECIMAL128_MUL = 7;
 constexpr uint32_t OP_DECIMAL128_DIV = 8;
+constexpr uint32_t OP_SET_ARENA = 9;
 constexpr uint32_t OP_SHUTDOWN = 255;
+
+// high bit of op (request) / status (response): payload lives at arena
+// offset 0 instead of following on the socket
+constexpr uint32_t ARENA_FLAG = 0x80000000u;
 
 constexpr uint32_t STATUS_OK = 0;
 constexpr uint32_t STATUS_CAST_ERROR = 2;
@@ -146,14 +152,16 @@ SidecarClient::SidecarClient(const std::string& python_exe, int timeout_sec) {
     // printing readiness; device/jax init dominates the wait)
     auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_sec);
     while (true) {
-      fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
-      if (fd_ < 0) throw std::runtime_error("sidecar: socket() failed");
+      int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+      if (fd < 0) throw std::runtime_error("sidecar: socket() failed");
       sockaddr_un addr{};
       addr.sun_family = AF_UNIX;
       std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
-      if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) break;
-      close(fd_);
-      fd_ = -1;
+      if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        close(fd);  // probe only; pooled connections are created below
+        break;
+      }
+      close(fd);
       int status = 0;
       if (waitpid(child_pid_, &status, WNOHANG) == child_pid_) {
         child_pid_ = -1;
@@ -165,17 +173,17 @@ SidecarClient::SidecarClient(const std::string& python_exe, int timeout_sec) {
       std::this_thread::sleep_for(std::chrono::milliseconds(100));
     }
 
-    // a wedged worker must surface as an op error (the fallback path),
-    // not an indefinite block under the client mutex
-    timeval tv{};
-    tv.tv_sec = 600;
-    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    // fixed-size pool: slots never move (threads hold references into
+    // conns_ while other threads acquire), connections establish
+    // lazily. Slot 0 is eager: it proves the data plane.
+    conns_.resize(kPoolSize);
+    for (size_t i = kPoolSize; i-- > 0;) free_.push_back(i);
+    conns_[0] = make_conn();
 
     auto resp = request(OP_PING, {});
     platform_.assign(resp.begin(), resp.end());
   } catch (...) {
-    if (fd_ >= 0) close(fd_);
+    for (auto& c : conns_) close_conn(c);
     if (child_pid_ > 0) {
       int status = 0;
       kill(child_pid_, SIGKILL);
@@ -187,12 +195,17 @@ SidecarClient::SidecarClient(const std::string& python_exe, int timeout_sec) {
 }
 
 SidecarClient::~SidecarClient() {
-  if (fd_ >= 0) {
-    try {
-      request(OP_SHUTDOWN, {});
-    } catch (...) {
+  {
+    std::lock_guard<std::mutex> lock(pool_mu_);
+    if (!conns_.empty() && conns_[0].fd >= 0) {
+      try {
+        do_request(conns_[0], OP_SHUTDOWN, {});
+      } catch (...) {
+      }
     }
-    close(fd_);
+    for (auto& c : conns_) close_conn(c);
+    conns_.clear();
+    free_.clear();
   }
   if (child_pid_ > 0) {
     int status = 0;
@@ -212,44 +225,179 @@ SidecarClient::~SidecarClient() {
   if (!sock_path_.empty()) unlink(sock_path_.c_str());
 }
 
-void SidecarClient::send_all(const void* buf, size_t n) {
+void SidecarClient::close_conn(Conn& c) {
+  if (c.arena != nullptr) munmap(c.arena, c.arena_size);
+  if (c.arena_fd >= 0) close(c.arena_fd);
+  if (c.fd >= 0) close(c.fd);
+  c = Conn{};
+}
+
+SidecarClient::Conn SidecarClient::make_conn() {
+  Conn c;
+  c.fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (c.fd < 0) throw std::runtime_error("sidecar: socket() failed");
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(c.fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(c.fd);
+    throw std::runtime_error("sidecar: connect failed (worker died?)");
+  }
+  // a wedged worker must surface as an op error (the fallback path),
+  // not an indefinite block holding a pool slot
+  timeval tv{};
+  tv.tv_sec = 600;
+  setsockopt(c.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(c.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+
+  // shared-memory data plane: one memfd per connection, passed ONCE via
+  // SCM_RIGHTS; arena failure degrades to inline streaming, never fails
+  // the connection
+  int afd = memfd_create("srjt-arena", MFD_CLOEXEC);
+  if (afd >= 0 && ftruncate(afd, static_cast<off_t>(kArenaSize)) == 0) {
+    void* p = mmap(nullptr, kArenaSize, PROT_READ | PROT_WRITE, MAP_SHARED, afd, 0);
+    if (p != MAP_FAILED) {
+      uint8_t msg[20];
+      uint32_t op = OP_SET_ARENA;
+      uint64_t plen = 8;
+      uint64_t asize = kArenaSize;
+      std::memcpy(msg, &op, 4);
+      std::memcpy(msg + 4, &plen, 8);
+      std::memcpy(msg + 12, &asize, 8);
+
+      iovec iov{msg, sizeof(msg)};
+      char cbuf[CMSG_SPACE(sizeof(int))] = {};
+      msghdr mh{};
+      mh.msg_iov = &iov;
+      mh.msg_iovlen = 1;
+      mh.msg_control = cbuf;
+      mh.msg_controllen = sizeof(cbuf);
+      cmsghdr* cm = CMSG_FIRSTHDR(&mh);
+      cm->cmsg_level = SOL_SOCKET;
+      cm->cmsg_type = SCM_RIGHTS;
+      cm->cmsg_len = CMSG_LEN(sizeof(int));
+      std::memcpy(CMSG_DATA(cm), &afd, sizeof(int));
+      if (sendmsg(c.fd, &mh, MSG_NOSIGNAL) == static_cast<ssize_t>(sizeof(msg))) {
+        uint8_t rhdr[12];
+        try {
+          recv_all(c.fd, rhdr, sizeof(rhdr));
+          uint32_t status;
+          std::memcpy(&status, rhdr, 4);
+          uint64_t rlen;
+          std::memcpy(&rlen, rhdr + 4, 8);
+          std::vector<uint8_t> sink(rlen);
+          if (rlen) recv_all(c.fd, sink.data(), rlen);
+          if ((status & ~ARENA_FLAG) == STATUS_OK) {
+            c.arena_fd = afd;
+            c.arena = static_cast<uint8_t*>(p);
+            c.arena_size = kArenaSize;
+          }
+        } catch (...) {
+          close(c.fd);
+          munmap(p, kArenaSize);
+          close(afd);
+          throw;
+        }
+      }
+      if (c.arena == nullptr) {
+        munmap(p, kArenaSize);
+      }
+    }
+    if (c.arena == nullptr) close(afd);
+  } else if (afd >= 0) {
+    close(afd);
+  }
+  return c;
+}
+
+size_t SidecarClient::acquire_conn() {
+  std::unique_lock<std::mutex> lock(pool_mu_);
+  while (free_.empty()) pool_cv_.wait(lock);
+  size_t idx = free_.back();
+  free_.pop_back();
+  if (conns_[idx].fd >= 0) return idx;
+  // an unused or previously broken slot: (re-)establish it off-lock
+  lock.unlock();
+  Conn c;
+  try {
+    c = make_conn();
+  } catch (...) {
+    lock.lock();
+    free_.push_back(idx);
+    pool_cv_.notify_one();
+    throw;
+  }
+  lock.lock();
+  conns_[idx] = c;
+  return idx;
+}
+
+void SidecarClient::release_conn(size_t idx, bool broken) {
+  std::lock_guard<std::mutex> lock(pool_mu_);
+  if (broken) {
+    close_conn(conns_[idx]);  // slot reconnects lazily on next acquire
+  }
+  free_.push_back(idx);
+  pool_cv_.notify_one();
+}
+
+void SidecarClient::send_all(int fd, const void* buf, size_t n) {
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   while (n) {
     // MSG_NOSIGNAL: a dead worker must yield an exception (-> host
     // fallback), not a SIGPIPE that kills embedders that don't mask it
-    ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
     if (w <= 0) throw std::runtime_error("sidecar: send failed (worker died or timed out)");
     p += w;
     n -= static_cast<size_t>(w);
   }
 }
 
-void SidecarClient::recv_all(void* buf, size_t n) {
+void SidecarClient::recv_all(int fd, void* buf, size_t n) {
   uint8_t* p = static_cast<uint8_t*>(buf);
   while (n) {
-    ssize_t r = read(fd_, p, n);
+    ssize_t r = read(fd, p, n);
     if (r <= 0) throw std::runtime_error("sidecar: recv failed (worker died or timed out)");
     p += r;
     n -= static_cast<size_t>(r);
   }
 }
 
-std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8_t>& payload) {
+std::vector<uint8_t> SidecarClient::do_request(Conn& c, uint32_t op,
+                                               const std::vector<uint8_t>& payload) {
   uint64_t plen = payload.size();
+  bool via_arena = c.arena != nullptr && plen > 0 && plen <= c.arena_size;
+  uint32_t wire_op = via_arena ? (op | ARENA_FLAG) : op;
   uint8_t hdr[12];
-  std::memcpy(hdr, &op, 4);
+  std::memcpy(hdr, &wire_op, 4);
   std::memcpy(hdr + 4, &plen, 8);
-  send_all(hdr, sizeof(hdr));
-  if (!payload.empty()) send_all(payload.data(), payload.size());
+  if (via_arena) {
+    std::memcpy(c.arena, payload.data(), plen);
+    send_all(c.fd, hdr, sizeof(hdr));
+  } else {
+    send_all(c.fd, hdr, sizeof(hdr));
+    if (!payload.empty()) send_all(c.fd, payload.data(), payload.size());
+  }
 
   uint8_t rhdr[12];
-  recv_all(rhdr, sizeof(rhdr));
+  recv_all(c.fd, rhdr, sizeof(rhdr));
   uint32_t status;
   uint64_t rlen;
   std::memcpy(&status, rhdr, 4);
   std::memcpy(&rlen, rhdr + 4, 8);
+  bool resp_arena = (status & ARENA_FLAG) != 0;
+  status &= ~ARENA_FLAG;
   std::vector<uint8_t> resp(rlen);
-  if (rlen) recv_all(resp.data(), rlen);
+  if (rlen) {
+    if (resp_arena) {
+      if (c.arena == nullptr || rlen > c.arena_size) {
+        throw std::runtime_error("sidecar: arena response without an arena");
+      }
+      std::memcpy(resp.data(), c.arena, rlen);
+    } else {
+      recv_all(c.fd, resp.data(), rlen);
+    }
+  }
   if (status == STATUS_CAST_ERROR) {
     // semantic ANSI failure: payload = i64 row, u8 is_null, utf-8
     // value. Re-raise as srjt::CastError so guarded_cast translates it
@@ -268,9 +416,25 @@ std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8
   return resp;
 }
 
+std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8_t>& payload) {
+  size_t idx = acquire_conn();
+  bool broken = false;
+  try {
+    auto resp = do_request(conns_[idx], op, payload);
+    release_conn(idx, false);
+    return resp;
+  } catch (const CastError&) {
+    release_conn(idx, false);  // semantic failure: transport is healthy
+    throw;
+  } catch (...) {
+    broken = true;
+    release_conn(idx, broken);  // transport failure: drop + lazy reconnect
+    throw;
+  }
+}
+
 void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t n,
                                 int32_t num_keys, float* out_sums, int64_t* out_counts) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   payload.reserve(12 + static_cast<size_t>(n) * 12);
   append_val<uint32_t>(payload, static_cast<uint32_t>(num_keys));
@@ -287,7 +451,6 @@ void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t 
 
 std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
     const NativeTable& table) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_table(payload, table);
   auto resp = request(OP_CONVERT_TO_ROWS, payload);
@@ -329,7 +492,6 @@ std::vector<std::unique_ptr<NativeColumn>> SidecarClient::convert_to_rows(
 NativeTable SidecarClient::convert_from_rows(const NativeColumn& rows,
                                              const int32_t* type_ids, const int32_t* scales,
                                              int32_t ncols) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_val<uint32_t>(payload, static_cast<uint32_t>(ncols));
   append(payload, type_ids, static_cast<size_t>(ncols) * 4);
@@ -351,7 +513,6 @@ NativeTable SidecarClient::convert_from_rows(const NativeColumn& rows,
 
 std::unique_ptr<NativeColumn> SidecarClient::cast_to_integer(const NativeColumn& col,
                                                              bool ansi, int32_t out_type_id) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_val<uint8_t>(payload, ansi ? 1 : 0);
   append_val<int32_t>(payload, out_type_id);
@@ -367,7 +528,6 @@ std::unique_ptr<NativeColumn> SidecarClient::cast_to_integer(const NativeColumn&
 std::unique_ptr<NativeColumn> SidecarClient::cast_to_decimal(const NativeColumn& col,
                                                              bool ansi, int32_t precision,
                                                              int32_t scale) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_val<uint8_t>(payload, ansi ? 1 : 0);
   append_val<int32_t>(payload, precision);
@@ -382,7 +542,6 @@ std::unique_ptr<NativeColumn> SidecarClient::cast_to_decimal(const NativeColumn&
 }
 
 std::unique_ptr<NativeColumn> SidecarClient::zorder(const NativeTable& table) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_table(payload, table);
   auto resp = request(OP_ZORDER, payload);
@@ -394,7 +553,6 @@ std::unique_ptr<NativeColumn> SidecarClient::zorder(const NativeTable& table) {
 
 NativeTable SidecarClient::decimal128_binary(const NativeColumn& a, const NativeColumn& b,
                                              int32_t out_scale, bool divide) {
-  std::lock_guard<std::mutex> lock(op_mu_);
   std::vector<uint8_t> payload;
   append_val<int32_t>(payload, out_scale);
   append_val<uint32_t>(payload, 2);

@@ -1,0 +1,546 @@
+// Executes the JNI tier WITHOUT a JVM (VERDICT r4 missing #1): this
+// harness fabricates the JNIEnv function table declared in
+// stub_jni/jni.h, dlopen()s the srjt shared library exactly as
+// System.loadLibrary would, dlsym()s the Java_* JNIEXPORT symbols the
+// Java API layer (java/src/main/java/...) binds to, and drives them
+// end to end — real L3 marshalling, exception translation, handle
+// registry, CastException construction — against a fake object model.
+//
+// What a real JVM would do differently (documented in NOTES_ROUND5):
+// the JNINativeInterface_ layout is ours, not the JDK's ~230-slot
+// table, local-reference bookkeeping is a no-op (DeleteLocalRef is
+// recorded but nothing is GC'd), and NewStringUTF does not validate
+// modified-UTF-8. Everything srjt_jni.cc *calls* behaves per the JNI
+// spec: exceptions become pending state, array regions copy, critical
+// sections pin.
+//
+// Usage: jni_harness <libsrjt.so> <some.parquet> <expected_rows>
+#include <jni.h>
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dlfcn.h>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// fake object model
+// ---------------------------------------------------------------------------
+
+struct FakeObj : _jobject {
+  enum Kind { CLASS, STRING, BYTE_ARR, INT_ARR, LONG_ARR, OBJ_ARR, THROWABLE };
+  Kind kind;
+  std::string name;  // CLASS: binary name; STRING: utf8 chars
+  std::vector<int8_t> bytes;
+  std::vector<int32_t> ints;
+  std::vector<int64_t> longs;
+  std::vector<FakeObj*> objs;
+  std::string msg;  // THROWABLE message
+  int32_t row = -1; // THROWABLE CastException row
+};
+
+struct FakeMethod : _jmethodID {
+  std::string cls;
+  std::string name;
+  std::string sig;
+};
+
+std::vector<std::unique_ptr<FakeObj>> g_heap;
+std::vector<std::unique_ptr<FakeMethod>> g_methods;
+std::map<std::string, FakeObj*> g_classes;
+FakeObj* g_pending = nullptr;  // pending exception
+int g_local_ref_deletes = 0;
+
+FakeObj* alloc(FakeObj::Kind k) {
+  g_heap.push_back(std::make_unique<FakeObj>());
+  g_heap.back()->kind = k;
+  return g_heap.back().get();
+}
+
+FakeObj* as_fake(jobject o) { return static_cast<FakeObj*>(o); }
+
+// ---------------------------------------------------------------------------
+// JNINativeInterface_ implementation
+// ---------------------------------------------------------------------------
+
+jclass fn_FindClass(JNIEnv*, const char* name) {
+  // a fake "classpath" that resolves every name — the veneer's
+  // CudfException-then-RuntimeException fallback is exercised by the
+  // separate g_hide_cudf_exception toggle below
+  auto it = g_classes.find(name);
+  if (it != g_classes.end()) return it->second;
+  FakeObj* c = alloc(FakeObj::CLASS);
+  c->name = name;
+  g_classes[name] = c;
+  return c;
+}
+
+bool g_hide_cudf_exception = false;
+
+jclass fn_FindClass_gated(JNIEnv* env, const char* name) {
+  if (g_hide_cudf_exception && std::strcmp(name, "ai/rapids/cudf/CudfException") == 0) {
+    // JNI spec: a failed FindClass leaves NoClassDefFoundError pending
+    FakeObj* t = alloc(FakeObj::THROWABLE);
+    t->name = "java/lang/NoClassDefFoundError";
+    t->msg = name;
+    g_pending = t;
+    return nullptr;
+  }
+  return fn_FindClass(env, name);
+}
+
+jint fn_ThrowNew(JNIEnv*, jclass cls, const char* msg) {
+  FakeObj* t = alloc(FakeObj::THROWABLE);
+  t->name = as_fake(cls)->name;
+  t->msg = msg == nullptr ? "" : msg;
+  g_pending = t;
+  return 0;
+}
+
+jsize fn_GetArrayLength(JNIEnv*, jarray a) {
+  FakeObj* f = as_fake(a);
+  switch (f->kind) {
+    case FakeObj::BYTE_ARR: return static_cast<jsize>(f->bytes.size());
+    case FakeObj::INT_ARR: return static_cast<jsize>(f->ints.size());
+    case FakeObj::LONG_ARR: return static_cast<jsize>(f->longs.size());
+    case FakeObj::OBJ_ARR: return static_cast<jsize>(f->objs.size());
+    default: return 0;
+  }
+}
+
+jobject fn_GetObjectArrayElement(JNIEnv*, jobjectArray a, jsize i) {
+  return as_fake(a)->objs[static_cast<size_t>(i)];
+}
+
+const char* fn_GetStringUTFChars(JNIEnv*, jstring s, jboolean* copy) {
+  if (copy != nullptr) *copy = JNI_FALSE;
+  return as_fake(s)->name.c_str();
+}
+
+void fn_ReleaseStringUTFChars(JNIEnv*, jstring, const char*) {}
+
+void fn_DeleteLocalRef(JNIEnv*, jobject) { g_local_ref_deletes++; }
+
+jbyteArray fn_NewByteArray(JNIEnv*, jsize n) {
+  FakeObj* a = alloc(FakeObj::BYTE_ARR);
+  a->bytes.resize(static_cast<size_t>(n));
+  return a;
+}
+
+jlongArray fn_NewLongArray(JNIEnv*, jsize n) {
+  FakeObj* a = alloc(FakeObj::LONG_ARR);
+  a->longs.resize(static_cast<size_t>(n));
+  return a;
+}
+
+void fn_SetLongArrayRegion(JNIEnv*, jlongArray a, jsize off, jsize n, const jlong* src) {
+  std::memcpy(as_fake(a)->longs.data() + off, src, static_cast<size_t>(n) * 8);
+}
+
+void* fn_GetPrimitiveArrayCritical(JNIEnv*, jarray a, jboolean* copy) {
+  if (copy != nullptr) *copy = JNI_FALSE;
+  FakeObj* f = as_fake(a);
+  switch (f->kind) {
+    case FakeObj::BYTE_ARR: return f->bytes.data();
+    case FakeObj::INT_ARR: return f->ints.data();
+    case FakeObj::LONG_ARR: return f->longs.data();
+    default: return nullptr;
+  }
+}
+
+void fn_ReleasePrimitiveArrayCritical(JNIEnv*, jarray, void*, jint) {}
+
+void fn_GetByteArrayRegion(JNIEnv*, jbyteArray a, jsize off, jsize n, jbyte* dst) {
+  std::memcpy(dst, as_fake(a)->bytes.data() + off, static_cast<size_t>(n));
+}
+
+void fn_SetByteArrayRegion(JNIEnv*, jbyteArray a, jsize off, jsize n, const jbyte* src) {
+  std::memcpy(as_fake(a)->bytes.data() + off, src, static_cast<size_t>(n));
+}
+
+void fn_GetIntArrayRegion(JNIEnv*, jintArray a, jsize off, jsize n, jint* dst) {
+  std::memcpy(dst, as_fake(a)->ints.data() + off, static_cast<size_t>(n) * 4);
+}
+
+void fn_GetLongArrayRegion(JNIEnv*, jlongArray a, jsize off, jsize n, jlong* dst) {
+  std::memcpy(dst, as_fake(a)->longs.data() + off, static_cast<size_t>(n) * 8);
+}
+
+jmethodID fn_GetMethodID(JNIEnv*, jclass cls, const char* name, const char* sig) {
+  g_methods.push_back(std::make_unique<FakeMethod>());
+  FakeMethod* m = g_methods.back().get();
+  m->cls = as_fake(cls)->name;
+  m->name = name;
+  m->sig = sig;
+  return m;
+}
+
+jstring fn_NewStringUTF(JNIEnv*, const char* s) {
+  FakeObj* o = alloc(FakeObj::STRING);
+  o->name = s;
+  return o;
+}
+
+jobject fn_NewObject(JNIEnv*, jclass cls, jmethodID mid, ...) {
+  FakeMethod* m = static_cast<FakeMethod*>(mid);
+  FakeObj* o = alloc(FakeObj::THROWABLE);
+  o->name = as_fake(cls)->name;
+  // the one constructor the veneer builds reflectively:
+  // CastException(String, int)
+  if (m->sig == "(Ljava/lang/String;I)V") {
+    va_list ap;
+    va_start(ap, mid);
+    jobject s = va_arg(ap, jobject);
+    jint row = va_arg(ap, jint);
+    va_end(ap);
+    o->msg = as_fake(s)->name;
+    o->row = row;
+  }
+  return o;
+}
+
+jint fn_Throw(JNIEnv*, jthrowable t) {
+  g_pending = as_fake(t);
+  return 0;
+}
+
+jboolean fn_ExceptionCheck(JNIEnv*) { return g_pending != nullptr ? JNI_TRUE : JNI_FALSE; }
+
+void fn_ExceptionClear(JNIEnv*) { g_pending = nullptr; }
+
+JNINativeInterface_ make_table() {
+  JNINativeInterface_ t;
+  t.FindClass = fn_FindClass_gated;
+  t.ThrowNew = fn_ThrowNew;
+  t.GetArrayLength = fn_GetArrayLength;
+  t.GetObjectArrayElement = fn_GetObjectArrayElement;
+  t.GetStringUTFChars = fn_GetStringUTFChars;
+  t.ReleaseStringUTFChars = fn_ReleaseStringUTFChars;
+  t.DeleteLocalRef = fn_DeleteLocalRef;
+  t.NewByteArray = fn_NewByteArray;
+  t.NewLongArray = fn_NewLongArray;
+  t.SetLongArrayRegion = fn_SetLongArrayRegion;
+  t.GetPrimitiveArrayCritical = fn_GetPrimitiveArrayCritical;
+  t.ReleasePrimitiveArrayCritical = fn_ReleasePrimitiveArrayCritical;
+  t.GetByteArrayRegion = fn_GetByteArrayRegion;
+  t.SetByteArrayRegion = fn_SetByteArrayRegion;
+  t.GetIntArrayRegion = fn_GetIntArrayRegion;
+  t.GetLongArrayRegion = fn_GetLongArrayRegion;
+  t.GetMethodID = fn_GetMethodID;
+  t.NewStringUTF = fn_NewStringUTF;
+  t.NewObject = fn_NewObject;
+  t.Throw = fn_Throw;
+  t.ExceptionCheck = fn_ExceptionCheck;
+  t.ExceptionClear = fn_ExceptionClear;
+  return t;
+}
+
+// ---------------------------------------------------------------------------
+// harness plumbing
+// ---------------------------------------------------------------------------
+
+int g_failures = 0;
+
+#define CHECK(cond, what)                                              \
+  do {                                                                 \
+    if (cond) {                                                        \
+      std::printf("ok   %s\n", what);                                  \
+    } else {                                                           \
+      std::printf("FAIL %s (%s:%d)\n", what, __FILE__, __LINE__);      \
+      g_failures++;                                                    \
+    }                                                                  \
+  } while (0)
+
+FakeObj* take_pending() {
+  FakeObj* p = g_pending;
+  g_pending = nullptr;
+  return p;
+}
+
+jobjectArray make_string_array(JNIEnv* env, const std::vector<std::string>& v) {
+  FakeObj* a = alloc(FakeObj::OBJ_ARR);
+  for (const std::string& s : v) {
+    a->objs.push_back(as_fake(env->NewStringUTF(s.c_str())));
+  }
+  return a;
+}
+
+jintArray make_int_array(const std::vector<int32_t>& v) {
+  FakeObj* a = alloc(FakeObj::INT_ARR);
+  a->ints = v;
+  return a;
+}
+
+jlongArray make_long_array(const std::vector<int64_t>& v) {
+  FakeObj* a = alloc(FakeObj::LONG_ARR);
+  a->longs = v;
+  return a;
+}
+
+template <typename T>
+T sym(void* so, const char* name) {
+  void* p = dlsym(so, name);
+  if (p == nullptr) {
+    std::printf("FAIL dlsym %s: %s\n", name, dlerror());
+    g_failures++;
+  }
+  return reinterpret_cast<T>(p);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <libsrjt.so> <file.parquet> <expected_rows>\n", argv[0]);
+    return 2;
+  }
+  void* so = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (so == nullptr) {
+    std::fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  std::ifstream f(argv[2], std::ios::binary);
+  std::vector<char> parquet((std::istreambuf_iterator<char>(f)),
+                            std::istreambuf_iterator<char>());
+  const int64_t expected_rows = std::atoll(argv[3]);
+
+  JNINativeInterface_ table = make_table();
+  JNIEnv env_storage{&table};
+  JNIEnv* env = &env_storage;
+
+  // --- symbol resolution (the exact names a JVM would bind) --------------
+  using J = JNIEnv*;
+  auto footer_read = sym<jlong (*)(J, jclass, jlong, jlong, jlong, jlong, jobjectArray,
+                                   jintArray, jintArray, jint, jboolean)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ParquetFooter_readAndFilterNative");
+  auto footer_rows = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumRowsNative");
+  auto footer_cols = sym<jint (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ParquetFooter_getNumColumnsNative");
+  auto footer_ser = sym<jbyteArray (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ParquetFooter_serializeThriftFileNative");
+  auto footer_close = sym<void (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ParquetFooter_closeNative");
+  auto hmb_alloc = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_HostMemoryBuffer_allocateNative");
+  auto hmb_addr = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_HostMemoryBuffer_addressNative");
+  auto hmb_free = sym<void (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_HostMemoryBuffer_freeNative");
+  auto hmb_set = sym<void (*)(J, jclass, jlong, jlong, jbyteArray, jlong, jlong)>(
+      so, "Java_ai_rapids_cudf_HostMemoryBuffer_setBytesNative");
+  auto hmb_get = sym<void (*)(J, jclass, jbyteArray, jlong, jlong, jlong, jlong)>(
+      so, "Java_ai_rapids_cudf_HostMemoryBuffer_getBytesNative");
+  auto col_create = sym<jlong (*)(J, jclass, jint, jint, jlong, jlong, jlong, jlong, jlong,
+                                  jlong, jlong)>(
+      so, "Java_ai_rapids_cudf_ColumnVector_createNative");
+  auto col_type = sym<jint (*)(J, jclass, jlong)>(so, "Java_ai_rapids_cudf_ColumnView_typeNative");
+  auto col_size = sym<jlong (*)(J, jclass, jlong)>(so, "Java_ai_rapids_cudf_ColumnView_sizeNative");
+  auto col_close = sym<void (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_ColumnView_closeNative");
+  auto col_data_bytes = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_ColumnVector_dataBytesNative");
+  auto col_copy_data = sym<void (*)(J, jclass, jlong, jlong, jlong)>(
+      so, "Java_ai_rapids_cudf_ColumnVector_copyDataNative");
+  auto table_create = sym<jlong (*)(J, jclass, jlongArray)>(
+      so, "Java_ai_rapids_cudf_Table_createNative");
+  auto table_rows = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_Table_numRowsNative");
+  auto table_cols = sym<jint (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_Table_numColumnsNative");
+  auto table_col = sym<jlong (*)(J, jclass, jlong, jint)>(
+      so, "Java_ai_rapids_cudf_Table_columnNative");
+  auto table_close = sym<void (*)(J, jclass, jlong)>(
+      so, "Java_ai_rapids_cudf_Table_closeNative");
+  auto to_rows_batched = sym<jlongArray (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_RowConversion_convertToRowsBatchedNative");
+  auto from_rows = sym<jlong (*)(J, jclass, jlong, jintArray, jintArray)>(
+      so, "Java_com_nvidia_spark_rapids_jni_RowConversion_convertFromRowsNative");
+  auto cast_to_int = sym<jlong (*)(J, jclass, jlong, jboolean, jint)>(
+      so, "Java_com_nvidia_spark_rapids_jni_CastStrings_toIntegerNative");
+  auto zorder = sym<jlong (*)(J, jclass, jlong)>(
+      so, "Java_com_nvidia_spark_rapids_jni_ZOrder_interleaveBitsNative");
+  auto dec_mul = sym<jlong (*)(J, jclass, jlong, jlong, jint)>(
+      so, "Java_com_nvidia_spark_rapids_jni_DecimalUtils_multiply128Native");
+  auto live_handles = sym<int64_t (*)()>(so, "srjt_live_handles");
+  if (g_failures != 0) return 1;
+
+  const int64_t live_at_start = live_handles();
+
+  // --- 1. ParquetFooter end to end ---------------------------------------
+  {
+    jobjectArray names = make_string_array(env, {"a", "b"});
+    jintArray nc = make_int_array({0, 0});
+    jintArray tags = make_int_array({0, 0});  // Tag.VALUE
+    jlong h = footer_read(env, nullptr, reinterpret_cast<jlong>(parquet.data()),
+                          static_cast<jlong>(parquet.size()), 0,
+                          static_cast<jlong>(parquet.size()), names, nc, tags, 2, JNI_FALSE);
+    CHECK(h != 0 && g_pending == nullptr, "footer readAndFilter returns a handle");
+    CHECK(footer_rows(env, nullptr, h) == expected_rows, "footer num_rows matches");
+    CHECK(footer_cols(env, nullptr, h) == 2, "footer num_columns pruned to 2");
+    jbyteArray ser = footer_ser(env, nullptr, h);
+    CHECK(ser != nullptr && fn_GetArrayLength(env, ser) > 8,
+          "footer serializeThriftFile yields bytes");
+    if (ser != nullptr) {
+      FakeObj* sa = as_fake(ser);
+      CHECK(std::memcmp(sa->bytes.data(), "PAR1", 4) == 0,
+            "serialized footer is PAR1-framed");
+    }
+    footer_close(env, nullptr, h);
+    // use-after-close must throw through the veneer, not crash
+    jlong bad = footer_rows(env, nullptr, h);
+    FakeObj* ex = take_pending();
+    CHECK(bad < 0 && ex != nullptr && ex->name == "ai/rapids/cudf/CudfException",
+          "footer use-after-close raises CudfException");
+    // the CudfException-missing fallback path (trimmed jar)
+    g_hide_cudf_exception = true;
+    footer_rows(env, nullptr, h);
+    ex = take_pending();
+    CHECK(ex != nullptr && ex->name == "java/lang/RuntimeException",
+          "exception falls back to RuntimeException when CudfException is off classpath");
+    g_hide_cudf_exception = false;
+  }
+
+  // --- 2. HostMemoryBuffer -----------------------------------------------
+  {
+    jlong h = hmb_alloc(env, nullptr, 128);
+    CHECK(h != 0, "host buffer allocates");
+    jlong addr = hmb_addr(env, nullptr, h);
+    CHECK(addr != 0, "host buffer has an address");
+    FakeObj* src = as_fake(fn_NewByteArray(env, 128));
+    for (int i = 0; i < 128; i++) src->bytes[static_cast<size_t>(i)] = static_cast<int8_t>(i ^ 0x5A);
+    hmb_set(env, nullptr, addr, 0, src, 0, 128);
+    FakeObj* dst = as_fake(fn_NewByteArray(env, 128));
+    hmb_get(env, nullptr, dst, 0, addr, 0, 128);
+    CHECK(dst->bytes == src->bytes, "host buffer set/get roundtrips");
+    hmb_free(env, nullptr, h);
+  }
+
+  // --- 3. ColumnVector / Table / RowConversion ---------------------------
+  {
+    const int64_t n = 100;
+    std::vector<int32_t> c0(n), c1(n);
+    for (int64_t i = 0; i < n; i++) {
+      c0[static_cast<size_t>(i)] = static_cast<int32_t>(i * 3 - 50);
+      c1[static_cast<size_t>(i)] = static_cast<int32_t>(i * i);
+    }
+    jlong h0 = col_create(env, nullptr, 3 /*INT32*/, 0, n,
+                          reinterpret_cast<jlong>(c0.data()), n * 4, 0, 0, 0, 0);
+    jlong h1 = col_create(env, nullptr, 3, 0, n, reinterpret_cast<jlong>(c1.data()), n * 4, 0,
+                          0, 0, 0);
+    CHECK(h0 != 0 && h1 != 0, "INT32 columns create");
+    CHECK(col_type(env, nullptr, h0) == 3 && col_size(env, nullptr, h0) == n,
+          "column type/size readback");
+    jlong th = table_create(env, nullptr, make_long_array({h0, h1}));
+    CHECK(th != 0 && table_rows(env, nullptr, th) == n && table_cols(env, nullptr, th) == 2,
+          "table creates over column handles");
+
+    jlongArray batches = to_rows_batched(env, nullptr, th);
+    CHECK(batches != nullptr && fn_GetArrayLength(env, batches) == 1,
+          "convertToRowsBatched yields one batch");
+    jlong rows_h = as_fake(batches)->longs[0];
+    jlong back = from_rows(env, nullptr, rows_h, make_int_array({3, 3}),
+                           make_int_array({0, 0}));
+    CHECK(back != 0 && table_rows(env, nullptr, back) == n, "convertFromRows rebuilds table");
+    jlong b0 = table_col(env, nullptr, back, 0);
+    std::vector<int32_t> got(n);
+    CHECK(col_data_bytes(env, nullptr, b0) == n * 4, "roundtrip column data size");
+    col_copy_data(env, nullptr, b0, reinterpret_cast<jlong>(got.data()), n * 4);
+    CHECK(got == c0 && g_pending == nullptr, "row transcode roundtrips column 0 bytes");
+
+    col_close(env, nullptr, b0);
+    table_close(env, nullptr, back);
+    col_close(env, nullptr, rows_h);
+    table_close(env, nullptr, th);
+    col_close(env, nullptr, h0);
+    col_close(env, nullptr, h1);
+  }
+
+  // --- 4. CastStrings: success + ANSI CastException ----------------------
+  {
+    const char chars[] = "12xyz34";
+    std::vector<int32_t> offs = {0, 2, 5, 7};  // "12", "xyz", "34"
+    jlong sh = col_create(env, nullptr, 23 /*STRING*/, 0, 3, 0, 0, 0,
+                          reinterpret_cast<jlong>(offs.data()),
+                          reinterpret_cast<jlong>(chars), 7);
+    CHECK(sh != 0, "STRING column creates");
+    // non-ANSI: bad row nulls out, call succeeds
+    jlong ok = cast_to_int(env, nullptr, sh, JNI_FALSE, 3);
+    CHECK(ok != 0 && g_pending == nullptr, "non-ANSI cast returns a column");
+    std::vector<int32_t> vals(3);
+    col_copy_data(env, nullptr, ok, reinterpret_cast<jlong>(vals.data()), 12);
+    CHECK(vals[0] == 12 && vals[2] == 34, "cast values marshal back");
+    col_close(env, nullptr, ok);
+    // ANSI: the veneer must build CastException("xyz", 1) reflectively
+    jlong bad = cast_to_int(env, nullptr, sh, JNI_TRUE, 3);
+    FakeObj* ex = take_pending();
+    CHECK(bad == 0 && ex != nullptr &&
+              ex->name == "com/nvidia/spark/rapids/jni/CastException" && ex->row == 1 &&
+              ex->msg == "xyz",
+          "ANSI cast failure raises CastException(row=1, value=xyz)");
+    col_close(env, nullptr, sh);
+  }
+
+  // --- 5. ZOrder ---------------------------------------------------------
+  {
+    std::vector<int32_t> a = {0, 1, 2, 3}, b2 = {3, 2, 1, 0};
+    jlong h0 = col_create(env, nullptr, 3, 0, 4, reinterpret_cast<jlong>(a.data()), 16, 0, 0,
+                          0, 0);
+    jlong h1 = col_create(env, nullptr, 3, 0, 4, reinterpret_cast<jlong>(b2.data()), 16, 0, 0,
+                          0, 0);
+    jlong th = table_create(env, nullptr, make_long_array({h0, h1}));
+    jlong zh = zorder(env, nullptr, th);
+    CHECK(zh != 0 && col_type(env, nullptr, zh) == 24 /*LIST*/ &&
+              col_size(env, nullptr, zh) == 4,
+          "zorder interleaveBits yields LIST column");
+    col_close(env, nullptr, zh);
+    table_close(env, nullptr, th);
+    col_close(env, nullptr, h0);
+    col_close(env, nullptr, h1);
+  }
+
+  // --- 6. DecimalUtils multiply128 ---------------------------------------
+  {
+    // DECIMAL128 rows are 16-byte little-endian two's-complement
+    std::vector<int64_t> a = {7, 0}, b2 = {6, 0};  // one row each: lo, hi
+    jlong h0 = col_create(env, nullptr, 28 /*DECIMAL128*/, 0, 1,
+                          reinterpret_cast<jlong>(a.data()), 16, 0, 0, 0, 0);
+    jlong h1 = col_create(env, nullptr, 28, 0, 1, reinterpret_cast<jlong>(b2.data()), 16, 0,
+                          0, 0, 0);
+    jlong ph = dec_mul(env, nullptr, h0, h1, 0);
+    CHECK(ph != 0 && g_pending == nullptr, "decimal128 multiply returns");
+    if (ph != 0) {
+      // product table: [overflow BOOL8, product DECIMAL128]
+      jint ncols = table_cols(env, nullptr, ph);
+      jlong prod_col = ncols == 2 ? table_col(env, nullptr, ph, 1) : 0;
+      if (prod_col != 0) {
+        std::vector<int64_t> prod(2);
+        col_copy_data(env, nullptr, prod_col, reinterpret_cast<jlong>(prod.data()), 16);
+        CHECK(prod[0] == 42 && prod[1] == 0, "7 * 6 == 42 through the JNI tier");
+        col_close(env, nullptr, prod_col);
+      } else {
+        // single-column product contract
+        std::vector<int64_t> prod(2);
+        col_copy_data(env, nullptr, ph, reinterpret_cast<jlong>(prod.data()), 16);
+        CHECK(prod[0] == 42 && prod[1] == 0, "7 * 6 == 42 through the JNI tier");
+      }
+      table_close(env, nullptr, ph);
+    }
+    col_close(env, nullptr, h0);
+    col_close(env, nullptr, h1);
+  }
+
+  // --- 7. handle-leak accounting across everything above -----------------
+  CHECK(live_handles() == live_at_start, "no handles leaked by the JNI tier");
+  CHECK(g_local_ref_deletes > 0, "veneer deletes its local refs");
+
+  std::printf("%s: %d failure(s)\n", g_failures == 0 ? "PASS" : "FAIL", g_failures);
+  return g_failures == 0 ? 0 : 1;
+}

@@ -24,7 +24,11 @@ from ..ops.expressions import col, lit
 from ..ops.join import left_semi_join
 from ..ops.sort import sort_by_key
 
-__all__ = ["gen_store", "gen_web", "q3", "q55", "q55_distributed", "q95"]
+__all__ = [
+    "gen_store", "gen_store_wide", "gen_web",
+    "q3", "q7", "q7_distributed", "q19", "q19_distributed",
+    "q42", "q52", "q52_distributed", "q55", "q55_distributed", "q95",
+]
 
 
 
@@ -78,7 +82,111 @@ def gen_store(num_sales: int, seed: int = 42) -> Dict[str, Table]:
         ],
         ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"],
     )
+    # drawn AFTER the fact columns so adding it (round 5, q42) left every
+    # pre-existing column's random sequence untouched
+    item = Table(
+        list(item.columns) + [_int_col(rng.integers(1, 12, n_items))],  # i_category_id
+        list(item.names) + ["i_category_id"],
+    )
     return {"store_sales": store_sales, "date_dim": date_dim, "item": item}
+
+
+def gen_store_wide(num_sales: int, seed: int = 42) -> Dict[str, Table]:
+    """Full store-sales star for the q7/q19 class: fact + date_dim +
+    item + customer_demographics + promotion + customer +
+    customer_address + store. String dimension values (gender, zip
+    prefixes, channel flags) are dictionary codes in int lanes, as
+    everywhere in this tier."""
+    rng = np.random.default_rng(seed)
+    n_dates, n_items = 365 * 5, 1000
+    n_cdemo, n_promo, n_cust, n_addr, n_store = 200, 50, 2000, 500, 20
+
+    date_dim = Table(
+        [
+            _int_col(np.arange(n_dates)),  # d_date_sk
+            _int_col(1998 + np.arange(n_dates) // 365),  # d_year
+            _int_col(1 + (np.arange(n_dates) % 365) // 31),  # d_moy
+        ],
+        ["d_date_sk", "d_year", "d_moy"],
+    )
+    item = Table(
+        [
+            _int_col(np.arange(n_items)),  # i_item_sk
+            _int_col(rng.permutation(n_items)),  # i_item_id (distinct code)
+            _int_col(rng.integers(1, 500, n_items)),  # i_brand_id
+            _int_col(rng.integers(1, 1000, n_items)),  # i_manufact_id
+            _int_col(rng.integers(1, 100, n_items)),  # i_manager_id
+        ],
+        ["i_item_sk", "i_item_id", "i_brand_id", "i_manufact_id", "i_manager_id"],
+    )
+    customer_demographics = Table(
+        [
+            _int_col(np.arange(n_cdemo)),  # cd_demo_sk
+            _int_col(rng.integers(0, 2, n_cdemo)),  # cd_gender (code: 1 = 'M')
+            _int_col(rng.integers(0, 5, n_cdemo)),  # cd_marital_status (2 = 'S')
+            _int_col(rng.integers(0, 7, n_cdemo)),  # cd_education_status (3 = College)
+        ],
+        ["cd_demo_sk", "cd_gender", "cd_marital_status", "cd_education_status"],
+    )
+    promotion = Table(
+        [
+            _int_col(np.arange(n_promo)),  # p_promo_sk
+            _int_col(rng.integers(0, 2, n_promo)),  # p_channel_email (0 = 'N')
+            _int_col(rng.integers(0, 2, n_promo)),  # p_channel_event (0 = 'N')
+        ],
+        ["p_promo_sk", "p_channel_email", "p_channel_event"],
+    )
+    customer = Table(
+        [
+            _int_col(np.arange(n_cust)),  # c_customer_sk
+            _int_col(rng.integers(0, n_addr, n_cust)),  # c_current_addr_sk
+        ],
+        ["c_customer_sk", "c_current_addr_sk"],
+    )
+    customer_address = Table(
+        [
+            _int_col(np.arange(n_addr)),  # ca_address_sk
+            _int_col(rng.integers(0, 300, n_addr)),  # ca_zip5 (5-digit prefix code)
+        ],
+        ["ca_address_sk", "ca_zip5"],
+    )
+    store = Table(
+        [
+            _int_col(np.arange(n_store)),  # s_store_sk
+            _int_col(rng.integers(0, 300, n_store)),  # s_zip5
+        ],
+        ["s_store_sk", "s_zip5"],
+    )
+    store_sales = Table(
+        [
+            _int_col(rng.integers(0, n_dates, num_sales)),  # ss_sold_date_sk
+            _int_col(rng.integers(0, n_items, num_sales)),  # ss_item_sk
+            _int_col(rng.integers(0, n_cdemo, num_sales)),  # ss_cdemo_sk
+            _int_col(rng.integers(0, n_promo, num_sales)),  # ss_promo_sk
+            _int_col(rng.integers(0, n_cust, num_sales)),  # ss_customer_sk
+            _int_col(rng.integers(0, n_store, num_sales)),  # ss_store_sk
+            _int_col(rng.integers(1, 100, num_sales)),  # ss_quantity
+            _f64_col(rng.uniform(1, 200, num_sales).round(2)),  # ss_list_price
+            _f64_col(rng.uniform(0, 50, num_sales).round(2)),  # ss_coupon_amt
+            _f64_col(rng.uniform(1, 150, num_sales).round(2)),  # ss_sales_price
+            _f64_col(rng.uniform(1, 1000, num_sales).round(2)),  # ss_ext_sales_price
+        ],
+        [
+            "ss_sold_date_sk", "ss_item_sk", "ss_cdemo_sk", "ss_promo_sk",
+            "ss_customer_sk", "ss_store_sk", "ss_quantity", "ss_list_price",
+            "ss_coupon_amt", "ss_sales_price", "ss_ext_sales_price",
+        ],
+    )
+    return {
+        "store_sales": store_sales,
+        "date_dim": date_dim,
+        "item": item,
+        "customer_demographics": customer_demographics,
+        "promotion": promotion,
+        "customer": customer,
+        "customer_address": customer_address,
+        "store": store,
+    }
 
 
 def q3(tables: Dict[str, Table], manufact_id: int = 128, month: int = 11) -> Table:
@@ -151,6 +259,387 @@ def _q3_pipeline(year_lo: int, n_years: int, n_brands: int, n_dates: int, n_item
     )
 
 
+
+
+def q7(
+    tables: Dict[str, Table],
+    gender: int = 1,
+    marital: int = 2,
+    education: int = 3,
+    year: int = 2000,
+) -> Table:
+    """TPC-DS q7 — the 4-way star join with FLOAT64 AVG aggregates. SQL:
+
+        SELECT i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+        FROM store_sales, customer_demographics, date_dim, item, promotion
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_cdemo_sk = cd_demo_sk AND ss_promo_sk = p_promo_sk
+          AND cd_gender = :g AND cd_marital_status = :m
+          AND cd_education_status = :e
+          AND (p_channel_email = 'N' OR p_channel_event = 'N')
+          AND d_year = :y
+        GROUP BY i_item_id ORDER BY i_item_id
+
+    All four dimension joins, the demographic/promotion/date filters,
+    and the four EXACT means (integer mean via the limb divider, f64
+    means via the windowed accumulator) lower through ONE compiled
+    program."""
+    item = tables["item"]
+    n_item_ids = int(jnp.max(item.column("i_item_id").data)) + 1
+    n_dates = int(jnp.max(tables["date_dim"].column("d_date_sk").data)) + 1
+    n_items = int(jnp.max(item.column("i_item_sk").data)) + 1
+    n_cdemo = int(jnp.max(tables["customer_demographics"].column("cd_demo_sk").data)) + 1
+    n_promo = int(jnp.max(tables["promotion"].column("p_promo_sk").data)) + 1
+    agg = _q7_pipeline(
+        n_item_ids, n_dates, n_items, n_cdemo, n_promo,
+        int(gender), int(marital), int(education), int(year),
+    )(
+        tables["store_sales"],
+        {
+            "date_dim": tables["date_dim"],
+            "item": item,
+            "customer_demographics": tables["customer_demographics"],
+            "promotion": tables["promotion"],
+        },
+    )
+    return sort_by_key(agg, agg.select(["i_item_id"]), ascending=[True])
+
+
+@functools.lru_cache(maxsize=16)
+def _q7_pipeline(n_item_ids: int, n_dates: int, n_items: int, n_cdemo: int,
+                 n_promo: int, gender: int, marital: int, education: int, year: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
+
+    return compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk", build_key="d_date_sk",
+                    num_keys=n_dates,
+                    build_filter=col("d_year") == lit(np.int32(year)),
+                ),
+                JoinSpec(
+                    build="customer_demographics", probe_key="ss_cdemo_sk",
+                    build_key="cd_demo_sk", num_keys=n_cdemo,
+                    build_filter=(col("cd_gender") == lit(np.int32(gender)))
+                    & (col("cd_marital_status") == lit(np.int32(marital)))
+                    & (col("cd_education_status") == lit(np.int32(education))),
+                ),
+                JoinSpec(
+                    build="promotion", probe_key="ss_promo_sk", build_key="p_promo_sk",
+                    num_keys=n_promo,
+                    build_filter=(col("p_channel_email") == lit(np.int32(0)))
+                    | (col("p_channel_event") == lit(np.int32(0))),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk", build_key="i_item_sk",
+                    num_keys=n_items, payload=("i_item_id",),
+                ),
+            ),
+            group_by=(GroupKey("i_item_id", n_item_ids),),
+            aggregates=(
+                Agg("ss_quantity", "mean", "agg1"),
+                Agg("ss_list_price", "mean", "agg2"),
+                Agg("ss_coupon_amt", "mean", "agg3"),
+                Agg("ss_sales_price", "mean", "agg4"),
+            ),
+        )
+    )
+
+
+def q7_distributed(
+    tables: Dict[str, Table], mesh,
+    gender: int = 1, marital: int = 2, education: int = 3, year: int = 2000,
+) -> Table:
+    """q7 on the distributed Table operators: pre-filtered dims join the
+    sharded fact, then a distributed group-by with EXACT means (partial
+    limb sums + counts merge across shards, one division at the end) —
+    results must be BIT-identical to single-chip ``q7``."""
+    from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+
+    ss = tables["store_sales"]
+    dsel = (col("d_year") == lit(np.int32(year))).evaluate(tables["date_dim"])
+    d1 = copying.apply_boolean_mask(tables["date_dim"], dsel).select(["d_date_sk"])
+    d1 = Table(d1.columns, ["ss_sold_date_sk"])
+    cd = tables["customer_demographics"]
+    csel = (
+        (col("cd_gender") == lit(np.int32(gender)))
+        & (col("cd_marital_status") == lit(np.int32(marital)))
+        & (col("cd_education_status") == lit(np.int32(education)))
+    ).evaluate(cd)
+    c1 = copying.apply_boolean_mask(cd, csel).select(["cd_demo_sk"])
+    c1 = Table(c1.columns, ["ss_cdemo_sk"])
+    pr = tables["promotion"]
+    psel = (
+        (col("p_channel_email") == lit(np.int32(0)))
+        | (col("p_channel_event") == lit(np.int32(0)))
+    ).evaluate(pr)
+    p1 = copying.apply_boolean_mask(pr, psel).select(["p_promo_sk"])
+    p1 = Table(p1.columns, ["ss_promo_sk"])
+    i1 = tables["item"].select(["i_item_sk", "i_item_id"])
+    i1 = Table(i1.columns, ["ss_item_sk", "i_item_id"])
+
+    j, o1 = distributed_join_table(ss, d1, on=["ss_sold_date_sk"], mesh=mesh, how="inner")
+    j, o2 = distributed_join_table(j, c1, on=["ss_cdemo_sk"], mesh=mesh, how="inner")
+    j, o3 = distributed_join_table(j, p1, on=["ss_promo_sk"], mesh=mesh, how="inner")
+    j, o4 = distributed_join_table(j, i1, on=["ss_item_sk"], mesh=mesh, how="inner")
+    if o1 or o2 or o3 or o4:
+        raise RuntimeError("join capacity overflow — raise capacity")
+    agg, o5 = distributed_groupby_table(
+        j, ["i_item_id"],
+        [
+            ("ss_quantity", "mean", "agg1"),
+            ("ss_list_price", "mean", "agg2"),
+            ("ss_coupon_amt", "mean", "agg3"),
+            ("ss_sales_price", "mean", "agg4"),
+        ],
+        mesh,
+    )
+    if o5:
+        raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    return sort_by_key(agg, agg.select(["i_item_id"]), ascending=[True])
+
+
+def q19(
+    tables: Dict[str, Table], manager_id: int = 8, month: int = 11, year: int = 1998
+) -> Table:
+    """TPC-DS q19 — 5-way star join with a CROSS-DIMENSION inequality
+    (customer zip != store zip) evaluated on joined payload columns. SQL:
+
+        SELECT i_brand_id, i_manufact_id, sum(ss_ext_sales_price) ext_price
+        FROM date_dim, store_sales, item, customer, customer_address, store
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = :mgr AND d_moy = :moy AND d_year = :yr
+          AND ss_customer_sk = c_customer_sk
+          AND c_current_addr_sk = ca_address_sk
+          AND substr(ca_zip,1,5) <> substr(s_zip,1,5)
+          AND ss_store_sk = s_store_sk
+        GROUP BY i_brand_id, i_manufact_id
+        ORDER BY ext_price DESC, i_brand_id, i_manufact_id
+
+    The customer join's payload (c_current_addr_sk) becomes the NEXT
+    join's probe key — chained payload-probe joins in one program — and
+    the zip comparison runs as the plan filter over two payloads."""
+    item = tables["item"]
+    n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
+    n_manufact = int(jnp.max(item.column("i_manufact_id").data)) + 1
+    n_dates = int(jnp.max(tables["date_dim"].column("d_date_sk").data)) + 1
+    n_items = int(jnp.max(item.column("i_item_sk").data)) + 1
+    n_cust = int(jnp.max(tables["customer"].column("c_customer_sk").data)) + 1
+    n_addr = int(jnp.max(tables["customer_address"].column("ca_address_sk").data)) + 1
+    n_store = int(jnp.max(tables["store"].column("s_store_sk").data)) + 1
+    agg = _q19_pipeline(
+        n_brands, n_manufact, n_dates, n_items, n_cust, n_addr, n_store,
+        int(manager_id), int(month), int(year),
+    )(
+        tables["store_sales"],
+        {
+            "date_dim": tables["date_dim"],
+            "item": item,
+            "customer": tables["customer"],
+            "customer_address": tables["customer_address"],
+            "store": tables["store"],
+        },
+    )
+    order_keys = Table(
+        [agg.column("ext_price"), agg.column("i_brand_id"), agg.column("i_manufact_id")],
+        ["p", "b", "m"],
+    )
+    return sort_by_key(agg, order_keys, ascending=[False, True, True])
+
+
+@functools.lru_cache(maxsize=16)
+def _q19_pipeline(n_brands: int, n_manufact: int, n_dates: int, n_items: int,
+                  n_cust: int, n_addr: int, n_store: int, manager_id: int,
+                  month: int, year: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
+
+    return compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk", build_key="d_date_sk",
+                    num_keys=n_dates,
+                    build_filter=(col("d_moy") == lit(np.int32(month)))
+                    & (col("d_year") == lit(np.int32(year))),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk", build_key="i_item_sk",
+                    num_keys=n_items, payload=("i_brand_id", "i_manufact_id"),
+                    build_filter=col("i_manager_id") == lit(np.int32(manager_id)),
+                ),
+                JoinSpec(
+                    build="customer", probe_key="ss_customer_sk",
+                    build_key="c_customer_sk", num_keys=n_cust,
+                    payload=("c_current_addr_sk",),
+                ),
+                JoinSpec(
+                    # probe key is the PREVIOUS join's payload
+                    build="customer_address", probe_key="c_current_addr_sk",
+                    build_key="ca_address_sk", num_keys=n_addr, payload=("ca_zip5",),
+                ),
+                JoinSpec(
+                    build="store", probe_key="ss_store_sk", build_key="s_store_sk",
+                    num_keys=n_store, payload=("s_zip5",),
+                ),
+            ),
+            filter=col("ca_zip5") != col("s_zip5"),
+            group_by=(
+                GroupKey("i_brand_id", n_brands),
+                GroupKey("i_manufact_id", n_manufact),
+            ),
+            aggregates=(Agg("ss_ext_sales_price", "sum", "ext_price"),),
+        )
+    )
+
+
+def q19_distributed(
+    tables: Dict[str, Table], mesh,
+    manager_id: int = 8, month: int = 11, year: int = 1998,
+) -> Table:
+    """q19 on the distributed Table operators; the zip inequality runs
+    shard-local after the address/store payloads arrive. Results must be
+    BIT-identical to single-chip ``q19``."""
+    from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+
+    ss = tables["store_sales"]
+    dsel = (
+        (col("d_moy") == lit(np.int32(month))) & (col("d_year") == lit(np.int32(year)))
+    ).evaluate(tables["date_dim"])
+    d1 = copying.apply_boolean_mask(tables["date_dim"], dsel).select(["d_date_sk"])
+    d1 = Table(d1.columns, ["ss_sold_date_sk"])
+    isel = (col("i_manager_id") == lit(np.int32(manager_id))).evaluate(tables["item"])
+    i1 = copying.apply_boolean_mask(tables["item"], isel).select(
+        ["i_item_sk", "i_brand_id", "i_manufact_id"]
+    )
+    i1 = Table(i1.columns, ["ss_item_sk", "i_brand_id", "i_manufact_id"])
+    c1 = tables["customer"].select(["c_customer_sk", "c_current_addr_sk"])
+    c1 = Table(c1.columns, ["ss_customer_sk", "c_current_addr_sk"])
+    a1 = tables["customer_address"].select(["ca_address_sk", "ca_zip5"])
+    a1 = Table(a1.columns, ["c_current_addr_sk", "ca_zip5"])
+    s1 = tables["store"].select(["s_store_sk", "s_zip5"])
+    s1 = Table(s1.columns, ["ss_store_sk", "s_zip5"])
+
+    j, o1 = distributed_join_table(ss, d1, on=["ss_sold_date_sk"], mesh=mesh, how="inner")
+    j, o2 = distributed_join_table(j, i1, on=["ss_item_sk"], mesh=mesh, how="inner")
+    j, o3 = distributed_join_table(j, c1, on=["ss_customer_sk"], mesh=mesh, how="inner")
+    j, o4 = distributed_join_table(j, a1, on=["c_current_addr_sk"], mesh=mesh, how="inner")
+    j, o5 = distributed_join_table(j, s1, on=["ss_store_sk"], mesh=mesh, how="inner")
+    if o1 or o2 or o3 or o4 or o5:
+        raise RuntimeError("join capacity overflow — raise capacity")
+    keep = (col("ca_zip5") != col("s_zip5")).evaluate(j)
+    j = copying.apply_boolean_mask(j, keep)
+    agg, o6 = distributed_groupby_table(
+        j, ["i_brand_id", "i_manufact_id"],
+        [("ss_ext_sales_price", "sum", "ext_price")], mesh,
+    )
+    if o6:
+        raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    order_keys = Table(
+        [agg.column("ext_price"), agg.column("i_brand_id"), agg.column("i_manufact_id")],
+        ["p", "b", "m"],
+    )
+    return sort_by_key(agg, order_keys, ascending=[False, True, True])
+
+
+
+def _attach_year_and_sort(agg: Table, year: int, key_col: str, order_cols, ascending) -> Table:
+    """Shared epilogue of the q42/q52 reporting family: re-attach the
+    constant d_year the year-filter consumed, then ORDER BY. One
+    definition so the single-chip and distributed variants cannot
+    drift apart (their bit-identity contract)."""
+    agg = Table(
+        [
+            Column(dt.INT32, data=jnp.full((agg.num_rows,), year, jnp.int32)),
+            agg.column(key_col),
+            agg.column("ext_price"),
+        ],
+        ["d_year", key_col, "ext_price"],
+    )
+    order_keys = Table(
+        [agg.column(c) for c in order_cols], [f"k{i}" for i in range(len(order_cols))]
+    )
+    return sort_by_key(agg, order_keys, ascending=list(ascending))
+
+
+def q42(tables: Dict[str, Table], manager_id: int = 1, month: int = 11, year: int = 2000) -> Table:
+    """TPC-DS q42 (category revenue for a manager-month): the q3 shape
+    grouped by (d_year, i_category_id). SQL:
+
+        SELECT d_year, i_category_id, sum(ss_ext_sales_price)
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = :mgr AND d_moy = :moy AND d_year = :yr
+        GROUP BY d_year, i_category_id
+        ORDER BY sum DESC, d_year, i_category_id
+    """
+    item = tables["item"]
+    dates = tables["date_dim"]
+    n_cats = int(jnp.max(item.column("i_category_id").data)) + 1
+    agg = _q42_pipeline(n_cats, int(manager_id), int(month), int(year))(
+        tables["store_sales"], {"date_dim": dates, "item": item}
+    )
+    return _attach_year_and_sort(
+        agg, year, "i_category_id",
+        ["ext_price", "d_year", "i_category_id"], [False, True, True],
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _q42_pipeline(n_cats: int, manager_id: int, month: int, year: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
+
+    return compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk",
+                    build_key="d_date_sk", num_keys=None,  # sort-merge
+                    build_filter=(col("d_moy") == lit(month)) & (col("d_year") == lit(year)),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk",
+                    build_key="i_item_sk", num_keys=None,  # sort-merge
+                    payload=("i_category_id",),
+                    build_filter=col("i_manager_id") == lit(manager_id),
+                ),
+            ),
+            group_by=(GroupKey("i_category_id", n_cats),),
+            aggregates=(Agg("ss_ext_sales_price", "sum", "ext_price"),),
+        )
+    )
+
+
+def q52(tables: Dict[str, Table], manager_id: int = 1, month: int = 11, year: int = 2000) -> Table:
+    """TPC-DS q52 (brand revenue for a manager-month; q55's plan carrying
+    d_year through). SQL:
+
+        SELECT d_year, i_brand_id, sum(ss_ext_sales_price) ext_price
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = :mgr AND d_moy = :moy AND d_year = :yr
+        GROUP BY d_year, i_brand_id ORDER BY d_year, ext_price DESC, i_brand_id
+    """
+    item = tables["item"]
+    n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
+    agg = _q55_pipeline(n_brands, int(manager_id), int(month), int(year))(
+        tables["store_sales"], {"date_dim": tables["date_dim"], "item": item}
+    )
+    return _attach_year_and_sort(
+        agg, year, "i_brand_id", ["d_year", "ext_price", "i_brand_id"], [True, False, True]
+    )
+
+
+def q52_distributed(
+    tables: Dict[str, Table], mesh, manager_id: int = 1, month: int = 11, year: int = 2000
+) -> Table:
+    """q52 on the distributed Table operators (q55's exchange plan with
+    the year column re-attached). BIT-identical to single-chip q52."""
+    agg = q55_distributed(tables, mesh, manager_id=manager_id, month=month, year=year)
+    return _attach_year_and_sort(
+        agg, year, "i_brand_id", ["d_year", "ext_price", "i_brand_id"], [True, False, True]
+    )
 
 
 def q55(tables: Dict[str, Table], manager_id: int = 28, month: int = 11, year: int = 1999) -> Table:

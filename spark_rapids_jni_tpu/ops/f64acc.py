@@ -142,6 +142,79 @@ class _GroupSum(NamedTuple):
     has_ninf: jnp.ndarray
 
 
+# one-hot bytes per group x row the MXU path may materialize (256 MB)
+_MXU_ONEHOT_BUDGET = 1 << 28
+# rows per matmul chunk: |signed nibble partial| <= 15 * chunk must stay
+# inside s32 (2^31); 2^26 rows leaves 32x headroom
+_MXU_CHUNK = 1 << 26
+
+
+def _accumulate_mxu(
+    neg, e_eff, mant, is_nan, is_pinf, is_ninf, live, emax, seg, num_segments
+) -> _GroupSum:
+    """Per-group limb reduction as a signed one-hot int8 MXU contraction.
+
+    The round-4 payload formulation ([N, LIMBS+3] int64 stacked per
+    element, segment-summed) was per-element ALU/relayout-bound: ~0.34 s
+    per fused-q1 iteration at 1M rows (NOTES_ROUND4 item 5). Here the
+    reduction rides the systolic array instead: each 32-bit limb splits
+    into 8 nibble planes (values 0..15, int8), planes stack row-major as
+    B [8*LIMBS+3, N], and a signed one-hot A [G, N] (+1/-1 by element
+    sign, 0 for dead rows) contracts over N in one s8 x s8 -> s32
+    dot_general. Nibble partial sums recombine into the exact signed
+    224-bit window limbs in int64 at [G] scale — bit-identical to the
+    payload path, at matmul bandwidth.
+
+    Exactness bound: every per-group nibble partial is <= 15 * chunk
+    rows in magnitude; chunking at 2^26 rows keeps it under 2^30, well
+    inside the s32 accumulator. Non-finite rows carry zero limbs and a
+    forced +1 sign so the nan/pinf/ninf indicator planes cannot cancel
+    between +NaN and -NaN payload signs.
+    """
+    n = mant.shape[0]
+    shift = emax[seg] - e_eff  # >= 0 for live rows
+    limbs = _element_limbs(mant, shift)
+    nonfinite = is_nan | is_pinf | is_ninf
+    sgn8 = jnp.where(
+        live, jnp.where(nonfinite | ~neg, jnp.int8(1), jnp.int8(-1)), jnp.int8(0)
+    )
+    planes = []
+    for limb in limbs:
+        for j in range(8):
+            planes.append(((limb >> _U32(4 * j)) & _U32(0xF)).astype(jnp.int8))
+    planes.append(is_nan.astype(jnp.int8))
+    planes.append(is_pinf.astype(jnp.int8))
+    planes.append(is_ninf.astype(jnp.int8))
+    b = jnp.stack(planes, axis=0)  # [8*LIMBS+3, N] — rows contiguous
+    onehot = (seg[None, :] == jnp.arange(num_segments, dtype=seg.dtype)[:, None])
+    a = jnp.where(onehot, sgn8[None, :], jnp.int8(0))  # [G, N]
+    acc = None
+    for start in range(0, max(n, 1), _MXU_CHUNK):
+        stop = min(start + _MXU_CHUNK, n)
+        s = lax.dot_general(
+            a[:, start:stop],
+            b[:, start:stop],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=_I32,
+        ).astype(_I64)
+        acc = s if acc is None else acc + s
+    # recombine nibble sums into signed 32-bit-limb partials (int64 at
+    # [G, LIMBS] scale — tiny)
+    limb_sums = []
+    for k in range(LIMBS):
+        t = jnp.zeros((num_segments,), _I64)
+        for j in range(8):
+            t = t + (acc[:, 8 * k + j] << _I64(4 * j))
+        limb_sums.append(t)
+    return _GroupSum(
+        jnp.stack(limb_sums, axis=-1),
+        emax,
+        acc[:, 8 * LIMBS] > 0,
+        acc[:, 8 * LIMBS + 1] > 0,
+        acc[:, 8 * LIMBS + 2] > 0,
+    )
+
+
 def _accumulate(bits, valid, seg, num_segments) -> _GroupSum:
     if num_segments == 0 or bits.shape[0] == 0:
         # zero groups (fully filtered batch) or zero rows with live
@@ -174,17 +247,23 @@ def _accumulate(bits, valid, seg, num_segments) -> _GroupSum:
         emax = jax.ops.segment_max(e_live, seg, num_segments=num_segments)
     emax = jnp.maximum(emax, 1)  # empty / all-invalid groups: any base works
 
+    if num_segments * bits.shape[0] <= _MXU_ONEHOT_BUDGET:
+        # hot path (round 5): signed one-hot int8 MXU contraction —
+        # bit-identical to the payload reduction below, at matmul
+        # bandwidth instead of per-element i64 ALU (NOTES_ROUND4 item 5)
+        return _accumulate_mxu(
+            neg, e_eff, mant, is_nan, is_pinf, is_ninf, live, emax, seg, num_segments
+        )
+
     shift = emax[seg] - e_eff  # >= 0 for live rows
     limbs = _element_limbs(mant, shift)
     sgn = jnp.where(neg, _I64(-1), _I64(1))
     sgn = jnp.where(live, sgn, _I64(0))
-    # ONE vectorized [N, LIMBS+3] payload. Measured on chip at the q6
-    # axis (1M rows): payload scatter 0.42 s/iter, payload + small-G
-    # masked reduction 0.34 s/iter, flat per-lane masked reductions
-    # 2.4 s/iter (XLA re-materializes the shared decompose per lane) —
-    # the payload form wins despite the minor-dim padding. The real fix
-    # for the fused-pipeline hot path is an exact int8-MXU limb kernel
-    # (next-round item; see NOTES_ROUND4).
+    # ONE vectorized [N, LIMBS+3] payload (fallback when the one-hot
+    # would blow the budget). Measured on chip at the q6 axis (1M rows):
+    # payload scatter 0.42 s/iter, payload + small-G masked reduction
+    # 0.34 s/iter, flat per-lane masked reductions 2.4 s/iter (XLA
+    # re-materializes the shared decompose per lane).
     payload = jnp.stack(
         [l.astype(_I64) * sgn for l in limbs]
         + [is_nan.astype(_I64), is_pinf.astype(_I64), is_ninf.astype(_I64)],
@@ -785,15 +864,100 @@ def dd_from_f64bits(bits: jnp.ndarray) -> DD:
     return DD(hi, lo)
 
 
+def add2_f64bits(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Correctly rounded f64 sum of two bit-stored doubles, ELEMENTWISE.
+
+    The windowed accumulator with one segment per element is a scatter
+    over [2N] rows — measured ~0.34 s/iter at 1M rows inside the fused
+    pipelines (the round-4 flagship regression, NOTES_ROUND4 item 5).
+    A two-addend sum needs no window at all: align the smaller mantissa
+    into an 8-bit guard extension of the larger (61 bits total, flat
+    u64 lanes), fold bits beyond the guard into a sticky (for effective
+    subtraction the floor correction R-1 keeps the value bracketed:
+    gap >= guard implies at most one bit of cancellation, so the
+    rounding position stays above the guard LSB and the sticky is
+    exact), then round to nearest-even with the shared subnormal /
+    overflow handling. Pure elementwise integer ops — bit-identical on
+    every backend, verified against real-f64 hardware addition on the
+    CPU tier (tests).
+    """
+    GUARD = 8
+    neg_a, e_a, m_a, nan_a, pinf_a, ninf_a = _decompose(a)
+    neg_b, e_b, m_b, nan_b, pinf_b, ninf_b = _decompose(b)
+
+    a_big = (e_a > e_b) | ((e_a == e_b) & (m_a >= m_b))
+    e_big = jnp.where(a_big, e_a, e_b)
+    m_big = jnp.where(a_big, m_a, m_b)
+    neg_big = jnp.where(a_big, neg_a, neg_b)
+    e_sm = jnp.where(a_big, e_b, e_a)
+    m_sm = jnp.where(a_big, m_b, m_a)
+    neg_sm = jnp.where(a_big, neg_b, neg_a)
+
+    gap = e_big - e_sm  # >= 0
+    big = m_big << _u64(GUARD)  # <= 61 bits
+    sh_r = jnp.clip(gap - GUARD, 0, 63).astype(_U64)
+    sh_l = jnp.clip(GUARD - gap, 0, GUARD).astype(_U64)
+    aligned = jnp.where(gap >= GUARD, m_sm >> sh_r, m_sm << sh_l)
+    dropped = jnp.where(gap >= GUARD, m_sm & ((_u64(1) << sh_r) - _u64(1)), _u64(0))
+    sticky = dropped != 0
+
+    same_sign = neg_big == neg_sm
+    r = jnp.where(same_sign, big + aligned, big - aligned)
+    # effective subtraction with dropped bits: true value is r - frac,
+    # frac in (0,1) guard-LSB units -> floor is r-1 with sticky kept
+    r = jnp.where(~same_sign & sticky, r - _u64(1), r)
+
+    # highest set bit of r (<= 61)
+    p = jnp.zeros(r.shape, _I32)
+    v = r
+    for shift in (32, 16, 8, 4, 2, 1):
+        bigger = v >= (_u64(1) << _u64(shift))
+        p = jnp.where(bigger, p + shift, p)
+        v = jnp.where(bigger, v >> _u64(shift), v)
+
+    # drop q bits to land a 53-bit mantissa; the subnormal floor pins
+    # E_res = e_big - GUARD + q >= 1
+    q = jnp.maximum(p - 52, 1 + GUARD - e_big)
+    q_pos = jnp.clip(q, 0, 63).astype(_U64)
+    keep_r = r >> q_pos
+    gmask = (_u64(1) << q_pos) - _u64(1)
+    low = r & gmask
+    half = jnp.where(q > 0, _u64(1) << jnp.clip(q - 1, 0, 63).astype(_U64), _u64(0))
+    round_up = (q > 0) & (
+        (low > half) | ((low == half) & (sticky | ((keep_r & _u64(1)) == 1)))
+    )
+    keep_r = keep_r + round_up.astype(_U64)
+    keep_l = r << jnp.clip(-q, 0, 63).astype(_U64)
+    keep = jnp.where(q > 0, keep_r, keep_l)
+    ovf = keep >> _u64(53) != 0
+    keep = jnp.where(ovf, keep >> _u64(1), keep)
+    q = q + ovf.astype(_I32)
+
+    e_res = e_big - GUARD + q
+    subnormal = keep < _u64(1 << 52)
+    biased = jnp.clip(e_res, 0, 0x7FF).astype(_U64)
+    bits = jnp.where(
+        subnormal, keep, (biased << _u64(52)) | (keep & _u64((1 << 52) - 1))
+    )
+    inf_bits = _u64(0x7FF) << _u64(52)
+    bits = jnp.where((~subnormal) & (e_res >= 0x7FF), inf_bits, bits)
+    zero = r == 0
+    bits = jnp.where(zero, _u64(0), bits)
+    bits = bits | jnp.where(neg_big & ~zero, _u64(1) << _u64(63), _u64(0))
+
+    # IEEE specials: NaN dominates; inf +/- finite = inf; inf - inf = NaN
+    has_pinf = pinf_a | pinf_b
+    has_ninf = ninf_a | ninf_b
+    bits = jnp.where(has_pinf & ~has_ninf, inf_bits, bits)
+    bits = jnp.where(has_ninf & ~has_pinf, inf_bits | (_u64(1) << _u64(63)), bits)
+    bits = jnp.where(nan_a | nan_b | (has_pinf & has_ninf), inf_bits | _u64(1 << 51), bits)
+    return bits
+
+
 def dd_to_f64bits(x: DD) -> jnp.ndarray:
     """dd -> FLOAT64 bits, exactly: widen each half losslessly to f64
-    bits and round their exact pair-sum once through the windowed
-    accumulator."""
+    bits and round their exact pair-sum once through the elementwise
+    two-addend adder."""
     from .bitutils import _f32_to_f64_bits
 
-    a = _f32_to_f64_bits(x.hi)
-    b = _f32_to_f64_bits(x.lo)
-    n = a.shape[0] if a.ndim else 1
-    bits = jnp.stack([a, b], axis=-1).reshape(-1)
-    seg = jnp.repeat(jnp.arange(n, dtype=_I32), 2)
-    return segment_sum_f64bits(bits, seg, n).reshape(a.shape)
+    return add2_f64bits(_f32_to_f64_bits(x.hi), _f32_to_f64_bits(x.lo))

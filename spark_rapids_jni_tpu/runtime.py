@@ -185,6 +185,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_zstd_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
     lib.srjt_zstd_frame_content_size.restype = ctypes.c_int64
     lib.srjt_zstd_frame_content_size.argtypes = [u8p, ctypes.c_int64]
+    lib.srjt_faultinj_configure.restype = ctypes.c_int32
+    lib.srjt_faultinj_configure.argtypes = [ctypes.c_char_p]
+    lib.srjt_faultinj_disable.restype = None
+    lib.srjt_faultinj_enabled.restype = ctypes.c_int32
     lib.srjt_device_connect.restype = ctypes.c_int32
     lib.srjt_device_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32]
     lib.srjt_device_platform.restype = ctypes.c_char_p
@@ -311,7 +315,34 @@ def byte_array_lens(page: bytes):
 
 def _raise_last(lib) -> None:
     msg = lib.srjt_last_error().decode("utf-8", "replace")
+    # the native faultinj tier (faultinj.cc) prefixes its injected
+    # errors so the failure-classification taxonomy sees them the same
+    # way the Python tier's injected faults are seen
+    if msg.startswith("RETRYABLE:"):
+        from .utils.errors import RetryableError
+
+        raise RetryableError(f"native runtime error: {msg}")
+    if msg.startswith("FATAL:"):
+        from .utils.errors import FatalDeviceError
+
+        raise FatalDeviceError(f"native runtime error: {msg}")
     raise RuntimeError(f"native runtime error: {msg}")
+
+
+def faultinj_configure(path: str) -> None:
+    """Install a fault-injection config at the NATIVE C-ABI boundary
+    (faultinj.cc; same JSON schema as utils/faultinj.py)."""
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native runtime not built")
+    if lib.srjt_faultinj_configure(path.encode()) != 0:
+        _raise_last(lib)
+
+
+def faultinj_disable() -> None:
+    lib = native_lib()
+    if lib is not None:
+        lib.srjt_faultinj_disable()
 
 
 def live_handles() -> int:

@@ -194,6 +194,111 @@ class TestExactMean:
         assert got == float(Fraction(1, 3))
 
 
+class TestAdd2:
+    def _check(self, av, bv):
+        from spark_rapids_jni_tpu.ops.f64acc import add2_f64bits
+
+        a = np.asarray(av, np.float64)
+        b = np.asarray(bv, np.float64)
+        got = np.asarray(add2_f64bits(jnp.asarray(a.view(np.uint64)),
+                                      jnp.asarray(b.view(np.uint64))))
+        want = (a + b).view(np.uint64)
+        # two documented sign-bit deviations: zero results carry +0
+        # (like the windowed accumulator) and NaN results are the
+        # canonical quiet NaN (sign/payload of NaN is unobservable)
+        gz = got & np.uint64(0x7FFFFFFFFFFFFFFF)
+        wz = want & np.uint64(0x7FFFFFFFFFFFFFFF)
+        zero = (gz == 0) & (wz == 0)
+        is_nan = np.isnan(a + b) & np.isnan(got.view(np.float64))
+        norm = zero | is_nan
+        np.testing.assert_array_equal(np.where(norm, gz, got), np.where(norm, wz, want))
+
+    def test_random_pairs_match_hardware(self, rng):
+        n = 200_000
+        a = rng.standard_normal(n) * (10.0 ** rng.uniform(-300, 300, n))
+        b = rng.standard_normal(n) * (10.0 ** rng.uniform(-300, 300, n))
+        self._check(a, b)
+
+    def test_near_cancellation(self, rng):
+        n = 50_000
+        a = rng.standard_normal(n) * (10.0 ** rng.uniform(-10, 10, n))
+        ulps = rng.integers(-8, 9, n)
+        b = -(np.frombuffer((a.view(np.int64) + ulps).tobytes(), np.float64).copy())
+        self._check(a, b)
+
+    def test_guard_boundary_gaps(self, rng):
+        # exponent gaps straddling the 8-bit guard: 0..70, both signs
+        n = 20_000
+        a = rng.standard_normal(n)
+        gap = rng.integers(0, 71, n)
+        b = np.ldexp(rng.standard_normal(n), -gap.astype(np.int64))
+        self._check(a, b)
+        self._check(a, -b)
+
+    def test_ties_and_exact_halves(self):
+        # construct exact round-to-even ties: 1 + 2^-53 etc.
+        a = np.array([1.0, 1.0, 1.5, -1.0, 2.0**52, 2.0**52])
+        b = np.array([2.0**-53, 2.0**-52, 2.0**-53, -(2.0**-53), 0.5, 1.5])
+        self._check(a, b)
+
+    def test_specials_and_subnormals(self):
+        tiny = np.float64(5e-324)
+        a = np.array([np.inf, -np.inf, np.inf, np.nan, tiny, -tiny, 1e308, 0.0])
+        b = np.array([1.0, 1.0, -np.inf, 1.0, tiny, tiny, 1e308, -0.0])
+        self._check(a, b)
+
+    def test_dd_roundtrip_still_exact(self, rng):
+        from spark_rapids_jni_tpu.ops.f64acc import dd_to_f64bits
+
+        # f32-representable pairs roundtrip bit-exactly through dd
+        hi = rng.standard_normal(10_000).astype(np.float32)
+        lo = (rng.standard_normal(10_000) * 1e-9).astype(np.float32)
+        want = hi.astype(np.float64) + lo.astype(np.float64)
+        got = np.asarray(dd_to_f64bits(DD(jnp.asarray(hi), jnp.asarray(lo))))
+        np.testing.assert_array_equal(got, want.view(np.uint64))
+
+
+class TestMxuPathIdentity:
+    def test_mxu_matches_payload_bits(self, rng, monkeypatch):
+        # the int8-MXU contraction and the i64 payload reduction must
+        # produce the SAME bits on every input, including non-finite
+        # mixes and invalid rows
+        from spark_rapids_jni_tpu.ops import f64acc
+
+        n = 4096
+        vals = rng.standard_normal(n) * (10.0 ** rng.uniform(-18, 18, n))
+        vals[rng.random(n) < 0.01] = np.inf
+        vals[rng.random(n) < 0.01] = -np.inf
+        vals[rng.random(n) < 0.01] = np.nan
+        vals[rng.random(n) < 0.01] = -np.nan
+        b = _bits(vals)
+        seg = jnp.asarray(rng.integers(0, 9, n), jnp.int32)
+        valid = jnp.asarray(rng.random(n) < 0.8)
+        mxu = segment_sum_f64bits(b, seg, 9, valid=valid)
+        monkeypatch.setattr(f64acc, "_MXU_ONEHOT_BUDGET", -1)
+        payload = segment_sum_f64bits(b, seg, 9, valid=valid)
+        assert np.array_equal(np.asarray(mxu), np.asarray(payload))
+        mean_m, cnt_m = segment_mean_f64bits(b, seg, 9, valid=valid)
+        monkeypatch.undo()
+        monkeypatch.setattr(f64acc, "_MXU_ONEHOT_BUDGET", -1)
+        mean_p, cnt_p = segment_mean_f64bits(b, seg, 9, valid=valid)
+        assert np.array_equal(np.asarray(mean_m), np.asarray(mean_p))
+        assert np.array_equal(np.asarray(cnt_m), np.asarray(cnt_p))
+
+    def test_mxu_chunking_exact(self, rng, monkeypatch):
+        # force multi-chunk matmuls and check against the payload path
+        from spark_rapids_jni_tpu.ops import f64acc
+
+        monkeypatch.setattr(f64acc, "_MXU_CHUNK", 1000)
+        n = 2500
+        vals = rng.standard_normal(n) * (10.0 ** rng.uniform(-10, 10, n))
+        b = _bits(vals)
+        seg = jnp.asarray(rng.integers(0, 3, n), jnp.int32)
+        got = _vals(segment_sum_f64bits(b, seg, 3))
+        for g in range(3):
+            assert got[g] == exact_sum(vals[np.asarray(seg) == g])
+
+
 class TestCrossBackendContract:
     def test_jit_matches_eager(self, rng):
         import jax

@@ -382,6 +382,25 @@ def test_expression_three_valued_logic():
     assert n.to_pylist() == [True, False, None]
 
 
+def test_expression_case_when():
+    from spark_rapids_jni_tpu.ops.expressions import when
+
+    t = make_table(
+        a=([1, 5, None, 7], dt.INT64),
+        x=([10.0, 20.0, 30.0, None], dt.FLOAT64),
+        y=([-1.0, -2.0, -3.0, -4.0], dt.FLOAT64),
+    )
+    # NULL condition selects the ELSE branch (SQL CASE semantics)
+    r = when(col("a") > lit(2), col("x"), col("y")).evaluate(t)
+    assert r.to_pylist() == [-1.0, 20.0, -3.0, None]
+    # literal branches + nesting (multi-arm CASE)
+    r2 = when(col("a") > lit(6), lit(100), when(col("a") > lit(2), lit(50), lit(0))).evaluate(t)
+    assert r2.to_pylist() == [0, 50, 0, 100]
+    # the pivot idiom: SUM(CASE WHEN p THEN v ELSE 0 END)
+    piv = when(col("a") == lit(5), col("x"), lit(0.0)).evaluate(t)
+    assert piv.to_pylist() == [0.0, 20.0, 0.0, 0.0]
+
+
 def test_expression_divide_by_zero_null():
     t = make_table(a=([4, 9], dt.INT64), b=([2, 0], dt.INT64))
     r = (col("a") / col("b")).evaluate(t)

@@ -316,3 +316,36 @@ def test_decode_zero_length_rows_share_start_offsets():
     back = rc.convert_from_rows(rows[0], t.dtypes())
     assert back.columns[1].to_pylist() == a
     assert back.columns[2].to_pylist() == b
+
+
+def test_multibatch_fixed_roundtrip_static_and_dynamic(rng, monkeypatch):
+    """Batch-split encode at a forced-tiny ceiling: the <=4-batch static
+    path and the many-batch traced path must both produce batches that
+    decode back to the original table (VERDICT r4 item 5 machinery)."""
+    import jax.numpy as jnp
+
+    cols = [
+        Column(dt.INT64, data=jnp.asarray(rng.integers(-1000, 1000, 300), jnp.int64)),
+        Column(dt.INT8, data=jnp.asarray(rng.integers(0, 127, 300), jnp.int8)),
+        Column(dt.FLOAT32, data=jnp.asarray(rng.standard_normal(300), jnp.float32)),
+    ]
+    t = Table(cols, ["a", "b", "c"])
+    row = rc.compute_row_layout(t.dtypes()).row_size_fixed
+    for ceiling_rows, expect_min_batches in ((100, 3), (50, 6)):
+        monkeypatch.setattr(rc, "MAX_BATCH_BYTES", row * ceiling_rows)
+        batches = rc.convert_to_rows(t)
+        assert len(batches) >= expect_min_batches
+        monkeypatch.setattr(rc, "MAX_BATCH_BYTES", (1 << 31) - 1)
+        decoded = [rc.convert_from_rows(b, list(t.dtypes())) for b in batches]
+        got = {name: [] for name in t.names}
+        for d in decoded:
+            for name, col in zip(t.names, d.columns):
+                got[name].extend(col.to_pylist())
+        for name, col in zip(t.names, t.columns):
+            want = col.to_pylist()
+            if name == "c":
+                import numpy as _np
+
+                _np.testing.assert_allclose(got[name], want, rtol=1e-6)
+            else:
+                assert got[name] == want

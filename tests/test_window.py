@@ -1,0 +1,174 @@
+"""Window-function tier vs pandas oracles (ops/window.py; unblocks the
+15 window-gated TPC-DS queries in QUERIES.md)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.window import window_aggregate
+
+
+def _make(rng, n=500, nulls=False):
+    part = rng.integers(0, 7, n).astype(np.int32)
+    order = rng.integers(0, 50, n).astype(np.int32)  # ties on purpose
+    vals = (rng.standard_normal(n) * 100).round(2)
+    valid = rng.random(n) < 0.85 if nulls else np.ones(n, bool)
+    t = Table(
+        [
+            Column(dt.INT32, data=jnp.asarray(part)),
+            Column(dt.INT32, data=jnp.asarray(order)),
+            Column.from_numpy(np.where(valid, vals, 0.0)).with_validity(jnp.asarray(valid))
+            if hasattr(Column, "with_validity")
+            else Column(
+                dt.FLOAT64,
+                data=Column.from_numpy(vals).data,
+                validity=jnp.asarray(valid) if nulls else None,
+            ),
+        ],
+        ["p", "o", "v"],
+    )
+    df = pd.DataFrame({"p": part, "o": order, "v": np.where(valid, vals, np.nan)})
+    return t, df
+
+
+class TestRanks:
+    def test_row_number_rank_dense_rank(self, rng):
+        t, df = _make(rng)
+        out = window_aggregate(
+            t, ["p"], [("o", True)],
+            [("o", "row_number", "rn"), ("o", "rank", "rk"), ("o", "dense_rank", "dk")],
+        )
+        # pandas row_number within partition ordered by o must match up
+        # to tie-breaking: compare rank/dense_rank exactly (tie-stable),
+        # and row_number as a valid permutation consistent with ranks
+        want_rk = df.groupby("p")["o"].rank(method="min").astype(int)
+        want_dk = df.groupby("p")["o"].rank(method="dense").astype(int)
+        assert np.asarray(out.column("rk").data).tolist() == want_rk.tolist()
+        assert np.asarray(out.column("dk").data).tolist() == want_dk.tolist()
+        rn = np.asarray(out.column("rn").data)
+        # each partition's row numbers are a permutation of 1..size
+        for p in np.unique(np.asarray(df.p)):
+            got = sorted(rn[df.p.values == p].tolist())
+            assert got == list(range(1, (df.p.values == p).sum() + 1))
+        # row_number of a row is >= its competition rank
+        assert (rn >= np.asarray(out.column("rk").data)).all()
+
+    def test_descending_order(self, rng):
+        t, df = _make(rng)
+        out = window_aggregate(t, ["p"], [("o", False)], [("o", "rank", "rk")])
+        want = df.groupby("p")["o"].rank(method="min", ascending=False).astype(int)
+        assert np.asarray(out.column("rk").data).tolist() == want.tolist()
+
+
+class TestPartitionAggs:
+    def test_sum_mean_exact_f64(self, rng):
+        t, df = _make(rng, nulls=True)
+        out = window_aggregate(
+            t, ["p"], [],
+            [("v", "sum", "s"), ("v", "mean", "m"), ("v", "count", "c")],
+        )
+        s = np.asarray(out.column("s").data).view(np.float64)
+        m = np.asarray(out.column("m").data).view(np.float64)
+        c = np.asarray(out.column("c").data)
+        for p in np.unique(df.p.values):
+            rows = np.nonzero(df.p.values == p)[0]
+            vals = df.v.values[rows]
+            vals = vals[~np.isnan(vals)]
+            want_s = math.fsum(vals)
+            assert all(s[r] == want_s for r in rows)  # exact, every row
+            assert all(c[r] == len(vals) for r in rows)
+            from fractions import Fraction
+
+            want_m = float(sum(Fraction(v) for v in vals) / len(vals)) if len(vals) else None
+            if want_m is not None:
+                assert all(m[r] == want_m for r in rows)
+
+    def test_min_max(self, rng):
+        t, df = _make(rng)
+        out = window_aggregate(t, ["p"], [], [("v", "min", "lo"), ("v", "max", "hi")])
+        lo = np.asarray(out.column("lo").data).view(np.float64)
+        hi = np.asarray(out.column("hi").data).view(np.float64)
+        want_lo = df.groupby("p")["v"].transform("min").values
+        want_hi = df.groupby("p")["v"].transform("max").values
+        np.testing.assert_array_equal(lo, want_lo)
+        np.testing.assert_array_equal(hi, want_hi)
+
+
+class TestFramesAndShifts:
+    def test_cumsum(self, rng):
+        n = 300
+        part = rng.integers(0, 5, n).astype(np.int32)
+        vals = rng.integers(-50, 50, n).astype(np.int64)
+        # unique order key so the cumsum order is deterministic
+        order = np.arange(n).astype(np.int32)
+        rng.shuffle(order)
+        t = Table(
+            [
+                Column(dt.INT32, data=jnp.asarray(part)),
+                Column(dt.INT32, data=jnp.asarray(order)),
+                Column(dt.INT64, data=jnp.asarray(vals)),
+            ],
+            ["p", "o", "v"],
+        )
+        out = window_aggregate(t, ["p"], [("o", True)], [("v", "cumsum", "cs")])
+        df = pd.DataFrame({"p": part, "o": order, "v": vals})
+        want = df.sort_values(["p", "o"]).groupby("p")["v"].cumsum()
+        got = pd.Series(np.asarray(out.column("cs").data), index=df.index)
+        pd.testing.assert_series_equal(
+            got.sort_index(), want.sort_index(), check_names=False, check_dtype=False
+        )
+
+    def test_lag_lead(self, rng):
+        n = 200
+        part = rng.integers(0, 4, n).astype(np.int32)
+        order = np.arange(n).astype(np.int32)
+        rng.shuffle(order)
+        vals = rng.integers(0, 1000, n).astype(np.int64)
+        t = Table(
+            [
+                Column(dt.INT32, data=jnp.asarray(part)),
+                Column(dt.INT32, data=jnp.asarray(order)),
+                Column(dt.INT64, data=jnp.asarray(vals)),
+            ],
+            ["p", "o", "v"],
+        )
+        out = window_aggregate(
+            t, ["p"], [("o", True)], [("v", "lag", "lg"), ("v", "lead", "ld")]
+        )
+        df = pd.DataFrame({"p": part, "o": order, "v": vals})
+        srt = df.sort_values(["p", "o"])
+        want_lg = srt.groupby("p")["v"].shift(1).reindex(df.index)
+        want_ld = srt.groupby("p")["v"].shift(-1).reindex(df.index)
+        assert out.column("lg").to_pylist() == [
+            None if pd.isna(v) else int(v) for v in want_lg
+        ]
+        assert out.column("ld").to_pylist() == [
+            None if pd.isna(v) else int(v) for v in want_ld
+        ]
+
+
+class TestEdges:
+    def test_global_partition_and_empty(self, rng):
+        t, df = _make(rng, n=50)
+        out = window_aggregate(t, [], [("o", True)], [("o", "row_number", "rn")])
+        assert sorted(np.asarray(out.column("rn").data).tolist()) == list(range(1, 51))
+
+        empty = Table(
+            [
+                Column(dt.INT32, data=jnp.zeros((0,), jnp.int32)),
+                Column(dt.FLOAT64, data=jnp.zeros((0,), jnp.uint64)),
+            ],
+            ["p", "v"],
+        )
+        out = window_aggregate(empty, ["p"], [], [("v", "sum", "s")])
+        assert out.num_rows == 0 and "s" in out.names
+
+    def test_unknown_function_raises(self, rng):
+        t, _ = _make(rng, n=10)
+        with pytest.raises(ValueError, match="unknown window function"):
+            window_aggregate(t, ["p"], [], [("v", "median", "m")])
