@@ -342,6 +342,34 @@ def rotl_take(
     return _as_u8(out32)[:n]
 
 
+def rotl_take32(
+    x32: jnp.ndarray, shift_bytes: jnp.ndarray, out_w: int, interpret: bool = False
+) -> jnp.ndarray:
+    """rotl_take for a u32-lane input [N, W/4]: byte rotate-left by
+    shift_bytes, keep out_w bytes, return [N, out_w] u8. Same kernel as
+    rotl_take minus the [N, W]-u8 -> u32 conversion (which pads ~4x at
+    GB scale)."""
+    n, w4 = x32.shape
+    if not (_use_pallas() or interpret):
+        return byte_rotate_left(_as_u8(x32), shift_bytes)[:, :out_w]
+    rows = max((n + _PK_BLK - 1) // _PK_BLK * _PK_BLK, _PK_BLK)
+    sl, rb = _split_shift(shift_bytes.astype(jnp.int32))
+    out32 = pl.pallas_call(
+        functools.partial(_rotl_take_kernel, out_lanes=out_w // 4),
+        out_shape=jax.ShapeDtypeStruct((rows, out_w // 4), jnp.uint32),
+        grid=(rows // _PK_BLK,),
+        in_specs=[_scal_spec(_PK_BLK, interpret)] * 2
+        + [_rows_spec(_PK_BLK, w4, interpret)],
+        out_specs=_rows_spec(_PK_BLK, out_w // 4, interpret),
+        interpret=interpret,
+    )(
+        _pack_scalar(sl[:, 0], _PK_BLK, rows),
+        _pack_scalar(rb[:, 0], _PK_BLK, rows),
+        _pad_rows(x32, rows),
+    )
+    return _as_u8(out32)[:n]
+
+
 def _vacc_kernel(*refs, lane_offs: tuple, out_lanes: int):
     """Accumulate the packed string matrices into the variable section:
     refs = (sl_0..sl_{K-1}, rb_0..rb_{K-1}, packed_p, out); column k's
@@ -461,6 +489,27 @@ def _asm_epilogue(a0, a1, c0, pmod, delta, alen, g_tile: int, interpret: bool = 
     )[:t]
 
 
+def overlap_tiles_u32(buf: jnp.ndarray, stride: int, width: int) -> jnp.ndarray:
+    """overlap_tiles emitting u32 LANES: [ceil(L/stride), width/4] u32
+    where row w covers buf bytes [w*stride, w*stride + width). stride
+    and width must be multiples of 4. The whole relayout happens on the
+    FLAT buffer (flat_u8_to_u32) — a [N, width]-u8 tile matrix
+    converted to u32 per element pads ~4x at GB scale and OOMed the
+    compile at the 1Mx155 mixed-decode axis (two 7.6 GB temps; round-5
+    finding)."""
+    if width % stride != 0 or stride % 4 != 0:
+        raise ValueError("width must be a multiple of stride; stride of 4")
+    n = buf.shape[0]
+    rows = max((n + stride - 1) // stride, 1)
+    padded = jnp.zeros((rows * stride + width,), jnp.uint8).at[:n].set(buf)
+    p32 = flat_u8_to_u32(padded)
+    s4 = stride // 4
+    parts = [
+        p32[k * s4 : (rows + k) * s4].reshape(rows, s4) for k in range(width // stride)
+    ]
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
 def padded_extract(pool: jnp.ndarray, starts: jnp.ndarray, max_len: int) -> jnp.ndarray:
     """[N] windows of up to ``max_len`` bytes at arbitrary byte offsets
     ``starts`` in ``pool`` -> [N, W] u8 (W = pow2 >= max_len) where row
@@ -470,10 +519,18 @@ def padded_extract(pool: jnp.ndarray, starts: jnp.ndarray, max_len: int) -> jnp.
     One overlapping-tile gather + one per-row rotate: stride s =
     pow2_ceil(max_len), width 2s, so window [starts % s, starts % s +
     max_len) always lies inside the gathered row (s - 1 + max_len < 2s).
+    The tiles live in u32 lanes end to end (overlap_tiles_u32): the row
+    gather feeds the rotate kernel directly, with no per-element u8->u32
+    conversion at [N, 2s] scale.
     """
     if max_len < 1:
         return jnp.zeros((starts.shape[0], 4), jnp.uint8)
     stride = max(_pow2_ceil(max_len), 4)
+    if _use_pallas():
+        tiles32 = overlap_tiles_u32(pool, stride, 2 * stride)
+        idx = (starts // stride).astype(jnp.int32)
+        g32 = jnp.take(tiles32, idx, axis=0)  # [N, 2s/4] u32
+        return rotl_take32(g32, (starts % stride).astype(jnp.int32), stride)
     tiles = overlap_tiles(pool, stride, 2 * stride)
     idx = (starts // stride).astype(jnp.int32)
     g = jnp.take(tiles, idx, axis=0)  # [N, 2s]
