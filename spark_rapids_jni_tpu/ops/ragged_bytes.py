@@ -526,7 +526,12 @@ def padded_extract(pool: jnp.ndarray, starts: jnp.ndarray, max_len: int) -> jnp.
     if max_len < 1:
         return jnp.zeros((starts.shape[0], 4), jnp.uint8)
     stride = max(_pow2_ceil(max_len), 4)
-    if _use_pallas():
+    # u32-lane tiles only at wide strides: s/4 >= 128 lanes keeps the
+    # tile matrix unpadded. At short strides (string extracts) the u32
+    # minor dim would pad up to 16x, while the u8 path's convert temp
+    # is proportionally tiny — the OOM it guards against is a
+    # wide-stride (row-blob) phenomenon.
+    if _use_pallas() and stride >= 512:
         tiles32 = overlap_tiles_u32(pool, stride, 2 * stride)
         idx = (starts // stride).astype(jnp.int32)
         g32 = jnp.take(tiles32, idx, axis=0)  # [N, 2s/4] u32
