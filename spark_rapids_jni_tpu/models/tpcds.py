@@ -27,7 +27,8 @@ from ..ops.sort import sort_by_key
 __all__ = [
     "gen_store", "gen_store_wide", "gen_web",
     "q3", "q7", "q7_distributed", "q19", "q19_distributed",
-    "q42", "q52", "q52_distributed", "q55", "q55_distributed", "q95",
+    "q42", "q52", "q52_distributed", "q55", "q55_distributed",
+    "q94", "q94_distributed", "q95",
 ]
 
 
@@ -746,6 +747,90 @@ def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:
     return {"web_sales": web_sales, "web_returns": web_returns, "date_dim": date_dim}
 
 
+def _q95_family(tables: Dict[str, Table], returns_how: str, ship_lo: int, ship_hi: int, mesh=None) -> dict:
+    """Shared plan of TPC-DS q95 (EXISTS returns) and q94 (NOT EXISTS
+    returns): per-order multi-warehouse detection, ship-date filter,
+    semi-join on the multi-warehouse set, then a semi (q95) or anti
+    (q94) join on returned orders, per-order sums, exact totals. One
+    definition so the four entry points cannot drift. ``mesh=None``
+    runs single-chip ops; a mesh routes every exchange-bearing step
+    through the distributed Table operators (results must be identical
+    — the distributed tests pin it)."""
+    ws = tables["web_sales"]
+
+    if mesh is None:
+        per_order = groupby_aggregate(
+            ws.select(["ws_order_number"]),
+            ws.select(["ws_warehouse_sk"]),
+            [("ws_warehouse_sk", "min"), ("ws_warehouse_sk", "max")],
+        )
+    else:
+        from ..parallel.table_ops import distributed_groupby_table
+
+        per_order, ovf = distributed_groupby_table(
+            ws, ["ws_order_number"],
+            [("ws_warehouse_sk", "min", "ws_warehouse_sk_min"),
+             ("ws_warehouse_sk", "max", "ws_warehouse_sk_max")],
+            mesh,
+        )
+        if ovf:
+            raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
+    ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
+
+    wr = tables["web_returns"]
+    wr_keys = Table(wr.select(["wr_order_number"]).columns, ["ws_order_number"])
+
+    pred = (
+        (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
+        & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
+    ).evaluate(ws)
+    ws1 = copying.apply_boolean_mask(ws, pred)
+    if mesh is None:
+        from ..ops.join import left_anti_join
+
+        ws1 = left_semi_join(ws1, ws_wh, on=["ws_order_number"])
+        join2 = left_anti_join if returns_how == "left_anti" else left_semi_join
+        ws1 = join2(ws1, wr_keys, on=["ws_order_number"])
+        per = groupby_aggregate(
+            ws1.select(["ws_order_number"]),
+            ws1.select(["ws_ext_ship_cost", "ws_net_profit"]),
+            [("ws_ext_ship_cost", "sum"), ("ws_net_profit", "sum")],
+        )
+    else:
+        from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+
+        ws1, o1 = distributed_join_table(ws1, ws_wh, on=["ws_order_number"], mesh=mesh, how="left_semi")
+        ws1, o2 = distributed_join_table(ws1, wr_keys, on=["ws_order_number"], mesh=mesh, how=returns_how)
+        if o1 or o2:
+            raise RuntimeError("join capacity overflow — raise capacity")
+        per, o3 = distributed_groupby_table(
+            ws1, ["ws_order_number"],
+            [("ws_ext_ship_cost", "sum", "ws_ext_ship_cost_sum"),
+             ("ws_net_profit", "sum", "ws_net_profit_sum")],
+            mesh,
+        )
+        if o3:
+            raise RuntimeError("groupby capacity overflow — raise group_capacity")
+    return {
+        "order_count": int(per.num_rows),
+        "total_shipping_cost": _exact_total(per.column("ws_ext_ship_cost_sum")),
+        "total_net_profit": _exact_total(per.column("ws_net_profit_sum")),
+    }
+
+
+def q94(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dict:
+    """TPC-DS q94 — q95's NOT EXISTS variant: returned orders EXCLUDED
+    via a true left ANTI join (Spark's NOT EXISTS lowering)."""
+    return _q95_family(tables, "left_anti", int(ship_lo), int(ship_hi))
+
+
+def q94_distributed(tables: Dict[str, Table], mesh, ship_lo: int = 400, ship_hi: int = 460) -> dict:
+    """q94 over the distributed Table operators; identical to
+    single-chip ``q94`` (pinned by test)."""
+    return _q95_family(tables, "left_anti", int(ship_lo), int(ship_hi), mesh=mesh)
+
+
 def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dict:
     """Returned-order shipping report. SQL shape:
 
@@ -760,89 +845,15 @@ def q95(tables: Dict[str, Table], ship_lo: int = 400, ship_hi: int = 460) -> dic
           AND ws_order_number IN (SELECT wr_order_number FROM web_returns)
 
     The IN-subqueries run as true left-semi joins (the plan Spark
-    produces for IN; ops.join.left_semi_join).
-    """
-    ws = tables["web_sales"]
-
-    # ws_wh: orders shipped from >1 distinct warehouse == per-order
-    # min(warehouse) != max(warehouse)
-    per_order = groupby_aggregate(
-        ws.select(["ws_order_number"]),
-        ws.select(["ws_warehouse_sk"]),
-        [("ws_warehouse_sk", "min"), ("ws_warehouse_sk", "max")],
-    )
-    multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
-    ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
-
-    # returned orders (no dedup needed: semi-join multiplicity is 0/1)
-    wr = tables["web_returns"]
-    wr_keys = Table(wr.select(["wr_order_number"]).columns, ["ws_order_number"])
-
-    pred = (
-        (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
-        & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
-    ).evaluate(ws)
-    ws1 = copying.apply_boolean_mask(ws, pred)
-    ws1 = left_semi_join(ws1, ws_wh, on=["ws_order_number"])
-    ws1 = left_semi_join(ws1, wr_keys, on=["ws_order_number"])
-
-    per = groupby_aggregate(
-        ws1.select(["ws_order_number"]),
-        ws1.select(["ws_ext_ship_cost", "ws_net_profit"]),
-        [("ws_ext_ship_cost", "sum"), ("ws_net_profit", "sum")],
-    )
-    return {
-        "order_count": int(per.num_rows),
-        "total_shipping_cost": _exact_total(per.column("ws_ext_ship_cost_sum")),
-        "total_net_profit": _exact_total(per.column("ws_net_profit_sum")),
-    }
+    produces for IN; ops.join.left_semi_join). Shares its plan body
+    with q94 (_q95_family)."""
+    return _q95_family(tables, "left_semi", int(ship_lo), int(ship_hi))
 
 
 def q95_distributed(tables: Dict[str, Table], mesh, ship_lo: int = 400, ship_hi: int = 460) -> dict:
     """q95 on the Table-level distributed operators (parallel/table_ops):
-    the same plan as ``q95`` with every exchange-bearing step — both
-    groupbys and both semi-joins — running as shuffled shard_map programs
-    over the mesh. Filters and the tiny post-aggregation arithmetic stay
-    local, exactly like Spark keeps narrow transformations pipelined.
-    Must produce results identical to single-chip ``q95``."""
-    from ..parallel.table_ops import distributed_groupby_table, distributed_join_table
+    the same plan with every exchange-bearing step — both groupbys and
+    both membership joins — running as shuffled shard_map programs over
+    the mesh. Must produce results identical to single-chip ``q95``."""
+    return _q95_family(tables, "left_semi", int(ship_lo), int(ship_hi), mesh=mesh)
 
-    ws = tables["web_sales"]
-
-    per_order, ovf = distributed_groupby_table(
-        ws, ["ws_order_number"],
-        [("ws_warehouse_sk", "min", "ws_warehouse_sk_min"),
-         ("ws_warehouse_sk", "max", "ws_warehouse_sk_max")],
-        mesh,
-    )
-    if ovf:
-        raise RuntimeError("groupby capacity overflow — raise group_capacity")
-    multi = (col("ws_warehouse_sk_min") != col("ws_warehouse_sk_max")).evaluate(per_order)
-    ws_wh = copying.apply_boolean_mask(per_order, multi).select(["ws_order_number"])
-
-    wr = tables["web_returns"]
-    wr_keys = Table(wr.select(["wr_order_number"]).columns, ["ws_order_number"])
-
-    pred = (
-        (col("ws_ship_date_sk") >= lit(np.int32(ship_lo)))
-        & (col("ws_ship_date_sk") <= lit(np.int32(ship_hi)))
-    ).evaluate(ws)
-    ws1 = copying.apply_boolean_mask(ws, pred)
-    ws1, o1 = distributed_join_table(ws1, ws_wh, on=["ws_order_number"], mesh=mesh, how="left_semi")
-    ws1, o2 = distributed_join_table(ws1, wr_keys, on=["ws_order_number"], mesh=mesh, how="left_semi")
-    if o1 or o2:
-        raise RuntimeError("join capacity overflow — raise capacity")
-
-    per, o3 = distributed_groupby_table(
-        ws1, ["ws_order_number"],
-        [("ws_ext_ship_cost", "sum", "ws_ext_ship_cost_sum"),
-         ("ws_net_profit", "sum", "ws_net_profit_sum")],
-        mesh,
-    )
-    if o3:
-        raise RuntimeError("groupby capacity overflow — raise group_capacity")
-    return {
-        "order_count": int(per.num_rows),
-        "total_shipping_cost": _exact_total(per.column("ws_ext_ship_cost_sum")),
-        "total_net_profit": _exact_total(per.column("ws_net_profit_sum")),
-    }

@@ -51,6 +51,12 @@ def _wide_frames(tabs):
     return f
 
 
+def _exact_fsum_of(vals):
+    """Correctly rounded sum of already-rounded f64 values — the outer
+    level of the two-level rounding the query performs."""
+    return math.fsum(vals)
+
+
 def _exact_mean(values) -> float:
     """Correctly rounded f64 of (exact sum / count) — the accumulator's
     contract; a float mean would double-round."""
@@ -231,3 +237,44 @@ class TestReportingShapes:
             np.testing.assert_array_equal(
                 np.asarray(single.column(name).data), np.asarray(dist.column(name).data)
             )
+
+
+class TestQ94:
+    def _oracle(self, tabs, lo=400, hi=460):
+        ws = tabs["web_sales"]
+        df = pd.DataFrame({
+            "o": np.asarray(ws.column("ws_order_number").data),
+            "w": np.asarray(ws.column("ws_warehouse_sk").data),
+            "d": np.asarray(ws.column("ws_ship_date_sk").data),
+            "c": np.asarray(ws.column("ws_ext_ship_cost").data).view(np.float64),
+            "p": np.asarray(ws.column("ws_net_profit").data).view(np.float64),
+        })
+        wh = df.groupby("o")["w"].nunique()
+        multi = set(wh[wh > 1].index)
+        returned = set(np.asarray(tabs["web_returns"].column("wr_order_number").data).tolist())
+        sel = df[(df.d >= lo) & (df.d <= hi) & df.o.isin(multi) & ~df.o.isin(returned)]
+        # mirror q94's TWO-LEVEL rounding exactly: correctly rounded
+        # per-order sums, then the exact total of those rounded sums —
+        # a flat fsum would differ by accumulated per-group rounding
+        per_order_c = [math.fsum(g.tolist()) for _, g in sel.groupby("o")["c"]]
+        per_order_p = [math.fsum(g.tolist()) for _, g in sel.groupby("o")["p"]]
+        return {
+            "order_count": sel.o.nunique(),
+            "total_shipping_cost": _exact_fsum_of(per_order_c),
+            "total_net_profit": _exact_fsum_of(per_order_p),
+        }
+
+    def test_matches_exact_oracle(self):
+        tabs = tpcds.gen_web(30_000, seed=13)
+        got = tpcds.q94(tabs)
+        want = self._oracle(tabs)
+        assert got["order_count"] == want["order_count"]
+        assert got["total_shipping_cost"] == want["total_shipping_cost"]
+        assert got["total_net_profit"] == want["total_net_profit"]
+
+    def test_distributed_identical(self):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        tabs = tpcds.gen_web(12_000, seed=14)
+        single = tpcds.q94(tabs)
+        dist = tpcds.q94_distributed(tabs, mesh)
+        assert single == dist
