@@ -28,7 +28,7 @@ __all__ = [
     "gen_store", "gen_store_wide", "gen_web",
     "q3", "q7", "q7_distributed", "q19", "q19_distributed",
     "q42", "q52", "q52_distributed", "q55", "q55_distributed",
-    "q94", "q94_distributed", "q95",
+    "q94", "q94_distributed", "q95", "q98",
 ]
 
 
@@ -745,6 +745,80 @@ def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:
     web_returns = Table([_int_col(returned)], ["wr_order_number"])
     date_dim = Table([_int_col(np.arange(n_dates))], ["d_date_sk"])
     return {"web_sales": web_sales, "web_returns": web_returns, "date_dim": date_dim}
+
+
+def q98(tables: Dict[str, Table], month: int = 11, year: int = 2000) -> Table:
+    """TPC-DS q98 shape — the WINDOW-RATIO reporting family (q12/q20/
+    q98): item revenue with each item's share of its CLASS partition.
+    SQL shape:
+
+        SELECT i_category, i_class(-> brand here), sum(ss_ext_sales_price) itemrevenue,
+               sum(ss_ext_sales_price) * 100 /
+                 sum(sum(ss_ext_sales_price)) OVER (PARTITION BY i_category) revenueratio
+        FROM store_sales, item, date_dim
+        WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+          AND d_moy = :moy AND d_year = :yr
+        GROUP BY i_category, i_class ORDER BY i_category, revenueratio
+
+    Exercises the round-5 window tier (ops/window.window_aggregate)
+    composed AFTER a compiled star-join aggregation: the partitioned
+    sum runs the exact f64 accumulator, so the ratio's numerator and
+    denominator are both correctly rounded."""
+    from ..ops.window import window_aggregate
+
+    item = tables["item"]
+    n_cats = int(jnp.max(item.column("i_category_id").data)) + 1
+    n_brands = int(jnp.max(item.column("i_brand_id").data)) + 1
+    agg = _q98_pipeline(n_cats, n_brands, int(month), int(year))(
+        tables["store_sales"], {"date_dim": tables["date_dim"], "item": item}
+    )
+    w = window_aggregate(
+        agg, ["i_category_id"], [], [("itemrevenue", "sum", "cat_total")]
+    )
+    ratio = (
+        (col("itemrevenue") * lit(100.0)) / col("cat_total")
+    ).evaluate(w)
+    out = Table(
+        [
+            w.column("i_category_id"),
+            w.column("i_brand_id"),
+            w.column("itemrevenue"),
+            ratio,
+        ],
+        ["i_category_id", "i_brand_id", "itemrevenue", "revenueratio"],
+    )
+    order_keys = Table(
+        [out.column("i_category_id"), out.column("revenueratio"), out.column("i_brand_id")],
+        ["c", "r", "b"],
+    )
+    return sort_by_key(out, order_keys, ascending=[True, True, True])
+
+
+@functools.lru_cache(maxsize=16)
+def _q98_pipeline(n_cats: int, n_brands: int, month: int, year: int):
+    from ..pipeline import Agg, GroupKey, JoinSpec, PlanSpec, compile_plan
+
+    return compile_plan(
+        PlanSpec(
+            joins=(
+                JoinSpec(
+                    build="date_dim", probe_key="ss_sold_date_sk",
+                    build_key="d_date_sk", num_keys=None,
+                    build_filter=(col("d_moy") == lit(month)) & (col("d_year") == lit(year)),
+                ),
+                JoinSpec(
+                    build="item", probe_key="ss_item_sk",
+                    build_key="i_item_sk", num_keys=None,
+                    payload=("i_category_id", "i_brand_id"),
+                ),
+            ),
+            group_by=(
+                GroupKey("i_category_id", n_cats),
+                GroupKey("i_brand_id", n_brands),
+            ),
+            aggregates=(Agg("ss_ext_sales_price", "sum", "itemrevenue"),),
+        )
+    )
 
 
 def _q95_family(tables: Dict[str, Table], returns_how: str, ship_lo: int, ship_hi: int, mesh=None) -> dict:

@@ -278,3 +278,46 @@ class TestQ94:
         single = tpcds.q94(tabs)
         dist = tpcds.q94_distributed(tabs, mesh)
         assert single == dist
+
+
+class TestQ98WindowRatio:
+    def test_matches_oracle(self):
+        tabs = tpcds.gen_store(30_000, seed=15)
+        out = tpcds.q98(tabs, month=11, year=2000)
+        ss = tabs["store_sales"]; it = tabs["item"]; dd = tabs["date_dim"]
+        df = pd.DataFrame({
+            "d": np.asarray(ss.column("ss_sold_date_sk").data),
+            "i": np.asarray(ss.column("ss_item_sk").data),
+            "p": _f64(ss.column("ss_ext_sales_price")),
+        }).merge(pd.DataFrame({
+            "d": np.asarray(dd.column("d_date_sk").data),
+            "y": np.asarray(dd.column("d_year").data),
+            "m": np.asarray(dd.column("d_moy").data),
+        }), on="d").merge(pd.DataFrame({
+            "i": np.asarray(it.column("i_item_sk").data),
+            "cat": np.asarray(it.column("i_category_id").data),
+            "b": np.asarray(it.column("i_brand_id").data),
+        }), on="i")
+        df = df[(df.m == 11) & (df.y == 2000)]
+        rev = {}
+        for (cat, b), grp in df.groupby(["cat", "b"]):
+            rev[(cat, b)] = math.fsum(grp.p.tolist())
+        cat_tot = {
+            c: math.fsum(v for (cc, _), v in rev.items() if cc == c)
+            for c in {c for c, _ in rev}
+        }
+        rows = [
+            (cat, b, v, v * 100.0 / cat_tot[cat]) for (cat, b), v in rev.items()
+        ]
+        rows.sort(key=lambda r: (r[0], r[3], r[1]))
+        got_cat = np.asarray(out.column("i_category_id").data).tolist()
+        got_b = np.asarray(out.column("i_brand_id").data).tolist()
+        got_rev = _f64(out.column("itemrevenue"))
+        got_ratio = _f64(out.column("revenueratio"))
+        assert got_cat == [r[0] for r in rows]
+        assert got_b == [r[1] for r in rows]
+        # itemrevenue is EXACT (windowed accumulator == fsum)
+        np.testing.assert_array_equal(got_rev, np.array([r[2] for r in rows]))
+        # the ratio divides two correctly rounded values; dd division
+        # carries ~2^-48 relative error on the f64-less tier
+        np.testing.assert_allclose(got_ratio, np.array([r[3] for r in rows]), rtol=1e-12)
