@@ -146,6 +146,13 @@ def _agg_column(col: Column, order, seg, num, how: str) -> Column:
         data = jax.ops.segment_sum(sorted_valid.astype(jnp.int64), seg, num)
         return Column(dt.INT64, data=data)
 
+    if (
+        how in ("var", "std")
+        and d.is_fixed_width
+        and not d.id.name.startswith("DECIMAL")
+    ):
+        return _var_std_column(col, order, seg, num, how, sorted_valid)
+
     any_valid = jax.ops.segment_max(sorted_valid.astype(jnp.int32), seg, num) > 0
 
     if how in ("min", "max") and d.is_fixed_width and d.id != TypeId.DECIMAL128:
@@ -216,6 +223,65 @@ def _agg_column(col: Column, order, seg, num, how: str) -> Column:
         return Column(dt.INT64, data=s, validity=any_valid)
 
     raise ValueError(f"unsupported aggregation {how!r} on {d!r}")
+
+
+def _var_std_column(col: Column, order, seg, num, how: str, sorted_valid) -> Column:
+    """Sample variance / stddev (Spark var_samp / stddev_samp: DOUBLE
+    out, NULL below two valid rows; q17/q39's missing primitive).
+
+    STABLE two-pass formulation — deviations from the group mean, not
+    the raw-moment sumsq - sum^2/n (which cancels catastrophically for
+    large-mean data: values ~1e9 with stddev ~1 would return noise).
+    Pass 1 computes correctly rounded group means (segment_mean
+    machinery); pass 2 sums (x - mean)^2. On the f64-less tier the
+    deviation and square evaluate in the dd (double-f32, ~2^-48/op)
+    domain, materialize to f64 bits through the elementwise two-addend
+    adder, and segment-sum EXACTLY through the windowed accumulator —
+    precision is set by the per-element deviation arithmetic, relative
+    to the DEVIATIONS rather than the raw moments. The [G]-scale
+    divide by (n-1) runs in real f64 on the host (this op is an eager
+    boundary; the groupby already pays a host sync for the group
+    count)."""
+    from . import f64acc
+
+    d = col.dtype
+    if bitutils.backend_has_f64():
+        if d.id == TypeId.FLOAT64:
+            x = bitutils.float_view(col.data, d)
+        else:
+            x = col.data.astype(jnp.float64)
+        xs = jnp.where(sorted_valid, x[order], 0.0)
+        cnt_dev = jax.ops.segment_sum(sorted_valid.astype(jnp.int64), seg, num)
+        mean = jax.ops.segment_sum(xs, seg, num) / jnp.maximum(cnt_dev, 1)
+        dx = jnp.where(sorted_valid, xs - mean[seg], 0.0)
+        m2_np = np.asarray(jax.ops.segment_sum(dx * dx, seg, num), np.float64)
+        cnt = np.asarray(cnt_dev).astype(np.float64)
+    else:
+        if d.id == TypeId.FLOAT64:
+            pair = f64acc.dd_from_f64bits(col.data)
+        else:
+            pair = f64acc.dd_from_any(col.data)
+        xbits = f64acc.dd_to_f64bits(pair)[order]
+        mean_bits, cnt_dev = f64acc.segment_mean_f64bits(
+            xbits, seg, num, valid=sorted_valid
+        )
+        mean_pair = f64acc.dd_from_f64bits(mean_bits)
+        sp = f64acc.DD(pair.hi[order], pair.lo[order])
+        dx = sp - f64acc.DD(mean_pair.hi[seg], mean_pair.lo[seg])
+        d2 = dx * dx
+        d2bits = f64acc.dd_to_f64bits(d2)
+        m2bits = f64acc.segment_sum_f64bits(d2bits, seg, num, valid=sorted_valid)
+        m2_np = np.asarray(m2bits).view(np.float64)
+        cnt = np.asarray(cnt_dev).astype(np.float64)
+    ok = cnt >= 2
+    var = m2_np / np.maximum(cnt - 1, 1.0)
+    var = np.maximum(var, 0.0)
+    out = np.sqrt(var) if how == "std" else var
+    return Column(
+        dt.FLOAT64,
+        data=jnp.asarray(np.where(ok, out, 0.0).view(np.uint64)),
+        validity=jnp.asarray(ok),
+    )
 
 
 def _from_total_order(key: jnp.ndarray, d) -> jnp.ndarray:
