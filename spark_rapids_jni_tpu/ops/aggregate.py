@@ -259,9 +259,10 @@ def _var_std_column(col: Column, order, seg, num, how: str, sorted_valid) -> Col
     else:
         if d.id == TypeId.FLOAT64:
             pair = f64acc.dd_from_f64bits(col.data)
+            xbits = col.data[order]  # exact stored bits — no dd round trip
         else:
             pair = f64acc.dd_from_any(col.data)
-        xbits = f64acc.dd_to_f64bits(pair)[order]
+            xbits = f64acc.dd_to_f64bits(pair)[order]
         mean_bits, cnt_dev = f64acc.segment_mean_f64bits(
             xbits, seg, num, valid=sorted_valid
         )

@@ -51,7 +51,7 @@ __all__ = ["window_aggregate"]
 
 _RANKS = ("row_number", "rank", "dense_rank")
 _SHIFTS = ("lag", "lead")
-_FULL_AGGS = ("sum", "mean", "min", "max", "count")
+_FULL_AGGS = ("sum", "mean", "min", "max", "count", "var", "std")
 _SUPPORTED = _RANKS + _SHIFTS + _FULL_AGGS + ("cumsum",)
 
 
@@ -89,7 +89,7 @@ def window_aggregate(
     order (required for rank/row_number/lag/lead/cumsum;
     full-partition aggregates ignore it). ``aggs``: [(source_col, how,
     out_name)] with how in {row_number, rank, dense_rank, lag, lead,
-    sum, mean, min, max, count, cumsum}; lag/lead read offset 1
+    sum, mean, min, max, count, var, std, cumsum}; lag/lead read offset 1
     (Spark's default) with NULL at partition edges; source_col is
     ignored for the rank family (pass any column name).
 
@@ -154,7 +154,7 @@ def _out_dtype(src_dtype, how: str):
         return dt.INT32
     if how == "count":
         return dt.INT64
-    if how == "mean":
+    if how in ("mean", "var", "std"):
         return dt.FLOAT64
     return src_dtype
 

@@ -172,3 +172,14 @@ class TestEdges:
         t, _ = _make(rng, n=10)
         with pytest.raises(ValueError, match="unknown window function"):
             window_aggregate(t, ["p"], [], [("v", "median", "m")])
+
+
+def test_partition_var_std(rng):
+    t, df = _make(rng, n=400)
+    out = window_aggregate(t, ["p"], [], [("v", "var", "vv"), ("v", "std", "sd")])
+    want_v = df.groupby("p")["v"].transform("var").values
+    want_s = df.groupby("p")["v"].transform("std").values
+    vv = np.asarray(out.column("vv").data).view(np.float64)
+    sd = np.asarray(out.column("sd").data).view(np.float64)
+    np.testing.assert_allclose(vv, want_v, rtol=1e-9)
+    np.testing.assert_allclose(sd, want_s, rtol=1e-9)
