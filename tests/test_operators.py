@@ -493,3 +493,27 @@ def test_groupby_var_large_mean_stable(rng):
     # cross-implementation mean rounding differs at ~2e-8 here; the
     # property under test is STABILITY (raw moments would be ~100% off)
     np.testing.assert_allclose(out.column("v_std").to_pylist(), exp.values, rtol=1e-6)
+
+
+def test_groupby_var_std_dd_branch(rng, monkeypatch):
+    # force the f64-less (dd) formulation on the CPU tier so the TPU
+    # branch of _var_std_column is exercised hermetically
+    from spark_rapids_jni_tpu.ops import aggregate as agg_mod
+    from spark_rapids_jni_tpu.ops import bitutils
+
+    monkeypatch.setattr(bitutils, "backend_has_f64", lambda: False)
+    keys = [int(k) for k in rng.integers(0, 5, 300)]
+    vals = (rng.standard_normal(300) * 30 + 10).tolist()
+    with_nulls = [v if i % 11 else None for i, v in enumerate(vals)]
+    t_keys = make_table(k=(keys, dt.INT32))
+    t_vals = make_table(v=(with_nulls, dt.FLOAT64))
+    out = groupby_aggregate(t_keys, t_vals, [("v", "var"), ("v", "std")])
+    df = pd.DataFrame({"k": keys, "v": with_nulls})
+    exp = df.groupby("k")["v"].agg(["var", "std"]).reset_index()
+    np.testing.assert_allclose(out.column("v_var").to_pylist(), exp["var"].values, rtol=1e-9)
+    np.testing.assert_allclose(out.column("v_std").to_pylist(), exp["std"].values, rtol=1e-9)
+    # integer source through dd promotion
+    t_ints = make_table(v=([int(v) for v in rng.integers(-500, 500, 300)], dt.INT64))
+    out2 = groupby_aggregate(t_keys, t_ints, [("v", "std")])
+    exp2 = pd.DataFrame({"k": keys, "v": np.asarray(t_ints.column("v").data)}).groupby("k")["v"].std()
+    np.testing.assert_allclose(out2.column("v_std").to_pylist(), exp2.values, rtol=1e-9)
