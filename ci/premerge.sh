@@ -26,6 +26,17 @@ EOF
 # a 1-core box (VERDICT r3 item 9)
 python -m pytest tests/ -q -m "not slow"
 
+# robustness tier: the chaos suite re-runs the end-to-end distributed
+# pipeline under the storm profile (retryable + delay faults at 30%)
+# with the retry orchestrator armed THROUGH the env knobs (the parity
+# test honors SRJT_RETRY_* when SRJT_RETRY_ENABLED is set), asserting
+# results bit-identical to fault-free runs — a retry/backoff/
+# supervision regression fails premerge, not production (ISSUE 1)
+SRJT_FAULTINJ_CONFIG=ci/chaos_storm.json SRJT_RETRY_ENABLED=1 \
+  SRJT_RETRY_MAX_ATTEMPTS=10 SRJT_RETRY_BASE_DELAY_MS=1 \
+  SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  python -m pytest tests/test_chaos.py -q
+
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py
 

@@ -487,6 +487,15 @@ SRJT_EXPORT void srjt_device_shutdown() {
   // destructor (worker shutdown) runs outside the state mutex
 }
 
+SRJT_EXPORT int32_t srjt_device_heartbeat() {
+  // 1 = worker answered a PING under the short probe deadline
+  // (SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC), 0 = no sidecar connected or
+  // the worker is unreachable/wedged. Never throws: supervision
+  // probes must be safe from any thread.
+  auto client = sidecar_ref();
+  return client && client->heartbeat() ? 1 : 0;
+}
+
 SRJT_EXPORT int32_t srjt_device_groupby_sum(const int64_t* keys, const float* vals,
                                             int64_t n, int32_t num_keys, float* out_sums,
                                             int64_t* out_counts) {

@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <stdexcept>
 #include <thread>
@@ -37,6 +38,16 @@ constexpr uint32_t ARENA_FLAG = 0x80000000u;
 
 constexpr uint32_t STATUS_OK = 0;
 constexpr uint32_t STATUS_CAST_ERROR = 2;
+
+// positive-integer env knob with fallback (deadline tunables); the
+// Python twin is utils/retry.py env_float(positive=True)
+long env_seconds(const char* name, long dflt) {
+  const char* env = std::getenv(name);
+  if (env == nullptr) return dflt;
+  char* end = nullptr;
+  long v = std::strtol(env, &end, 10);
+  return (end != env && v > 0) ? v : dflt;
+}
 
 void append(std::vector<uint8_t>& buf, const void* p, size_t n) {
   const uint8_t* b = static_cast<const uint8_t*>(p);
@@ -244,9 +255,11 @@ SidecarClient::Conn SidecarClient::make_conn() {
     throw std::runtime_error("sidecar: connect failed (worker died?)");
   }
   // a wedged worker must surface as an op error (the fallback path),
-  // not an indefinite block holding a pool slot
+  // not an indefinite block holding a pool slot. The per-request
+  // deadline is deploy-tunable: SRJT_SIDECAR_TIMEOUT_SEC (default 600)
+  long deadline_sec = env_seconds("SRJT_SIDECAR_TIMEOUT_SEC", 600);
   timeval tv{};
-  tv.tv_sec = 600;
+  tv.tv_sec = deadline_sec;
   setsockopt(c.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   setsockopt(c.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 
@@ -277,27 +290,38 @@ SidecarClient::Conn SidecarClient::make_conn() {
       cm->cmsg_type = SCM_RIGHTS;
       cm->cmsg_len = CMSG_LEN(sizeof(int));
       std::memcpy(CMSG_DATA(cm), &afd, sizeof(int));
-      if (sendmsg(c.fd, &mh, MSG_NOSIGNAL) == static_cast<ssize_t>(sizeof(msg))) {
-        uint8_t rhdr[12];
-        try {
-          recv_all(c.fd, rhdr, sizeof(rhdr));
-          uint32_t status;
-          std::memcpy(&status, rhdr, 4);
-          uint64_t rlen;
-          std::memcpy(&rlen, rhdr + 4, 8);
-          std::vector<uint8_t> sink(rlen);
-          if (rlen) recv_all(c.fd, sink.data(), rlen);
-          if ((status & ~ARENA_FLAG) == STATUS_OK) {
-            c.arena_fd = afd;
-            c.arena = static_cast<uint8_t*>(p);
-            c.arena_size = kArenaSize;
-          }
-        } catch (...) {
-          close(c.fd);
-          munmap(p, kArenaSize);
-          close(afd);
-          throw;
+      ssize_t sent = sendmsg(c.fd, &mh, MSG_NOSIGNAL);
+      if (sent != static_cast<ssize_t>(sizeof(msg))) {
+        // a short/failed send leaves a truncated SET_ARENA frame on
+        // the stream — every later request would be misparsed by the
+        // worker. The connection is desynced and unusable: tear it
+        // down and throw so the caller reconnects, never fall back to
+        // inline streaming on this socket (ADVICE low #2).
+        close(c.fd);
+        munmap(p, kArenaSize);
+        close(afd);
+        throw std::runtime_error(
+            "sidecar: SET_ARENA send failed or was truncated (connection desynced)");
+      }
+      uint8_t rhdr[12];
+      try {
+        recv_all(c.fd, rhdr, sizeof(rhdr));
+        uint32_t status;
+        std::memcpy(&status, rhdr, 4);
+        uint64_t rlen;
+        std::memcpy(&rlen, rhdr + 4, 8);
+        std::vector<uint8_t> sink(rlen);
+        if (rlen) recv_all(c.fd, sink.data(), rlen);
+        if ((status & ~ARENA_FLAG) == STATUS_OK) {
+          c.arena_fd = afd;
+          c.arena = static_cast<uint8_t*>(p);
+          c.arena_size = kArenaSize;
         }
+      } catch (...) {
+        close(c.fd);
+        munmap(p, kArenaSize);
+        close(afd);
+        throw;
       }
       if (c.arena == nullptr) {
         munmap(p, kArenaSize);
@@ -417,20 +441,65 @@ std::vector<uint8_t> SidecarClient::do_request(Conn& c, uint32_t op,
 }
 
 std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8_t>& payload) {
-  size_t idx = acquire_conn();
-  bool broken = false;
-  try {
-    auto resp = do_request(conns_[idx], op, payload);
-    release_conn(idx, false);
-    return resp;
-  } catch (const CastError&) {
-    release_conn(idx, false);  // semantic failure: transport is healthy
-    throw;
-  } catch (...) {
-    broken = true;
-    release_conn(idx, broken);  // transport failure: drop + lazy reconnect
-    throw;
+  // connection supervision: one transport failure earns ONE fresh
+  // connection and a re-issue (all sidecar ops are pure/idempotent);
+  // a second failure means the worker itself is gone — throw so the
+  // caller degrades to the host engine instead of hanging.
+  for (int attempt = 0;; ++attempt) {
+    size_t idx = acquire_conn();
+    try {
+      auto resp = do_request(conns_[idx], op, payload);
+      release_conn(idx, false);
+      return resp;
+    } catch (const CastError&) {
+      release_conn(idx, false);  // semantic failure: transport is healthy
+      throw;
+    } catch (...) {
+      release_conn(idx, true);  // transport failure: drop + lazy reconnect
+      if (attempt >= 1) throw;
+    }
   }
+}
+
+bool SidecarClient::heartbeat() {
+  // cheap liveness probe on a THROWAWAY connection with its own short
+  // deadline (SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC, default 5 s) — NOT
+  // the pooled request path, whose heavy-op deadline (default 600 s)
+  // and reconnect-retry would make a wedged worker block the probe
+  // for minutes while holding a pool slot. False means unreachable/
+  // wedged — callers should tear the client down and run on the host.
+  long probe_sec = env_seconds("SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC", 5);
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  timeval tv{};
+  tv.tv_sec = probe_sec;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
+  bool ok = false;
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    try {
+      uint8_t hdr[12] = {};  // op PING, zero payload
+      send_all(fd, hdr, sizeof(hdr));
+      uint8_t rhdr[12];
+      recv_all(fd, rhdr, sizeof(rhdr));
+      uint32_t status;
+      uint64_t rlen;
+      std::memcpy(&status, rhdr, 4);
+      std::memcpy(&rlen, rhdr + 4, 8);
+      if ((status & ~ARENA_FLAG) == STATUS_OK && rlen > 0 && rlen < 4096) {
+        std::vector<uint8_t> sink(rlen);
+        recv_all(fd, sink.data(), rlen);
+        ok = true;
+      }
+    } catch (...) {
+      ok = false;
+    }
+  }
+  close(fd);
+  return ok;
 }
 
 void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t n,

@@ -48,6 +48,13 @@ class SidecarClient {
   // jax backend name on the worker ("tpu", "cpu", ...)
   const std::string& platform() const { return platform_; }
 
+  // Liveness probe: PING round-trip on a throwaway connection under a
+  // short probe deadline (SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC, default
+  // 5 s) — never the heavy-op deadline, never a pool slot. False ==
+  // worker unreachable/wedged; callers should shut the client down
+  // and run on the host engine.
+  bool heartbeat();
+
   // GROUPBY SUM over a bounded key domain, executed on the worker's
   // device (the MXU Pallas kernel when the backend is a TPU).
   void groupby_sum(const int64_t* keys, const float* vals, int64_t n, int32_t num_keys,

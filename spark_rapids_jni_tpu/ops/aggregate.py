@@ -146,11 +146,14 @@ def _agg_column(col: Column, order, seg, num, how: str) -> Column:
         data = jax.ops.segment_sum(sorted_valid.astype(jnp.int64), seg, num)
         return Column(dt.INT64, data=data)
 
-    if (
-        how in ("var", "std")
-        and d.is_fixed_width
-        and not d.id.name.startswith("DECIMAL")
-    ):
+    if how in ("var", "std"):
+        # numeric inputs only (Spark var_samp/stddev_samp analysis
+        # rule): BOOL8/TIMESTAMP/DURATION would silently compute
+        # variance over raw codes / epoch ticks (ADVICE r5 low #5)
+        if not (d.is_integral or d.is_floating):
+            raise ValueError(
+                f"var/std require a numeric (integral or floating) column, got {d!r}"
+            )
         return _var_std_column(col, order, seg, num, how, sorted_valid)
 
     any_valid = jax.ops.segment_max(sorted_valid.astype(jnp.int32), seg, num) > 0
@@ -241,7 +244,14 @@ def _var_std_column(col: Column, order, seg, num, how: str, sorted_valid) -> Col
     to the DEVIATIONS rather than the raw moments. The [G]-scale
     divide by (n-1) runs in real f64 on the host (this op is an eager
     boundary; the groupby already pays a host sync for the group
-    count)."""
+    count).
+
+    Precision limit on the f64-less (dd) tier: non-FLOAT64 inputs pass
+    through the dd split (~48-bit effective mantissa), so integer
+    values with magnitude above 2^48 lose low bits BEFORE the
+    deviation is formed — var/std of int64 data beyond +-2^48 is
+    approximate there, while the real-f64 backend branch keeps the
+    full 53-bit f64 mantissa (ADVICE r5 low #5)."""
     from . import f64acc
 
     d = col.dtype

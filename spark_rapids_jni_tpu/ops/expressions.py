@@ -282,7 +282,18 @@ class _IsNull(Expression):
 class _When(Expression):
     """SQL CASE WHEN cond THEN a ELSE b END. 3VL: a NULL condition
     selects the ELSE branch (SQL's CASE treats unknown as not-matched);
-    result validity follows the CHOSEN branch per row."""
+    result validity follows the CHOSEN branch per row.
+
+    EAGER EVALUATION (ADVICE r5 low #4): both THEN and ELSE evaluate
+    for every row before the select — the columnar/XLA formulation has
+    no per-row lazy branch. Consequence: an error-capable op in the
+    UNTAKEN branch still raises (an ANSI cast raising CastError on a
+    row the condition would have guarded fails the whole expression),
+    deviating from SQL CASE's guarded-evaluation guarantee. Callers
+    relying on CASE-as-guard must mask/neutralize the branch input
+    BEFORE the error-capable op (e.g. substitute a safe value where
+    the condition selects the other branch), as Spark's own
+    conditional-expression rewrite does."""
 
     def __init__(self, cond, then, other):
         self.cond, self.then, self.other = cond, then, other

@@ -28,9 +28,14 @@ shapes, no serial loops:
 
 Exactness: ranks / counts / row numbers integer-exact; full-partition
 FLOAT64 SUM/MEAN correctly rounded (bit-identical to the groupby
-tier). CUMULATIVE FLOAT64 sums run in the dd (double-f32) domain
-(~2^-48 relative) — a 224-bit prefix scan would serialize the window;
-documented trade.
+tier). CUMULATIVE FLOAT64 sums on the f64-less tier scan the dd hi/lo
+components through plain f32 cumsums, so the hi rounding is never
+compensated into lo: the realized error is ~2^-24 RELATIVE TO THE
+GLOBAL PREFIX magnitude (the segment-entry subtraction anchors error
+to whole-buffer scale, not the partition's), and a running sum stalls
+once the prefix exceeds ~2^24x the element magnitude — a documented
+trade (an exact 224-bit prefix scan would serialize the window;
+ADVICE r5 high). tests/test_window.py pins the realized bound.
 """
 
 from __future__ import annotations
@@ -53,6 +58,9 @@ _RANKS = ("row_number", "rank", "dense_rank")
 _SHIFTS = ("lag", "lead")
 _FULL_AGGS = ("sum", "mean", "min", "max", "count", "var", "std")
 _SUPPORTED = _RANKS + _SHIFTS + _FULL_AGGS + ("cumsum",)
+# order-defined results (ADVICE r5 low #3): silently rank/shift/scan an
+# arbitrary sort order is a wrong answer, not a default
+_ORDER_REQUIRED = ("rank", "dense_rank", "lag", "lead", "cumsum")
 
 
 def _inverse_permutation(order: jnp.ndarray) -> jnp.ndarray:
@@ -86,12 +94,15 @@ def window_aggregate(
 
     ``partition_by``: partition key column names (empty = one global
     partition). ``order_by``: [(column, ascending)] within-partition
-    order (required for rank/row_number/lag/lead/cumsum;
-    full-partition aggregates ignore it). ``aggs``: [(source_col, how,
-    out_name)] with how in {row_number, rank, dense_rank, lag, lead,
-    sum, mean, min, max, count, var, std, cumsum}; lag/lead read offset 1
-    (Spark's default) with NULL at partition edges; source_col is
-    ignored for the rank family (pass any column name).
+    order — REQUIRED (ValueError otherwise) for rank/dense_rank/lag/
+    lead/cumsum, whose results are order-defined; row_number with an
+    empty order_by numbers rows in an unspecified (implementation)
+    order; full-partition aggregates ignore it. ``aggs``:
+    [(source_col, how, out_name)] with how in {row_number, rank,
+    dense_rank, lag, lead, sum, mean, min, max, count, var, std,
+    cumsum}; lag/lead read offset 1 (Spark's default) with NULL at
+    partition edges; source_col is ignored for the rank family (pass
+    any column name).
 
     Returns the input table with the window columns appended, in the
     ORIGINAL row order.
@@ -99,6 +110,11 @@ def window_aggregate(
     for _, how, _ in aggs:
         if how not in _SUPPORTED:
             raise ValueError(f"unknown window function {how!r}")
+        if how in _ORDER_REQUIRED and not order_by:
+            raise ValueError(
+                f"window function {how!r} requires a non-empty order_by "
+                f"(its result is defined by within-partition order)"
+            )
     n = table.num_rows
     out_cols: List[Column] = list(table.columns)
     names: List[str] = list(table.names)

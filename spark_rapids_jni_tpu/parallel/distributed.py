@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.hashing import murmur3_raw
 from .shuffle import _bucketize
-from ._smcache import cached_sm
+from ._smcache import cached_sm, shard_map
 
 __all__ = ["shard_groupby_sum", "distributed_groupby_sum", "distributed_groupby_sum_multi"]
 
@@ -106,7 +106,7 @@ def distributed_groupby_sum(
 
     f = cached_sm(
         ("gb_sum", mesh, axis, int(capacity), cap_g),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
@@ -188,7 +188,7 @@ def distributed_groupby_sum_multi(
 
     f = cached_sm(
         ("gb_sum_multi", mesh, axis, int(capacity), cap_g, nk),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis),) * (nk + 1),

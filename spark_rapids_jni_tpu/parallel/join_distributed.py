@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.dispatch import op_boundary
 from .distributed import _hash_dest
 from .shuffle import _bucketize
-from ._smcache import cached_sm
+from ._smcache import cached_sm, shard_map
 
 __all__ = ["shard_join_pairs", "distributed_inner_join"]
 
@@ -133,7 +133,7 @@ def distributed_inner_join(
 
     f = cached_sm(
         ("join_pairs", mesh, axis, int(capacity), cap_out),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis)),

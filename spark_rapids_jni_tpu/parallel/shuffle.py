@@ -13,8 +13,11 @@ matrix + occupancy mask, all_to_all swaps bucket axes, receivers get
 send to one destination; overflow RAISES RetryableError by default
 (no silent-drop path — VERDICT r3 item 8), with ``on_overflow="flag"``
 as the opt-in contract for capacity-managing callers that recompute
-and retry. Compaction back to dense rows happens host-side or in the
-consuming kernel via the mask.
+and retry, and ``on_overflow="retry"`` as the self-healing contract:
+the exchange doubles capacity (geometric, bounded) and re-executes
+in-op (utils/retry.py orchestrator counters record each escalation).
+Compaction back to dense rows happens host-side or in the consuming
+kernel via the mask.
 """
 
 from __future__ import annotations
@@ -32,7 +35,7 @@ from ..columnar.dtype import TypeId
 from ..ops.hashing import hash_partition_map
 from ..ops.copying import gather
 from ..utils.dispatch import op_boundary
-from ._smcache import cached_sm
+from ._smcache import cached_sm, shard_map
 
 __all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
 
@@ -79,6 +82,35 @@ def _bucketize(vals: jnp.ndarray, dest: jnp.ndarray, n_parts: int, capacity: int
     )
 
 
+def _exchange_once(arrays, dest, mesh: Mesh, axis: str, capacity: int, n_parts: int):
+    """One all-to-all execution at a fixed capacity."""
+
+    def body(dest_local, *arrs):
+        outs = []
+        ovf = jnp.zeros((), bool)
+        mask = None
+        for a in arrs:
+            b, m, o = _bucketize(a, dest_local, n_parts, capacity)
+            # all_to_all: split axis 0 (destinations), concat received
+            r = lax.all_to_all(b, axis, split_axis=0, concat_axis=0, tiled=True)
+            outs.append(r)
+            ovf = ovf | o
+            mask = m
+        rm = lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True)
+        return tuple(outs) + (rm, ovf[None])
+
+    spec = P(axis)
+    in_specs = (spec,) + tuple(spec for _ in arrays)
+    out_specs = tuple(spec for _ in arrays) + (spec, spec)
+    f = cached_sm(
+        ("a2a_exchange", mesh, axis, int(capacity), len(arrays),
+         tuple(str(a.dtype) for a in arrays)),
+        lambda: jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)),
+    )
+    *received, recv_mask, overflow = f(dest, *arrays)
+    return received, recv_mask, overflow
+
+
 @op_boundary("all_to_all_exchange")
 def all_to_all_exchange(
     arrays: Sequence[jnp.ndarray],
@@ -101,41 +133,43 @@ def all_to_all_exchange(
     truncated data. ``on_overflow="raise"`` (default) raises
     ``RetryableError`` — the Spark task-retry class; capacity-managing
     callers (the Table tier recomputes and retries) opt into the
-    flag-only contract with ``on_overflow="flag"``. The defaulted
-    capacity (= rows per shard) cannot overflow.
+    flag-only contract with ``on_overflow="flag"``; and
+    ``on_overflow="retry"`` closes the loop IN-OP: the exchange doubles
+    the capacity (geometric, bounded by the per-shard ceiling that
+    cannot overflow) and re-executes until every row lands — the UCX
+    shuffle transient-failure posture, wired through the retry
+    orchestrator's counters (utils/retry.py). The defaulted capacity
+    (= rows per shard) cannot overflow.
     """
-    if on_overflow not in ("raise", "flag"):
-        raise ValueError(f"on_overflow must be 'raise' or 'flag', got {on_overflow!r}")
+    if on_overflow not in ("raise", "flag", "retry"):
+        raise ValueError(
+            f"on_overflow must be 'raise', 'flag', or 'retry', got {on_overflow!r}"
+        )
+    if capacity is not None and capacity < 1:
+        # capacity=0 would make the geometric escalation a fixed point
+        # (2*0 == 0): the retry loop must always be able to grow
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
     n_parts = mesh.shape[axis]
     n_global = dest.shape[0]
     per_shard = n_global // n_parts
     if capacity is None:
         capacity = per_shard  # safe: one shard can absorb everything
 
-    def body(dest_local, *arrs):
-        outs = []
-        ovf = jnp.zeros((), bool)
-        mask = None
-        for a in arrs:
-            b, m, o = _bucketize(a, dest_local, n_parts, capacity)
-            # all_to_all: split axis 0 (destinations), concat received
-            r = lax.all_to_all(b, axis, split_axis=0, concat_axis=0, tiled=True)
-            outs.append(r)
-            ovf = ovf | o
-            mask = m
-        rm = lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True)
-        return tuple(outs) + (rm, ovf[None])
+    while True:
+        received, recv_mask, overflow = _exchange_once(
+            arrays, dest, mesh, axis, int(capacity), n_parts
+        )
+        overflowed = bool(np.asarray(overflow).any())
+        if not overflowed or on_overflow == "flag":
+            return received, recv_mask, overflow
+        if on_overflow == "retry" and capacity < per_shard:
+            # geometric escalation: at most ceil(log2(per_shard/cap0))
+            # re-executions before the cannot-overflow ceiling
+            capacity = min(2 * int(capacity), per_shard)
+            from ..utils import retry as retry_mod
 
-    spec = P(axis)
-    in_specs = (spec,) + tuple(spec for _ in arrays)
-    out_specs = tuple(spec for _ in arrays) + (spec, spec)
-    f = cached_sm(
-        ("a2a_exchange", mesh, axis, int(capacity), len(arrays),
-         tuple(str(a.dtype) for a in arrays)),
-        lambda: jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)),
-    )
-    *received, recv_mask, overflow = f(dest, *arrays)
-    if on_overflow == "raise" and bool(np.asarray(overflow).any()):
+            retry_mod.record_capacity_retry()
+            continue
         from ..utils.errors import RetryableError
 
         raise RetryableError(
@@ -143,7 +177,6 @@ def all_to_all_exchange(
             f"capacity={capacity} rows; retry with a larger capacity "
             f"(rows would otherwise be dropped)"
         )
-    return received, recv_mask, overflow
 
 
 @op_boundary("exchange_by_key")
@@ -162,7 +195,16 @@ def exchange_by_key(
     null rows stay null on the receiving shard. Rows of one key all land
     on the same shard (hash pmod, ops/hashing parity with the
     single-device partitioner).
+
+    ``on_overflow="retry"`` makes a capacity overflow self-healing: the
+    exchange doubles ``capacity`` (geometric, bounded by the per-shard
+    ceiling) and re-executes the all-to-all instead of raising — the
+    shuffle-side half of the retry orchestrator (utils/retry.py).
     """
+    if on_overflow not in ("raise", "flag", "retry"):
+        raise ValueError(
+            f"on_overflow must be 'raise', 'flag', or 'retry', got {on_overflow!r}"
+        )
     for c in table.columns:
         if c.dtype.id in (TypeId.STRING, TypeId.LIST):
             raise ValueError(

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..utils.dispatch import op_boundary
 from .shuffle import _bucketize
-from ._smcache import cached_sm
+from ._smcache import cached_sm, shard_map
 
 __all__ = ["distributed_sort"]
 
@@ -81,7 +81,7 @@ def distributed_sort(
 
     f = cached_sm(
         ("sample_sort", mesh, axis, int(capacity), int(samples_per)),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(axis),), out_specs=(P(axis), P(axis), P(axis))
         )),
     )

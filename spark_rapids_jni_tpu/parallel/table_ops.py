@@ -52,7 +52,7 @@ from ..utils.dispatch import op_boundary
 from .distributed import _hash_dest_multi
 from .join_distributed import shard_join_pairs
 from .shuffle import _bucketize
-from ._smcache import cached_sm
+from ._smcache import cached_sm, shard_map
 
 __all__ = [
     "dict_encode",
@@ -263,7 +263,7 @@ def exchange_table(
         ("exchange_table", mesh, axis, int(capacity), len(lanes),
          tuple(str(a.dtype) for a in lanes),
          tuple(key_pos), tuple(has_v)),  # body statics: which lanes hash as keys
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body,
             mesh=mesh,
             in_specs=(spec,) * (1 + len(lanes)),
@@ -659,7 +659,7 @@ def _groupby_once(
         ("gb_table", mesh, axis, int(capacity), cap_g, n_keys, n_vals,
          tuple(hows), tuple(f64_flags), tuple(v is not None for v in val_valid),
          tuple(str(a.dtype) for a in key_lanes + val_lanes)),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body,
             mesh=mesh,
             in_specs=(spec,) * (n_keys + 1 + n_vals + len(valid_lanes)),
@@ -931,7 +931,7 @@ def _join_once(
         ("join_table", mesh, axis, int(capacity), cap_out, how,
          tuple(l_kpos), tuple(r_kpos), nl_lanes, nr_lanes,
          tuple(str(a.dtype) for a in in_lanes)),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             body, mesh=mesh, in_specs=(spec,) * len(in_lanes), out_specs=(spec,) * n_out
         )),
     )

@@ -193,6 +193,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.srjt_device_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32]
     lib.srjt_device_platform.restype = ctypes.c_char_p
     lib.srjt_device_shutdown.restype = None
+    try:
+        lib.srjt_device_heartbeat.restype = ctypes.c_int32
+    except AttributeError:
+        # a stale libsrjt.so predating the supervision tier: the rest
+        # of the ABI keeps working; device_heartbeat() reports False
+        pass
     lib.srjt_device_groupby_sum.restype = ctypes.c_int32
     lib.srjt_device_groupby_sum.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float),
@@ -687,10 +693,29 @@ def device_shutdown() -> None:
         lib.srjt_device_shutdown()
 
 
+def device_heartbeat() -> bool:
+    """Liveness probe of the connected sidecar worker: True iff a PING
+    round-trips on a throwaway connection under the short probe
+    deadline (SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC, default 5 s — NOT
+    the heavy-op SRJT_SIDECAR_TIMEOUT_SEC). False means no sidecar, a
+    wedged worker, or a libsrjt.so predating the supervision ABI."""
+    lib = native_lib()
+    if lib is None or not hasattr(lib, "srjt_device_heartbeat"):
+        return False
+    return bool(lib.srjt_device_heartbeat())
+
+
 def device_groupby_sum(keys, vals, num_keys: int):
     """GROUP BY SUM executed on the sidecar's device (the MXU Pallas
-    kernel when the backend is a TPU). keys int64[n], vals float32[n]."""
+    kernel when the backend is a TPU). keys int64[n], vals float32[n].
+
+    With the retry orchestrator armed (SRJT_RETRY_ENABLED=1 /
+    utils.retry.enable()), RETRYABLE-classified native failures —
+    including the native fault injector's ``RETRYABLE:``-prefixed
+    storms — re-run under bounded backoff before surfacing."""
     import numpy as np
+
+    from .utils import retry
 
     lib = native_lib()
     if lib is None:
@@ -701,15 +726,24 @@ def device_groupby_sum(keys, vals, num_keys: int):
         raise ValueError(f"keys/vals length mismatch: {len(keys)} vs {len(vals)}")
     sums = np.empty(num_keys, np.float32)
     counts = np.empty(num_keys, np.int64)
-    rc = lib.srjt_device_groupby_sum(
-        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        len(keys), num_keys,
-        sums.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-    )
-    if rc != 0:
-        _raise_last(lib)
+
+    def attempt():
+        rc = lib.srjt_device_groupby_sum(
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(keys), num_keys,
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        if rc != 0:
+            _raise_last(lib)
+
+    # same nesting guard as utils/dispatch.py: when an enclosing armed
+    # boundary already owns a retry loop, this op must not multiply it
+    if retry.is_enabled() and not retry.in_attempt():
+        retry.call_with_retry(attempt, op_name="device_groupby_sum")
+    else:
+        attempt()
     return sums, counts
 
 

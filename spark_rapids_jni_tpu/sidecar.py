@@ -61,6 +61,24 @@ Response status codes: 0 ok, 1 generic error (utf-8 message; the C++
 client falls back to the host engine), 2 CAST ERROR (semantic ANSI
 failure — the client re-raises through the g_cast_error protocol, it
 must NOT fall back and silently re-run on the CPU).
+
+Supervision (this round): ``SupervisedClient`` is the Python-side
+client with the robustness contract a wedged worker demands —
+per-request DEADLINE (``SRJT_SIDECAR_DEADLINE_S``, falling back to
+the C++ client's ``SRJT_SIDECAR_TIMEOUT_SEC`` so one knob tunes both
+twins; socket timeout, so a hung worker surfaces as RetryableError
+instead of blocking the executor forever), heartbeat PING (``SRJT_SIDECAR_HEARTBEAT_S``: a
+connection idle past the interval is probed with a cheap PING before
+carrying a heavy op), reconnect-on-desync (any transport fault or
+malformed frame closes the socket; the next request dials fresh), and
+host degrade: ``call()`` runs the op through the retry orchestrator
+(utils/retry.py) and, when the worker is truly gone (fatal
+classification or retry exhaustion), executes the SAME op in-process
+via ``_dispatch`` — the host-CPU engine — so results keep flowing.
+``worker_errors_are_classified``: a worker-side error message
+prefixed ``RetryableError:`` / ``FatalDeviceError:`` (the worker's
+op_boundary taxonomy stringified over the wire) is re-raised as that
+class on the client, which is what makes remote faults retryable.
 """
 
 from __future__ import annotations
@@ -70,6 +88,7 @@ import os
 import socket
 import struct
 import sys
+import time
 
 OP_PING = 0
 OP_GROUPBY_SUM_F32 = 1
@@ -426,6 +445,281 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
         for fd in fds:
             os.close(fd)
         conn.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised Python client (the executor-side path; C++ twin: sidecar.cc)
+# ---------------------------------------------------------------------------
+
+
+def _env_seconds(name: str, default: float) -> float:
+    # shared validated parser (utils/retry.py): malformed or <= 0
+    # values warn and keep the default — a zero deadline would make
+    # the socket non-blocking, not timeout-free (the C++ twin applies
+    # the same v > 0 rule)
+    from .utils.retry import env_float
+
+    return env_float(os.environ, name, default, positive=True)
+
+
+class SupervisedClient:
+    """Sidecar client with connection supervision.
+
+    Robustness contract (ISSUE: sidecar connection supervision):
+
+    - every socket operation runs under a per-request DEADLINE; a
+      wedged worker yields ``RetryableError("DEADLINE_EXCEEDED...")``
+      — never an indefinite block holding the executor,
+    - a connection idle longer than ``heartbeat_s`` is probed with a
+      PING before carrying a real op, so a silently dead worker is
+      detected by a 12-byte round-trip instead of a multi-second op
+      timing out,
+    - any transport fault or malformed frame DESYNCS the byte stream:
+      the socket is closed immediately and the next request reconnects
+      fresh (a desynced stream must never carry another frame),
+    - ``call()`` wraps ``request()`` in the retry orchestrator and
+      degrades to the in-process host-CPU engine (``_dispatch``) when
+      the worker is fatally gone — bounded by the deadline, no hang,
+      no silent drop.
+    """
+
+    def __init__(
+        self,
+        sock_path: str,
+        deadline_s: float = None,
+        heartbeat_s: float = None,
+    ):
+        self.sock_path = sock_path
+        if deadline_s is None:
+            # one deadline knob across both clients: the C++ twin
+            # (native/src/sidecar.cc) reads SRJT_SIDECAR_TIMEOUT_SEC,
+            # honored here too; SRJT_SIDECAR_DEADLINE_S (float) wins
+            # when both are set
+            deadline_s = _env_seconds(
+                "SRJT_SIDECAR_DEADLINE_S",
+                _env_seconds("SRJT_SIDECAR_TIMEOUT_SEC", 600.0),
+            )
+        self.deadline_s = float(deadline_s)
+        self.heartbeat_s = (
+            _env_seconds("SRJT_SIDECAR_HEARTBEAT_S", 30.0)
+            if heartbeat_s is None
+            else float(heartbeat_s)
+        )
+        self._sock: socket.socket = None
+        self._last_io = 0.0
+        self._ever_connected = False
+        self.reconnects = 0  # supervision observability: REDIALS only
+        self.host_fallbacks = 0
+
+    # -- connection lifecycle ------------------------------------------------
+
+    def connect(self) -> None:
+        from .utils.errors import RetryableError
+
+        self.close()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.deadline_s)
+        try:
+            s.connect(self.sock_path)
+        except (OSError, socket.timeout) as e:
+            s.close()
+            raise RetryableError(f"sidecar: UNAVAILABLE: connect failed ({e})") from e
+        if self._ever_connected:
+            self.reconnects += 1  # a redial, not the initial dial
+        self._ever_connected = True
+        self._sock = s
+        self._last_io = time.monotonic()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- framed request/response under a deadline ----------------------------
+
+    def _recv_deadline(self, n: int, deadline: float) -> bytes:
+        """Read exactly n bytes under a WHOLE-REQUEST deadline: the
+        socket timeout shrinks to the remaining budget each iteration,
+        so a slow-dripping worker (one chunk per almost-deadline) cannot
+        stretch one request past ``deadline_s`` total — the bound the
+        supervision contract advertises, not a per-recv idle timeout."""
+        buf = bytearray()
+        while len(buf) < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("request deadline exhausted")
+            self._sock.settimeout(remaining)
+            chunk = self._sock.recv(min(n - len(buf), 1 << 20))
+            if not chunk:
+                raise ConnectionError("sidecar: peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _raw_request(self, op: int, payload: bytes):
+        """One request/response exchange on the live socket, bounded by
+        one per-request deadline end to end. Any transport fault closes
+        the connection (desync discipline) and raises RetryableError."""
+        from .utils.errors import RetryableError
+
+        deadline = time.monotonic() + self.deadline_s
+        try:
+            self._sock.settimeout(self.deadline_s)
+            self._sock.sendall(struct.pack("<IQ", op, len(payload)) + payload)
+            hdr = self._recv_deadline(12, deadline)
+            status, rlen = struct.unpack("<IQ", hdr)
+            resp = self._recv_deadline(rlen, deadline) if rlen else b""
+        except socket.timeout as e:
+            self.close()
+            raise RetryableError(
+                f"sidecar: DEADLINE_EXCEEDED: op {op} exceeded "
+                f"{self.deadline_s}s request deadline"
+            ) from e
+        except (ConnectionError, OSError) as e:
+            self.close()
+            raise RetryableError(f"sidecar: Socket closed mid-request ({e})") from e
+        self._last_io = time.monotonic()
+        return status & ~ARENA_FLAG, resp
+
+    def ping(self) -> str:
+        """Heartbeat round-trip; returns the worker's backend name."""
+        if self._sock is None:
+            self.connect()
+        status, resp = self._raw_request(OP_PING, b"")
+        if status != STATUS_OK:
+            from .utils.errors import RetryableError
+
+            self.close()
+            raise RetryableError("sidecar: PING failed (worker unhealthy)")
+        return resp.decode()
+
+    def request(self, op: int, payload: bytes) -> bytes:
+        """Supervised exchange: reconnect when needed, heartbeat stale
+        connections, classify worker-side errors into the
+        fatal/retryable taxonomy."""
+        from .utils.errors import FatalDeviceError, RetryableError
+
+        if self._sock is None:
+            self.connect()
+            self.reconnects += 1
+        elif time.monotonic() - self._last_io > self.heartbeat_s:
+            try:
+                self.ping()
+            except RetryableError:
+                # stale connection died quietly: one immediate redial,
+                # then the request proceeds (or fails retryably)
+                self.connect()
+                self.reconnects += 1
+        status, resp = self._raw_request(op, payload)
+        if status == STATUS_OK:
+            return resp
+        msg = resp.decode("utf-8", "replace")
+        if status == STATUS_CAST_ERROR:
+            # semantic ANSI failure: transport healthy, not retryable —
+            # surface the protocol payload to the caller unchanged
+            raise _cast_error_from_wire(resp)
+        # worker-side failure text carries the taxonomy prefix from the
+        # worker's own op_boundary classification
+        if msg.startswith("RetryableError:"):
+            raise RetryableError(f"sidecar worker: {msg}")
+        if msg.startswith("FatalDeviceError:"):
+            raise FatalDeviceError(f"sidecar worker: {msg}")
+        raise RuntimeError(f"sidecar worker: {msg}")
+
+    # -- degrade-to-host orchestration ---------------------------------------
+
+    def call(self, op: int, payload: bytes) -> bytes:
+        """Run ``op`` on the worker under the retry orchestrator;
+        degrade to the in-process host-CPU engine when the worker is
+        gone. The degrade is BOUNDED: worst case is
+        max_attempts x (deadline + backoff), then the host result."""
+        from .utils import retry
+        from .utils.errors import DeviceError
+
+        try:
+            return retry.call_with_retry(
+                self.request, op, payload, op_name=f"sidecar_op_{op}"
+            )
+        except DeviceError:
+            # fatal worker (or retry exhaustion): the op still completes
+            # — same kernels, host backend, in-process
+            self.host_fallbacks += 1
+            self.close()
+            return _dispatch(op, payload, "host-fallback")
+
+
+def _cast_error_from_wire(resp: bytes):
+    from .ops.cast_string import CastError
+
+    if len(resp) < 9:
+        from .utils.errors import RetryableError
+
+        return RetryableError("sidecar: malformed cast-error frame (desync)")
+    (row,) = struct.unpack_from("<q", resp, 0)
+    is_null = resp[8] != 0
+    val = None if is_null else resp[9:].decode("utf-8", "replace")
+    return CastError(int(row), val)
+
+
+def spawn_worker(
+    sock_path: str = None,
+    python_exe: str = None,
+    startup_timeout_s: float = 60.0,
+    env: dict = None,
+):
+    """Spawn ``python -m spark_rapids_jni_tpu.sidecar`` and wait for its
+    socket (the pure-Python twin of SidecarClient's fork/exec path in
+    native/src/sidecar.cc). Returns (Popen, sock_path). Caller owns
+    shutdown (OP_SHUTDOWN or terminate())."""
+    import subprocess
+    import tempfile
+
+    if sock_path is None:
+        fd, tmp = tempfile.mkstemp(prefix="srjt-sidecar-")
+        os.close(fd)
+        os.unlink(tmp)
+        sock_path = tmp + ".sock"
+    full_env = dict(os.environ)
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pp = full_env.get("PYTHONPATH", "")
+    if pkg_parent not in pp.split(os.pathsep):
+        full_env["PYTHONPATH"] = f"{pkg_parent}{os.pathsep}{pp}" if pp else pkg_parent
+    if env:
+        full_env.update(env)
+    proc = subprocess.Popen(
+        [python_exe or sys.executable, "-m", "spark_rapids_jni_tpu.sidecar",
+         "--socket", sock_path],
+        env=full_env,
+    )
+    deadline = time.monotonic() + startup_timeout_s
+    while True:
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.connect(sock_path)
+            probe.close()
+            return proc, sock_path
+        except OSError:
+            probe.close()
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"sidecar worker exited during startup (rc={proc.returncode})"
+            )
+        if time.monotonic() > deadline:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)  # reap: no zombie in the executor
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=10)
+            raise RuntimeError("sidecar worker startup timed out")
+        time.sleep(0.05)
 
 
 def serve(sock_path: str) -> None:

@@ -1,4 +1,5 @@
 """Aux subsystems (SURVEY §5): op-boundary dispatch instrumentation,
-fault injection, tracing/profiling hooks, error classification."""
+fault injection, tracing/profiling hooks, error classification, and
+the retry orchestrator (backoff / split / capacity re-try)."""
 
-from . import dispatch, errors, faultinj, tracing  # noqa: F401
+from . import dispatch, errors, faultinj, retry, tracing  # noqa: F401

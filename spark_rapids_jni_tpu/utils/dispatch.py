@@ -3,8 +3,11 @@
 Every reference JNI export runs the same preamble — device binding,
 exception translation, NVTX range (RowConversionJni.cpp:42-57 pattern,
 SURVEY §2.2). ``op_boundary`` is that preamble for the TPU build: fault
-injection hook, tracing scope, and backend-error classification
-(fatal vs retryable) in one decorator applied to public ops.
+injection hook, tracing scope, backend-error classification (fatal vs
+retryable), and — when the retry orchestrator is armed
+(utils/retry.py, ``SRJT_RETRY_ENABLED=1``) — bounded retry with
+exponential backoff for RetryableError, all in one decorator applied
+to public ops.
 """
 
 from __future__ import annotations
@@ -21,30 +24,47 @@ def op_boundary(name: str):
     """Wrap a public op with the dispatch preamble.
 
     - ``faultinj.maybe_inject(name)`` fires configured faults first
-      (the CUPTI-callback interception point),
+      (the CUPTI-callback interception point); injection sits INSIDE
+      the retry attempt so chaos-injected RetryableErrors exercise the
+      recovery path, not just the classification,
     - ``tracing.func_range(name)`` scopes the body for XProf,
     - backend exceptions are classified into Fatal/Retryable
       (CATCH_STD analog); host-side ValueError/TypeError/KeyError/
-      IndexError pass through unchanged.
+      IndexError pass through unchanged,
+    - with the retry orchestrator armed, RetryableError re-runs the op
+      under the module RetryPolicy; FatalDeviceError NEVER retries.
+      Disarmed (the default), RetryableError propagates to the caller
+      unchanged — the seed's Spark-task-retry contract.
     """
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            faultinj.maybe_inject(name)
-            with tracing.func_range(name):
-                try:
-                    return fn(*args, **kwargs)
-                except DeviceError:
-                    raise
-                except (ValueError, TypeError, KeyError, IndexError):
-                    raise
-                except Exception as e:  # backend / runtime failures
-                    if type(e).__module__.startswith("spark_rapids_jni_tpu"):
-                        # the op's own documented API errors (CastError,
-                        # ParquetReadError, ...) are results, not failures
+            def attempt():
+                faultinj.maybe_inject(name)
+                with tracing.func_range(name):
+                    try:
+                        return fn(*args, **kwargs)
+                    except DeviceError:
                         raise
-                    raise classify(e) from e
+                    except (ValueError, TypeError, KeyError, IndexError):
+                        raise
+                    except Exception as e:  # backend / runtime failures
+                        if type(e).__module__.startswith("spark_rapids_jni_tpu"):
+                            # the op's own documented API errors (CastError,
+                            # ParquetReadError, ...) are results, not failures
+                            raise
+                        raise classify(e) from e
+
+            from . import retry
+
+            # only the OUTERMOST boundary owns the retry loop: a nested
+            # op's RetryableError propagates to the outer attempt, so a
+            # persistent failure costs max_attempts total re-runs, not
+            # max_attempts^nesting-depth
+            if retry.is_enabled() and not retry.in_attempt():
+                return retry.call_with_retry(attempt, op_name=name)
+            return attempt()
 
         return wrapper
 

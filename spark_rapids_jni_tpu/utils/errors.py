@@ -47,6 +47,16 @@ _RETRYABLE_MARKERS = (
     "DEADLINE_EXCEEDED",
     "Socket closed",
     "transient",
+    # sidecar transport faults (utils/retry.py supervision): a refused/
+    # reset connection means the worker died or is restarting — the
+    # task retries (reconnect or host fallback), the executor survives.
+    # Deliberately NOT "timed out": that substring appears in wedged-
+    # mesh/collective backend errors where the conservative fatal
+    # classification (executor replacement) must win; sidecar deadline
+    # errors carry their own DEADLINE_EXCEEDED marker.
+    "Connection refused",
+    "Connection reset",
+    "Broken pipe",
 )
 
 

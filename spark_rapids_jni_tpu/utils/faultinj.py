@@ -11,8 +11,16 @@ sit. Feature parity:
 - match by exact op name or ``"*"`` wildcard (:142-152),
 - injection types: ``fatal`` (FatalDeviceError — the trap/assert
   analog, :135-140), ``retryable`` (RetryableError), ``exception``
-  (plain RuntimeError — the FI_RETURN_VALUE analog),
+  (plain RuntimeError — the FI_RETURN_VALUE analog), ``delay``
+  (injected latency of ``delayMs`` milliseconds, no exception — the
+  wedged-kernel analog that exercises timeout/deadline paths),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
+- per-rule SCHEDULING so chaos tests hit backoff/timeout paths
+  deterministically: ``after`` skips the first N matching dispatches
+  before the rule arms (faults mid-pipeline, not at step one);
+  ``ramp`` scales the effective percent linearly from ``percent/ramp``
+  up to ``percent`` over the first ``ramp`` armed dispatches (a storm
+  that builds instead of a step function),
 - deterministic via ``seed`` (:369-392),
 - hot reload: config file mtime is polled on each dispatch (the
   inotify-thread analog, :429-480) when loaded from a path.
@@ -24,6 +32,8 @@ Config schema (faultinj/README.md:61-141 shape)::
       "faults": {
         "convert_to_rows": {"type": "retryable", "percent": 50,
                              "interceptionCount": 2},
+        "all_to_all_exchange": {"type": "delay", "percent": 30,
+                                 "delayMs": 5, "after": 2, "ramp": 4},
         "*": {"type": "fatal", "percent": 1}
       }
     }
@@ -35,6 +45,7 @@ import json
 import os
 import random
 import threading
+import time
 from typing import Dict, Optional
 
 from .errors import FatalDeviceError, RetryableError
@@ -43,12 +54,24 @@ __all__ = ["configure", "configure_from_file", "disable", "maybe_inject", "is_en
 
 
 class _Rule:
-    __slots__ = ("kind", "percent", "budget")
+    __slots__ = ("kind", "percent", "budget", "delay_ms", "after", "ramp", "calls")
 
-    def __init__(self, kind: str, percent: float, budget: Optional[int]):
+    def __init__(
+        self,
+        kind: str,
+        percent: float,
+        budget: Optional[int],
+        delay_ms: float = 0.0,
+        after: int = 0,
+        ramp: int = 0,
+    ):
         self.kind = kind
         self.percent = percent
         self.budget = budget  # None == unlimited
+        self.delay_ms = delay_ms  # kind == "delay" only
+        self.after = after  # matching dispatches to skip before arming
+        self.ramp = ramp  # armed dispatches over which percent scales in
+        self.calls = 0  # matching dispatches seen (scheduling state)
 
 
 class _State:
@@ -68,11 +91,18 @@ def _parse(cfg: dict) -> None:
     _state.rules = {}
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
-        if kind not in ("fatal", "retryable", "exception"):
+        if kind not in ("fatal", "retryable", "exception", "delay"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
-        _state.rules[name] = _Rule(kind, percent, None if budget is None else int(budget))
+        delay_ms = float(spec.get("delayMs", 50))
+        after = int(spec.get("after", 0))
+        ramp = int(spec.get("ramp", 0))
+        if delay_ms < 0 or after < 0 or ramp < 0:
+            raise ValueError("faultinj: delayMs/after/ramp must be non-negative")
+        _state.rules[name] = _Rule(
+            kind, percent, None if budget is None else int(budget), delay_ms, after, ramp
+        )
     _state.rng = random.Random(cfg.get("seed"))
 
 
@@ -121,7 +151,8 @@ def _reload_if_changed() -> None:
 
 def maybe_inject(op_name: str) -> None:
     """Called by op_boundary before dispatch; raises the configured
-    fault or returns. Cheap when disabled (one attribute read)."""
+    fault, sleeps (``delay`` kind), or returns. Cheap when disabled
+    (one attribute read)."""
     if not _state.enabled:
         return
     with _state.lock:
@@ -131,15 +162,32 @@ def maybe_inject(op_name: str) -> None:
             return
         if rule.budget is not None and rule.budget <= 0:
             return
-        if _state.rng.uniform(0, 100) >= rule.percent:
+        # scheduling: count every matching dispatch; hold fire for the
+        # first `after`, then ramp the effective percent over `ramp`
+        # armed dispatches. The RNG draw happens only once armed, so a
+        # seeded storm is bit-reproducible regardless of `after`.
+        rule.calls += 1
+        if rule.calls <= rule.after:
+            return
+        percent = rule.percent
+        if rule.ramp:
+            armed = rule.calls - rule.after
+            percent *= min(1.0, armed / rule.ramp)
+        if _state.rng.uniform(0, 100) >= percent:
             return
         if rule.budget is not None:
             rule.budget -= 1
         kind = rule.kind
+        delay_ms = rule.delay_ms
     if kind == "fatal":
         raise FatalDeviceError(f"injected fatal fault in {op_name}")
     if kind == "retryable":
         raise RetryableError(f"injected retryable fault in {op_name}")
+    if kind == "delay":
+        # latency, not failure: sleeps OUTSIDE the injector lock so a
+        # delay storm cannot serialize every other dispatch behind it
+        time.sleep(delay_ms / 1000.0)
+        return
     raise RuntimeError(f"injected exception in {op_name}")
 
 

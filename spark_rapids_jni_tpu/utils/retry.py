@@ -1,0 +1,404 @@
+"""Retry orchestrator: bounded backoff, retry-with-split, and the
+capacity re-try loop (SURVEY §5 recovery; the RmmRapidsRetryIterator
+analog for the TPU tier).
+
+The error taxonomy (utils/errors.py) splits device failures into
+``FatalDeviceError`` (executor must be replaced — NEVER retried here)
+and ``RetryableError`` (transient — Spark task-retry semantics re-run
+the batch). The seed classified but never recovered: a RetryableError
+propagated straight to the caller and killed the query. This module
+closes that loop with three strategies:
+
+1. **Bounded retry + exponential backoff + jitter**
+   (``call_with_retry``): re-run the failed operation up to
+   ``max_attempts`` times, sleeping ``base * 2^attempt`` ms (capped at
+   ``max_delay_ms``) with multiplicative jitter between attempts —
+   the reference plugin's retry framework posture, and what UCX
+   shuffle does for transient transport failures.
+2. **Retry-with-split** (``retry_with_split``): on
+   RESOURCE_EXHAUSTED-class failures the orchestrator halves the input
+   batch, runs the halves independently (each again under bounded
+   retry, splitting recursively up to ``split_depth``), and reassembles
+   the results — the RmmRapidsRetryIterator ``withRetry``/
+   ``splitAndRetry`` discipline: a batch too big for device memory is
+   not a fatal condition, it is two smaller batches.
+3. **Capacity re-try** lives where the capacity does:
+   ``parallel/shuffle.py`` ``on_overflow="retry"`` doubles the bucket
+   capacity (geometric, bounded by the cannot-overflow per-shard
+   ceiling) and re-executes the all-to-all; this module only counts it
+   (``stats().capacity_retries``).
+
+Configuration: environment (read once at import) or programmatic.
+
+    SRJT_RETRY_ENABLED       "1"/"true" arms op-boundary retry (default off)
+    SRJT_RETRY_MAX_ATTEMPTS  total attempts incl. the first (default 4)
+    SRJT_RETRY_BASE_DELAY_MS first backoff (default 25)
+    SRJT_RETRY_MAX_DELAY_MS  backoff ceiling (default 1000)
+    SRJT_RETRY_JITTER        multiplicative jitter fraction in [0,1)
+                             (default 0.25: sleep in [0.75x, 1.25x])
+    SRJT_RETRY_SPLIT_DEPTH   max halvings in retry_with_split (default 3)
+    SRJT_RETRY_SEED          jitter RNG seed (deterministic chaos runs)
+
+Op-boundary wiring (utils/dispatch.py): when the orchestrator is
+enabled, every ``op_boundary`` op retries injected/classified
+RetryableErrors transparently; disabled (the default) the seed's
+propagate-to-caller contract is unchanged, so capacity-managing callers
+and the existing test surface keep their semantics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from .errors import FatalDeviceError, RetryableError
+
+__all__ = [
+    "RetryPolicy",
+    "call_with_retry",
+    "retry_with_split",
+    "is_resource_exhausted",
+    "configure",
+    "policy",
+    "enable",
+    "disable",
+    "is_enabled",
+    "enabled",
+    "stats",
+    "reset_stats",
+]
+
+
+def env_float(env, key: str, default: float, positive: bool = False) -> float:
+    """Parse a float env knob, warning and falling back to ``default``
+    on malformed input — and, with ``positive=True``, on values <= 0
+    (matching the C++ client's v > 0 validation: a zero deadline would
+    make sockets non-blocking, not timeout-free). Shared by the retry
+    and sidecar-supervision tiers."""
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"retry: ignoring malformed {key}={raw!r}", stacklevel=2)
+        return default
+    if positive and v <= 0:
+        import warnings
+
+        warnings.warn(
+            f"retry: {key}={raw!r} must be > 0; keeping default {default}",
+            stacklevel=2,
+        )
+        return default
+    return v
+
+
+class RetryPolicy:
+    """Immutable-ish bundle of retry knobs; see module docstring for
+    the matching SRJT_RETRY_* environment schema."""
+
+    __slots__ = (
+        "max_attempts",
+        "base_delay_ms",
+        "max_delay_ms",
+        "jitter",
+        "split_depth",
+        "sleep",
+        "_rng",
+    )
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay_ms: float = 25.0,
+        max_delay_ms: float = 1000.0,
+        jitter: float = 0.25,
+        split_depth: int = 3,
+        seed: Optional[int] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if base_delay_ms < 0 or max_delay_ms < 0:
+            raise ValueError("backoff delays must be non-negative")
+        if not (0 <= jitter < 1):
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        if split_depth < 0:
+            raise ValueError(f"split_depth must be >= 0, got {split_depth}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_ms = float(base_delay_ms)
+        self.max_delay_ms = float(max_delay_ms)
+        self.jitter = float(jitter)
+        self.split_depth = int(split_depth)
+        self.sleep = sleep
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy":
+        env = os.environ if env is None else env
+        seed_raw = env.get("SRJT_RETRY_SEED")
+        return cls(
+            max_attempts=int(env_float(env, "SRJT_RETRY_MAX_ATTEMPTS", 4, positive=True)),
+            base_delay_ms=env_float(env, "SRJT_RETRY_BASE_DELAY_MS", 25.0),
+            max_delay_ms=env_float(env, "SRJT_RETRY_MAX_DELAY_MS", 1000.0),
+            jitter=env_float(env, "SRJT_RETRY_JITTER", 0.25),
+            split_depth=int(env_float(env, "SRJT_RETRY_SPLIT_DEPTH", 3)),
+            seed=int(seed_raw) if seed_raw else None,
+        )
+
+    def backoff_ms(self, attempt: int) -> float:
+        """Delay before re-running attempt ``attempt + 1`` (0-based):
+        exponential with multiplicative jitter (so a fleet of executors
+        retrying the same stall does not re-stampede in lockstep),
+        clamped LAST — ``max_delay_ms`` is a hard ceiling, never
+        exceeded by jitter."""
+        raw = self.base_delay_ms * (2.0**attempt)
+        if self.jitter:
+            raw *= self._rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
+        return min(raw, self.max_delay_ms)
+
+
+class _Stats:
+    """Cross-thread counters for observability and chaos assertions."""
+
+    __slots__ = ("lock", "attempts", "retries", "splits", "capacity_retries",
+                 "fatal", "exhausted", "backoff_ms_total")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        self.attempts = 0
+        self.retries = 0
+        self.splits = 0
+        self.capacity_retries = 0
+        self.fatal = 0
+        self.exhausted = 0
+        self.backoff_ms_total = 0.0
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "attempts": self.attempts,
+                "retries": self.retries,
+                "splits": self.splits,
+                "capacity_retries": self.capacity_retries,
+                "fatal": self.fatal,
+                "exhausted": self.exhausted,
+                "backoff_ms_total": self.backoff_ms_total,
+            }
+
+
+_stats = _Stats()
+
+
+def stats() -> dict:
+    return _stats.snapshot()
+
+
+def reset_stats() -> None:
+    with _stats.lock:
+        _stats.reset()
+
+
+def record_capacity_retry(n: int = 1) -> None:
+    """Called by the shuffle capacity re-try loop (parallel/shuffle.py)."""
+    with _stats.lock:
+        _stats.capacity_retries += n
+
+
+# ---------------------------------------------------------------------------
+# module-level policy + arming (env once, programmatic any time)
+# ---------------------------------------------------------------------------
+
+try:
+    _policy = RetryPolicy.from_env()
+except ValueError as _e:  # out-of-range knobs degrade, never crash import
+    import warnings
+
+    warnings.warn(f"retry: invalid SRJT_RETRY_* configuration ({_e}); using defaults")
+    _policy = RetryPolicy()
+_enabled = os.environ.get("SRJT_RETRY_ENABLED", "").lower() in ("1", "true", "yes")
+_lock = threading.Lock()
+
+# per-thread nesting guard: only the OUTERMOST armed op_boundary owns
+# the retry loop. Without it, layered boundaries (exchange_by_key ->
+# all_to_all_exchange) would multiply attempts (max_attempts^depth)
+# and stack backoff sleeps before a persistent failure surfaces.
+_tls = threading.local()
+
+
+def in_attempt() -> bool:
+    """True while a call_with_retry attempt is executing on this
+    thread (utils/dispatch.py consults this to keep nested boundaries
+    from opening their own retry loops)."""
+    return getattr(_tls, "depth", 0) > 0
+
+
+def policy() -> RetryPolicy:
+    return _policy
+
+
+def configure(**kwargs) -> RetryPolicy:
+    """Replace the module policy (same keywords as RetryPolicy)."""
+    global _policy
+    with _lock:
+        _policy = RetryPolicy(**kwargs)
+        return _policy
+
+
+def enable() -> None:
+    """Arm op-boundary retry (utils/dispatch.py consults this)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def enabled(**kwargs):
+    """Scoped arming for tests / chaos runs; keyword overrides install a
+    temporary policy (e.g. ``with retry.enabled(base_delay_ms=1): ...``)."""
+    global _policy, _enabled
+    prev_policy, prev_enabled = _policy, _enabled
+    if kwargs:
+        configure(**kwargs)
+    _enabled = True
+    try:
+        yield _policy
+    finally:
+        _policy, _enabled = prev_policy, prev_enabled
+
+
+# ---------------------------------------------------------------------------
+# strategies
+# ---------------------------------------------------------------------------
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED-class: the failure scales with input size, so
+    splitting the batch (not just waiting) is the productive retry."""
+    from .memory import MemoryBudgetExceeded
+
+    return isinstance(exc, MemoryBudgetExceeded) or "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def call_with_retry(
+    fn: Callable[..., Any],
+    *args,
+    op_name: str = "op",
+    policy: Optional[RetryPolicy] = None,
+    **kwargs,
+):
+    """Run ``fn(*args, **kwargs)`` under bounded retry + backoff.
+
+    RetryableError retries up to ``policy.max_attempts`` total attempts;
+    the final failure re-raises the LAST error. FatalDeviceError never
+    retries — re-running batches on a dead device strands the executor
+    (the reference's CudaFatalTest contract).
+    """
+    pol = policy if policy is not None else _policy
+    last: Optional[RetryableError] = None
+    for attempt in range(pol.max_attempts):
+        with _stats.lock:
+            _stats.attempts += 1
+        _tls.depth = getattr(_tls, "depth", 0) + 1
+        try:
+            return fn(*args, **kwargs)
+        except FatalDeviceError:
+            with _stats.lock:
+                _stats.fatal += 1
+            raise
+        except RetryableError as e:
+            last = e
+            if attempt == pol.max_attempts - 1:
+                break
+            delay_ms = pol.backoff_ms(attempt)
+            with _stats.lock:
+                _stats.retries += 1
+                _stats.backoff_ms_total += delay_ms
+            if delay_ms > 0:
+                pol.sleep(delay_ms / 1000.0)
+        finally:
+            _tls.depth -= 1
+    with _stats.lock:
+        _stats.exhausted += 1
+    raise last
+
+
+def _default_split(batch):
+    from ..ops.copying import slice_table
+
+    n = batch.num_rows
+    mid = n // 2
+    return slice_table(batch, 0, mid), slice_table(batch, mid, n)
+
+
+def _default_combine(parts: Sequence[Any]):
+    from ..ops.copying import concatenate
+
+    return concatenate(list(parts))
+
+
+def _batch_rows(batch) -> int:
+    n = getattr(batch, "num_rows", None)
+    return int(n) if n is not None else len(batch)
+
+
+def retry_with_split(
+    fn: Callable[[Any], Any],
+    batch,
+    *,
+    split: Optional[Callable[[Any], tuple]] = None,
+    combine: Optional[Callable[[List[Any]], Any]] = None,
+    op_name: str = "op",
+    policy: Optional[RetryPolicy] = None,
+):
+    """Run ``fn(batch)`` under bounded retry; on RESOURCE_EXHAUSTED-class
+    exhaustion halve the batch and recurse (up to ``policy.split_depth``
+    levels), reassembling with ``combine`` — the RmmRapidsRetryIterator
+    splitAndRetry analog.
+
+    Defaults treat ``batch`` as a ``columnar.Table``: ``split`` is a
+    row-range halving (ops.copying.slice_table) and ``combine`` is
+    row-wise ``concatenate``. Pass both for any other batch shape.
+
+    Non-exhaustion RetryableErrors never split (halving does not fix a
+    flaky transport); they surface after bounded retry. FatalDeviceError
+    propagates immediately.
+    """
+    pol = policy if policy is not None else _policy
+    split = split if split is not None else _default_split
+    combine = combine if combine is not None else _default_combine
+
+    def run(b, depth: int):
+        try:
+            return call_with_retry(fn, b, op_name=op_name, policy=pol)
+        except RetryableError as e:
+            if (
+                not is_resource_exhausted(e)
+                or depth >= pol.split_depth
+                or _batch_rows(b) < 2
+            ):
+                raise
+            with _stats.lock:
+                _stats.splits += 1
+            lo, hi = split(b)
+            return combine([run(lo, depth + 1), run(hi, depth + 1)])
+
+    return run(batch, 0)
