@@ -5,6 +5,14 @@ groupby on this host.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Observability (ISSUE 2): with ``SRJT_METRICS_ENABLED=1`` the BENCH row
+is followed by one ``{"metrics": {...}}`` JSON line PER STAGE
+(device_groupby, cpu_ref) — the utils/metrics stage report: op
+timings, shuffle movement, retry counts, and memory splits, each stage
+measured from a reset registry so the numbers are attributable. This
+is how a BENCH row and its runtime counters land in the same artifact
+(the BASELINE.json protocol's measured-evidence requirement).
+
 Measurement protocol: the remote (axon) backend carries a large fixed
 RPC latency per host sync, so the kernel is timed as a CHAINED
 on-device loop (each iteration's keys depend on the previous sums, so
@@ -102,8 +110,26 @@ def bench_cpu_ref() -> float:
 
 
 def main():
-    t_dev, per_iters, t_short, t_long = bench_device()
-    t_cpu = bench_cpu_ref()
+    from spark_rapids_jni_tpu.utils import metrics, retry
+
+    emit_metrics = metrics.is_enabled()
+    stage_snaps = []
+
+    def staged(name, fn):
+        """Run one bench stage with an attributable metrics window:
+        registry + retry stats reset at entry, stage report captured at
+        exit (timed through the op metrics namespace)."""
+        if not emit_metrics:
+            return fn()
+        metrics.reset()
+        retry.reset_stats()
+        with metrics.timer(f"bench.{name}"):
+            out = fn()
+        stage_snaps.append(metrics.stage_report(name))
+        return out
+
+    t_dev, per_iters, t_short, t_long = staged("device_groupby", bench_device)
+    t_cpu = staged("cpu_ref", bench_cpu_ref)
     mrows_s = (N_ROWS / t_dev) / 1e6
     vs_baseline = t_cpu / t_dev  # >1 means faster than the CPU ref
     print(
@@ -134,6 +160,11 @@ def main():
             }
         )
     )
+    # per-stage metrics snapshots ride NEXT TO the BENCH row, one JSON
+    # line each, so the harness that archives the row archives the
+    # runtime counters with it
+    for snap in stage_snaps:
+        print(json.dumps({"metrics": snap}))
 
 
 if __name__ == "__main__":

@@ -26,16 +26,36 @@ EOF
 # a 1-core box (VERDICT r3 item 9)
 python -m pytest tests/ -q -m "not slow"
 
-# robustness tier: the chaos suite re-runs the end-to-end distributed
-# pipeline under the storm profile (retryable + delay faults at 30%)
-# with the retry orchestrator armed THROUGH the env knobs (the parity
-# test honors SRJT_RETRY_* when SRJT_RETRY_ENABLED is set), asserting
-# results bit-identical to fault-free runs — a retry/backoff/
-# supervision regression fails premerge, not production (ISSUE 1)
+# robustness + observability tier: the chaos suite re-runs the
+# end-to-end distributed pipeline under the storm profile (retryable +
+# delay faults at 30%) with the retry orchestrator armed THROUGH the
+# env knobs (the parity test honors SRJT_RETRY_* when
+# SRJT_RETRY_ENABLED is set), asserting results bit-identical to
+# fault-free runs — a retry/backoff/supervision regression fails
+# premerge, not production (ISSUE 1). ISSUE 2 runs the same storm with
+# the METRICS subsystem armed: the metrics suite's chaos-integration
+# tests assert counter values match injected fault counts bit-exactly,
+# and the structured JSON-lines event log is archived as a premerge
+# artifact next to the BENCH rows.
+mkdir -p artifacts
+rm -f artifacts/chaos_metrics.jsonl
 SRJT_FAULTINJ_CONFIG=ci/chaos_storm.json SRJT_RETRY_ENABLED=1 \
   SRJT_RETRY_MAX_ATTEMPTS=10 SRJT_RETRY_BASE_DELAY_MS=1 \
   SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
-  python -m pytest tests/test_chaos.py -q
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/chaos_metrics.jsonl \
+  python -m pytest tests/test_chaos.py tests/test_metrics.py -q
+# the event log must exist and parse as JSON lines (artifact contract)
+python - <<'EOF'
+import json, sys
+lines = [json.loads(s) for s in open("artifacts/chaos_metrics.jsonl")]
+assert lines, "chaos run produced no metrics events"
+assert all("ts" in r and "event" in r for r in lines)
+print(f"archived {len(lines)} metrics events -> artifacts/chaos_metrics.jsonl")
+EOF
+# (the disabled-mode overhead guard —
+# tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
+# tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the
+# production posture it guards; no separate invocation needed)
 
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py

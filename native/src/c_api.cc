@@ -487,6 +487,22 @@ SRJT_EXPORT void srjt_device_shutdown() {
   // destructor (worker shutdown) runs outside the state mutex
 }
 
+SRJT_EXPORT const char* srjt_device_stats_json() {
+  // observability: the connected client's supervision counters plus
+  // the worker's metrics snapshot (STATS protocol verb). NULL when no
+  // sidecar is connected or the report itself failed; never throws —
+  // stats polling must be safe from any thread at any time.
+  auto client = sidecar_ref();
+  if (!client) return nullptr;
+  thread_local std::string stats_buf;
+  try {
+    stats_buf = client->stats_json();
+  } catch (...) {
+    return nullptr;
+  }
+  return stats_buf.c_str();
+}
+
 SRJT_EXPORT int32_t srjt_device_heartbeat() {
   // 1 = worker answered a PING under the short probe deadline
   // (SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC), 0 = no sidecar connected or

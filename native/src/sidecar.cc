@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <stdexcept>
@@ -30,6 +31,7 @@ constexpr uint32_t OP_ZORDER = 6;
 constexpr uint32_t OP_DECIMAL128_MUL = 7;
 constexpr uint32_t OP_DECIMAL128_DIV = 8;
 constexpr uint32_t OP_SET_ARENA = 9;
+constexpr uint32_t OP_STATS = 10;
 constexpr uint32_t OP_SHUTDOWN = 255;
 
 // high bit of op (request) / status (response): payload lives at arena
@@ -188,8 +190,10 @@ SidecarClient::SidecarClient(const std::string& python_exe, int timeout_sec) {
     // conns_ while other threads acquire), connections establish
     // lazily. Slot 0 is eager: it proves the data plane.
     conns_.resize(kPoolSize);
+    ever_connected_.assign(kPoolSize, 0);
     for (size_t i = kPoolSize; i-- > 0;) free_.push_back(i);
     conns_[0] = make_conn();
+    ever_connected_[0] = 1;
 
     auto resp = request(OP_PING, {});
     platform_.assign(resp.begin(), resp.end());
@@ -353,6 +357,11 @@ size_t SidecarClient::acquire_conn() {
   }
   lock.lock();
   conns_[idx] = c;
+  // a REDIAL (the slot carried a live connection before), not the
+  // lazy first dial — this is where the reconnects counter earns its
+  // name, distinct from request_failures
+  if (ever_connected_[idx]) reconnects_.fetch_add(1, std::memory_order_relaxed);
+  ever_connected_[idx] = 1;
   return idx;
 }
 
@@ -450,15 +459,82 @@ std::vector<uint8_t> SidecarClient::request(uint32_t op, const std::vector<uint8
     try {
       auto resp = do_request(conns_[idx], op, payload);
       release_conn(idx, false);
+      requests_.fetch_add(1, std::memory_order_relaxed);
       return resp;
     } catch (const CastError&) {
       release_conn(idx, false);  // semantic failure: transport is healthy
+      requests_.fetch_add(1, std::memory_order_relaxed);
       throw;
     } catch (...) {
       release_conn(idx, true);  // transport failure: drop + lazy reconnect
+      request_failures_.fetch_add(1, std::memory_order_relaxed);
       if (attempt >= 1) throw;
     }
   }
+}
+
+bool SidecarClient::probe_request(uint32_t op, long timeout_sec, size_t max_len,
+                                  std::string* out) {
+  // one zero-payload request/response on a THROWAWAY connection under
+  // its own short deadline: never a pool slot, never the heavy-op
+  // deadline, never the supervision counters — shared by heartbeat()
+  // (OP_PING) and stats_json() (OP_STATS) so the probe scaffolding
+  // cannot diverge between the two. max_len is the sane-size response
+  // guard: a desynced stream must not drive a giant allocation.
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  timeval tv{};
+  tv.tv_sec = timeout_sec;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
+  bool ok = false;
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    try {
+      uint8_t hdr[12] = {};
+      std::memcpy(hdr, &op, 4);  // zero payload length
+      send_all(fd, hdr, sizeof(hdr));
+      uint8_t rhdr[12];
+      recv_all(fd, rhdr, sizeof(rhdr));
+      uint32_t status;
+      uint64_t rlen;
+      std::memcpy(&status, rhdr, 4);
+      std::memcpy(&rlen, rhdr + 4, 8);
+      if ((status & ~ARENA_FLAG) == STATUS_OK && rlen > 0 && rlen < max_len) {
+        std::vector<uint8_t> resp(rlen);
+        recv_all(fd, resp.data(), rlen);
+        if (out) out->assign(resp.begin(), resp.end());
+        ok = true;
+      }
+    } catch (...) {
+      ok = false;
+    }
+  }
+  close(fd);
+  return ok;
+}
+
+std::string SidecarClient::stats_json() {
+  // worker half over the throwaway probe (heartbeat posture): a dead/
+  // wedged worker degrades to "worker": null rather than failing the
+  // report (observability must outlive its subject)
+  std::string worker;
+  if (!probe_request(OP_STATS, env_seconds("SRJT_SIDECAR_STATS_TIMEOUT_SEC", 5),
+                     size_t(4) << 20, &worker)) {
+    worker = "null";
+  }
+  char head[192];
+  std::snprintf(head, sizeof(head),
+                "{\"client\":{\"requests\":%llu,\"request_failures\":%llu,"
+                "\"reconnects\":%llu,\"heartbeats\":%llu},\"worker\":",
+                static_cast<unsigned long long>(requests_.load(std::memory_order_relaxed)),
+                static_cast<unsigned long long>(
+                    request_failures_.load(std::memory_order_relaxed)),
+                static_cast<unsigned long long>(reconnects_.load(std::memory_order_relaxed)),
+                static_cast<unsigned long long>(heartbeats_.load(std::memory_order_relaxed)));
+  return std::string(head) + worker + "}";
 }
 
 bool SidecarClient::heartbeat() {
@@ -468,38 +544,10 @@ bool SidecarClient::heartbeat() {
   // and reconnect-retry would make a wedged worker block the probe
   // for minutes while holding a pool slot. False means unreachable/
   // wedged — callers should tear the client down and run on the host.
-  long probe_sec = env_seconds("SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC", 5);
-  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd < 0) return false;
-  timeval tv{};
-  tv.tv_sec = probe_sec;
-  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-  sockaddr_un addr{};
-  addr.sun_family = AF_UNIX;
-  std::strncpy(addr.sun_path, sock_path_.c_str(), sizeof(addr.sun_path) - 1);
-  bool ok = false;
-  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-    try {
-      uint8_t hdr[12] = {};  // op PING, zero payload
-      send_all(fd, hdr, sizeof(hdr));
-      uint8_t rhdr[12];
-      recv_all(fd, rhdr, sizeof(rhdr));
-      uint32_t status;
-      uint64_t rlen;
-      std::memcpy(&status, rhdr, 4);
-      std::memcpy(&rlen, rhdr + 4, 8);
-      if ((status & ~ARENA_FLAG) == STATUS_OK && rlen > 0 && rlen < 4096) {
-        std::vector<uint8_t> sink(rlen);
-        recv_all(fd, sink.data(), rlen);
-        ok = true;
-      }
-    } catch (...) {
-      ok = false;
-    }
-  }
-  close(fd);
-  return ok;
+  heartbeats_.fetch_add(1, std::memory_order_relaxed);
+  return probe_request(
+      OP_PING, env_seconds("SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC", 5), 4096,
+      nullptr);
 }
 
 void SidecarClient::groupby_sum(const int64_t* keys, const float* vals, int64_t n,

@@ -23,6 +23,7 @@
 //    src/main/cpp/CMakeLists.txt:189-193).
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <memory>
@@ -54,6 +55,15 @@ class SidecarClient {
   // worker unreachable/wedged; callers should shut the client down
   // and run on the host engine.
   bool heartbeat();
+
+  // Observability (ISSUE 2 metrics subsystem): one JSON document
+  // combining this client's counters (requests, request_failures,
+  // reconnects, heartbeats — the connection-supervision events) with
+  // the worker's metrics-registry snapshot fetched via the STATS
+  // protocol verb (op 10; "worker": null when the worker is
+  // unreachable). The Python tier (runtime.device_stats) parses this
+  // and folds it into the utils/metrics registry.
+  std::string stats_json();
 
   // GROUPBY SUM over a bounded key domain, executed on the worker's
   // device (the MXU Pallas kernel when the backend is a TPU).
@@ -102,6 +112,11 @@ class SidecarClient {
   // one request/response exchange (NO global op mutex)
   std::vector<uint8_t> request(uint32_t op, const std::vector<uint8_t>& payload);
 
+  // zero-payload op on a throwaway connection under its own short
+  // deadline; response (bounded by max_len) lands in *out when given.
+  // Shared scaffolding of heartbeat() and stats_json().
+  bool probe_request(uint32_t op, long timeout_sec, size_t max_len,
+                     std::string* out);
   Conn make_conn();           // connect + pass arena fd (throws)
   size_t acquire_conn();      // lease index into conns_ (blocks when pool is saturated)
   void release_conn(size_t idx, bool broken);
@@ -114,6 +129,20 @@ class SidecarClient {
   std::condition_variable pool_cv_;
   std::vector<Conn> conns_;
   std::vector<size_t> free_;
+  // per-slot "carried a live connection before" flag (guarded by
+  // pool_mu_): distinguishes a REDIAL (counted in reconnects_) from
+  // the pool's lazy first dial (not a supervision event)
+  std::vector<char> ever_connected_;
+
+  // supervision counters (stats_json): lock-free, any thread.
+  // requests_ counts completed data-path exchanges, request_failures_
+  // transport faults, reconnects_ actual redials of a previously live
+  // slot, heartbeats_ liveness probes. The STATS poll itself rides a
+  // throwaway connection and touches none of them.
+  std::atomic<uint64_t> requests_{0};
+  std::atomic<uint64_t> request_failures_{0};
+  std::atomic<uint64_t> reconnects_{0};
+  std::atomic<uint64_t> heartbeats_{0};
 
   int child_pid_ = -1;
   std::string sock_path_;

@@ -56,7 +56,8 @@ __all__ = ["window_aggregate"]
 
 _RANKS = ("row_number", "rank", "dense_rank")
 _SHIFTS = ("lag", "lead")
-_FULL_AGGS = ("sum", "mean", "min", "max", "count", "var", "std")
+_FULL_AGGS = ("sum", "mean", "min", "max", "count", "var", "std",
+              "var_pop", "stddev_pop")
 _SUPPORTED = _RANKS + _SHIFTS + _FULL_AGGS + ("cumsum",)
 # order-defined results (ADVICE r5 low #3): silently rank/shift/scan an
 # arbitrary sort order is a wrong answer, not a default
@@ -100,7 +101,7 @@ def window_aggregate(
     order; full-partition aggregates ignore it. ``aggs``:
     [(source_col, how, out_name)] with how in {row_number, rank,
     dense_rank, lag, lead, sum, mean, min, max, count, var, std,
-    cumsum}; lag/lead read offset 1 (Spark's default) with NULL at
+    var_pop, stddev_pop, cumsum}; lag/lead read offset 1 (Spark's default) with NULL at
     partition edges; source_col is ignored for the rank family (pass
     any column name).
 
@@ -170,7 +171,7 @@ def _out_dtype(src_dtype, how: str):
         return dt.INT32
     if how == "count":
         return dt.INT64
-    if how in ("mean", "var", "std"):
+    if how in ("mean", "var", "std", "var_pop", "stddev_pop"):
         return dt.FLOAT64
     return src_dtype
 

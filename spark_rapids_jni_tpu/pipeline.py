@@ -35,6 +35,7 @@ from .columnar import Column, Table
 from .columnar import dtype as dt
 from .ops import bitutils
 from .ops.expressions import Expression
+from .utils import metrics
 from .utils.dispatch import op_boundary
 
 __all__ = ["Agg", "GroupKey", "JoinSpec", "PlanSpec", "CompiledPipeline", "compile_plan"]
@@ -138,6 +139,7 @@ class CompiledPipeline:
     def __init__(self, plan: PlanSpec):
         self.plan = plan
         self._fn = jax.jit(self._trace)
+        metrics.counter("pipeline.compiles").inc()
 
     # -- traced body (ONE program) -----------------------------------------
     def _trace(self, table: Table, builds: Dict[str, Table]):
@@ -229,6 +231,10 @@ class CompiledPipeline:
     @op_boundary("compiled_pipeline")
     def __call__(self, table: Table, builds: Optional[Dict[str, Table]] = None) -> Table:
         plan = self.plan
+        # end-to-end pipeline stats: batch/row throughput counters (the
+        # op_boundary wrapper already records wall time per dispatch)
+        metrics.counter("pipeline.batches").inc()
+        metrics.counter("pipeline.rows").inc(table.num_rows)
         want = {js.build for js in plan.joins}
         have = set(builds or {})
         if want != have:

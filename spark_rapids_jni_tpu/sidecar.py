@@ -55,6 +55,13 @@ VERDICT r3 item 2):
                       out: serialized table (overflow BOOL8, product)
   8 DECIMAL128_DIV    in:  i32 quotient_scale, serialized table (a, b)
                       out: as op 7
+  10 STATS            -> utf-8 JSON: {"backend", "snapshot"} — the
+                         worker's metrics-registry snapshot
+                         (utils/metrics.py): per-op request counts,
+                         error counts, op timings. The observability
+                         verb both clients (SupervisedClient.worker_stats,
+                         native sidecar.cc stats_json) poll to fold
+                         worker-side counters into their own registry.
   255 SHUTDOWN        -> empty ok, then the server exits
 
 Response status codes: 0 ok, 1 generic error (utf-8 message; the C++
@@ -100,7 +107,28 @@ OP_ZORDER = 6
 OP_DECIMAL128_MUL = 7
 OP_DECIMAL128_DIV = 8
 OP_SET_ARENA = 9
+OP_STATS = 10
 OP_SHUTDOWN = 255
+
+# readable per-op metric names (worker-side request counters)
+_OP_NAMES = {
+    OP_PING: "PING",
+    OP_GROUPBY_SUM_F32: "GROUPBY_SUM_F32",
+    OP_CONVERT_TO_ROWS: "CONVERT_TO_ROWS",
+    OP_CONVERT_FROM_ROWS: "CONVERT_FROM_ROWS",
+    OP_CAST_TO_INTEGER: "CAST_TO_INTEGER",
+    OP_CAST_TO_DECIMAL: "CAST_TO_DECIMAL",
+    OP_ZORDER: "ZORDER",
+    OP_DECIMAL128_MUL: "DECIMAL128_MUL",
+    OP_DECIMAL128_DIV: "DECIMAL128_DIV",
+    OP_SET_ARENA: "SET_ARENA",
+    OP_STATS: "STATS",
+    OP_SHUTDOWN: "SHUTDOWN",
+}
+
+
+def op_name(op: int) -> str:
+    return _OP_NAMES.get(op, f"OP_{op}")
 
 ARENA_FLAG = 0x80000000  # high bit of op/status: payload at arena[0:len]
 
@@ -348,9 +376,25 @@ def _op_decimal128(payload: bytes, div: bool) -> bytes:
     return _write_table(res)
 
 
+def _op_stats(backend: str) -> bytes:
+    """STATS verb: the worker's metrics-registry snapshot as JSON. The
+    worker counts per-op requests/errors registry-direct (always on,
+    independent of SRJT_METRICS_ENABLED — the verb must answer even
+    when hot-path instrumentation is disarmed)."""
+    import json
+
+    from .utils import metrics
+
+    return json.dumps(
+        {"backend": backend, "snapshot": metrics.snapshot()}
+    ).encode()
+
+
 def _dispatch(op: int, payload: bytes, backend: str) -> bytes:
     if op == OP_PING:
         return backend.encode()
+    if op == OP_STATS:
+        return _op_stats(backend)
     if op == OP_GROUPBY_SUM_F32:
         return _op_groupby_sum(payload)
     if op == OP_CONVERT_TO_ROWS:
@@ -374,6 +418,9 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     """One client connection: its own optional arena, its own thread."""
     import mmap
 
+    from .utils import metrics
+
+    reg = metrics.registry()  # worker-side counters: always-on
     arena = None  # mmap over the client's memfd
     fds: list = []
     try:
@@ -385,6 +432,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             wire_op, plen = struct.unpack("<IQ", hdr)
             op = wire_op & ~ARENA_FLAG
             in_arena = bool(wire_op & ARENA_FLAG)
+            reg.counter(f"sidecar.worker.requests.{op_name(op)}").inc()
             if in_arena:
                 if arena is None or plen > len(arena):
                     conn.sendall(struct.pack("<IQ", STATUS_ERROR, 0))
@@ -419,7 +467,16 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                     conn.sendall(struct.pack("<IQ", 0, 0))
                     shutdown()
                     return
+                # per-op wall time is hot-path instrumentation: gated
+                # (SRJT_METRICS_ENABLED), unlike the always-on request
+                # COUNTERS above — disarmed, no clock is touched
+                timed = metrics.is_enabled()
+                t0 = time.perf_counter() if timed else 0.0
                 resp = _dispatch(op, payload, backend)
+                if timed:
+                    reg.histogram(f"sidecar.worker.op_us.{op_name(op)}").record(
+                        (time.perf_counter() - t0) * 1e6
+                    )
                 if arena is not None and 0 < len(resp) <= len(arena):
                     arena[: len(resp)] = resp
                     conn.sendall(struct.pack("<IQ", STATUS_OK | ARENA_FLAG, len(resp)))
@@ -428,6 +485,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             except Exception as e:  # report, keep serving
                 from .ops.cast_string import CastError
 
+                reg.counter("sidecar.worker.errors").inc()
                 if isinstance(e, CastError):
                     # semantic ANSI failure: ships row + null-flag +
                     # value so the client re-raises instead of
@@ -514,6 +572,7 @@ class SupervisedClient:
     # -- connection lifecycle ------------------------------------------------
 
     def connect(self) -> None:
+        from .utils import metrics
         from .utils.errors import RetryableError
 
         self.close()
@@ -526,6 +585,8 @@ class SupervisedClient:
             raise RetryableError(f"sidecar: UNAVAILABLE: connect failed ({e})") from e
         if self._ever_connected:
             self.reconnects += 1  # a redial, not the initial dial
+            metrics.counter("sidecar.reconnects").inc()
+            metrics.event("sidecar.reconnect", sock=self.sock_path)
         self._ever_connected = True
         self._sock = s
         self._last_io = time.monotonic()
@@ -590,6 +651,9 @@ class SupervisedClient:
 
     def ping(self) -> str:
         """Heartbeat round-trip; returns the worker's backend name."""
+        from .utils import metrics
+
+        metrics.counter("sidecar.heartbeats").inc()
         if self._sock is None:
             self.connect()
         status, resp = self._raw_request(OP_PING, b"")
@@ -603,12 +667,17 @@ class SupervisedClient:
     def request(self, op: int, payload: bytes) -> bytes:
         """Supervised exchange: reconnect when needed, heartbeat stale
         connections, classify worker-side errors into the
-        fatal/retryable taxonomy."""
+        fatal/retryable taxonomy. With metrics armed, every exchange
+        records a latency histogram (``sidecar.request_us``) and
+        failures count under ``sidecar.request_failures``."""
+        from .utils import metrics
         from .utils.errors import FatalDeviceError, RetryableError
 
         if self._sock is None:
+            # connect() owns the reconnect accounting (attribute +
+            # metric, REDIALS only) — counting here too double-counted
+            # every redial and mislabeled the initial dial
             self.connect()
-            self.reconnects += 1
         elif time.monotonic() - self._last_io > self.heartbeat_s:
             try:
                 self.ping()
@@ -616,8 +685,18 @@ class SupervisedClient:
                 # stale connection died quietly: one immediate redial,
                 # then the request proceeds (or fails retryably)
                 self.connect()
-                self.reconnects += 1
-        status, resp = self._raw_request(op, payload)
+        armed = metrics.is_enabled()
+        t0 = time.perf_counter() if armed else 0.0
+        try:
+            status, resp = self._raw_request(op, payload)
+        except Exception:
+            metrics.counter("sidecar.request_failures").inc()
+            raise
+        if armed:
+            metrics.counter("sidecar.requests").inc()
+            metrics.histogram("sidecar.request_us").record(
+                (time.perf_counter() - t0) * 1e6
+            )
         if status == STATUS_OK:
             return resp
         msg = resp.decode("utf-8", "replace")
@@ -647,12 +726,78 @@ class SupervisedClient:
             return retry.call_with_retry(
                 self.request, op, payload, op_name=f"sidecar_op_{op}"
             )
-        except DeviceError:
+        except DeviceError as e:
             # fatal worker (or retry exhaustion): the op still completes
             # — same kernels, host backend, in-process
+            from .utils import metrics
+
             self.host_fallbacks += 1
+            metrics.counter("sidecar.host_fallbacks").inc()
+            metrics.event(
+                "sidecar.degrade_to_host", op=op_name(op), cls=type(e).__name__
+            )
             self.close()
             return _dispatch(op, payload, "host-fallback")
+
+    # -- observability -------------------------------------------------------
+
+    def worker_stats(self, fold: bool = True, timeout_s: float = None) -> dict:
+        """Poll the worker's STATS verb: returns the worker's metrics
+        snapshot ({"backend", "snapshot"}). With ``fold`` (default) the
+        worker's counters land in THIS process's registry via
+        utils/metrics.fold_worker_counters (gauges under
+        ``sidecar.worker.*``).
+
+        The poll rides a THROWAWAY connection under its own short
+        probe deadline (``SRJT_SIDECAR_STATS_TIMEOUT_SEC``, default
+        5 s — the native stats_json contract): it never touches the
+        supervised socket (no frame interleaving with an in-flight
+        data op), never waits out the heavy-op deadline on a wedged
+        worker, and never counts itself into ``sidecar.requests`` or
+        the ``sidecar.request_us`` latency histogram it exists to
+        report."""
+        import json
+
+        from .utils import metrics
+        from .utils.errors import RetryableError
+
+        if timeout_s is None:
+            timeout_s = _env_seconds("SRJT_SIDECAR_STATS_TIMEOUT_SEC", 5.0)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(float(timeout_s))
+        try:
+            s.connect(self.sock_path)
+            s.sendall(struct.pack("<IQ", OP_STATS, 0))
+            hdr = _recv_exact(s, 12)
+            status, rlen = struct.unpack("<IQ", hdr)
+            if rlen > (4 << 20):
+                # same guard as the native twin: a desynced stream's
+                # garbage length must not drive a giant allocation (a
+                # registry snapshot is KBs, not GBs)
+                raise ConnectionError(f"implausible STATS length {rlen}")
+            resp = _recv_exact(s, rlen) if rlen else b""
+        except (OSError, ConnectionError) as e:
+            raise RetryableError(
+                f"sidecar: UNAVAILABLE: STATS probe failed ({e})"
+            ) from e
+        finally:
+            s.close()
+        if (status & ~ARENA_FLAG) != STATUS_OK:
+            raise RetryableError("sidecar: STATS failed (worker unhealthy)")
+        try:
+            stats = json.loads(resp.decode("utf-8", "replace"))
+        except ValueError as e:
+            # a desynced stream / non-worker peer answering garbage
+            # stays inside the probe's retryable contract — the stats
+            # poll must outlive its subject, never crash the caller
+            raise RetryableError(
+                f"sidecar: malformed STATS payload ({e})"
+            ) from e
+        if fold:
+            metrics.fold_worker_counters(
+                (stats.get("snapshot") or {}).get("counters")
+            )
+        return stats
 
 
 def _cast_error_from_wire(resp: bytes):

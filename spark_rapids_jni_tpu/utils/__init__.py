@@ -1,5 +1,6 @@
 """Aux subsystems (SURVEY §5): op-boundary dispatch instrumentation,
-fault injection, tracing/profiling hooks, error classification, and
-the retry orchestrator (backoff / split / capacity re-try)."""
+fault injection, tracing/profiling hooks, error classification, the
+retry orchestrator (backoff / split / capacity re-try), and the runtime
+metrics registry + structured event log (utils/metrics.py)."""
 
-from . import dispatch, errors, faultinj, retry, tracing  # noqa: F401
+from . import dispatch, errors, faultinj, metrics, retry, tracing  # noqa: F401

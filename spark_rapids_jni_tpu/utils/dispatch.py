@@ -13,8 +13,9 @@ to public ops.
 from __future__ import annotations
 
 import functools
+import time
 
-from . import faultinj, tracing
+from . import faultinj, metrics, tracing
 from .errors import DeviceError, classify
 
 __all__ = ["op_boundary"]
@@ -34,7 +35,13 @@ def op_boundary(name: str):
     - with the retry orchestrator armed, RetryableError re-runs the op
       under the module RetryPolicy; FatalDeviceError NEVER retries.
       Disarmed (the default), RetryableError propagates to the caller
-      unchanged — the seed's Spark-task-retry contract.
+      unchanged — the seed's Spark-task-retry contract,
+    - with the metrics subsystem armed (utils/metrics.py,
+      ``SRJT_METRICS_ENABLED=1``), every dispatch records a call count
+      and wall-clock histogram (``op.<name>.calls`` /
+      ``op.<name>.wall_us``) spanning the full boundary including any
+      retries/backoff; disarmed, the only cost is one boolean read —
+      no clock, no registry touch.
     """
 
     def deco(fn):
@@ -61,10 +68,20 @@ def op_boundary(name: str):
             # only the OUTERMOST boundary owns the retry loop: a nested
             # op's RetryableError propagates to the outer attempt, so a
             # persistent failure costs max_attempts total re-runs, not
-            # max_attempts^nesting-depth
-            if retry.is_enabled() and not retry.in_attempt():
-                return retry.call_with_retry(attempt, op_name=name)
-            return attempt()
+            # max_attempts^nesting-depth. The retry-dispatch decision is
+            # written out twice so the disarmed-metrics path allocates
+            # nothing beyond what the seed paid (one boolean read).
+            if not metrics.is_enabled():
+                if retry.is_enabled() and not retry.in_attempt():
+                    return retry.call_with_retry(attempt, op_name=name)
+                return attempt()
+            t0 = time.perf_counter()
+            try:
+                if retry.is_enabled() and not retry.in_attempt():
+                    return retry.call_with_retry(attempt, op_name=name)
+                return attempt()
+            finally:
+                metrics.record_op(name, time.perf_counter() - t0)
 
         return wrapper
 

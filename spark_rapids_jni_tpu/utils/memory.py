@@ -32,17 +32,29 @@ class MemoryBudgetExceeded(RetryableError):
     so automatically) or the task may re-run elsewhere."""
 
 
-# observability: how many batch splits the memory tier has forced
-_split_retries = 0
+# observability: how many batch splits the memory tier has forced.
+# The count lives in the metrics registry (utils/metrics.py,
+# ``memory.split_retries``) — registry-direct, so it keeps counting
+# whether or not SRJT_METRICS_ENABLED arms the hot-path tier (a split
+# is a rare recovery event, not a hot path).
+_SPLIT_COUNTER = "memory.split_retries"
 
 
 def split_retry_count() -> int:
-    return _split_retries
+    """DEPRECATED: thin alias over the metrics registry counter
+    ``memory.split_retries``; read it via
+    ``utils.metrics.registry().counter("memory.split_retries").value``
+    (or a ``runtime.stats_report()`` snapshot) in new code."""
+    from . import metrics
+
+    return metrics.registry().counter(_SPLIT_COUNTER).value
 
 
 def _note_split() -> None:
-    global _split_retries
-    _split_retries += 1
+    from . import metrics
+
+    metrics.registry().counter(_SPLIT_COUNTER).inc()
+    metrics.event("memory.split_retry")
 
 
 def device_memory_budget() -> int:

@@ -1,0 +1,557 @@
+"""Runtime metrics subsystem: counters, gauges, log2 histograms, and a
+structured JSON-lines event log (SURVEY §5 observability; ISSUE 2).
+
+PR 1 closed the recovery loop but left it blind: the retry orchestrator
+kept private counters, the memory tier a single module global, and the
+sidecar client two instance attributes — nothing shared a namespace,
+nothing could be snapshotted together, and nothing recorded *time*.
+This module is the one registry every layer reports into, modeled on
+the reference plugin's metrics posture (per-op NVTX ranges + the
+RapidsShuffleManager's shuffle byte/latency counters) and on Theseus /
+Thallus (PAPERS.md), which both treat data-movement visibility as a
+first-class subsystem of a distributed columnar engine.
+
+Design contract:
+
+- **Always-on registry, gated instrumentation.** The registry itself
+  (``registry()``) is always live and cheap — durable product counters
+  (memory split-retries, sidecar worker op counts) write through it
+  unconditionally. The *hot-path* instrumentation (per-op wall-clock
+  timing in ``op_boundary``, per-exchange shuffle timings, the event
+  log) is gated by ``SRJT_METRICS_ENABLED`` / ``enable()``: disabled,
+  the module-level ``counter()``/``histogram()``/``timer()`` helpers
+  hand back no-op stubs and never touch a clock, so an instrumented
+  hot path costs one boolean read (the NVTX-disabled contract,
+  utils/tracing.py has the same stance).
+- **Fixed log2 bucketing.** ``Histogram`` keeps 64 power-of-two
+  buckets in a preallocated list — recording is index arithmetic plus
+  one locked increment, never a dict resize or sort on the hot path.
+- **Structured event log.** ``SRJT_METRICS_LOG=<path>`` (or
+  ``set_log_path()``) appends one JSON object per line:
+  ``{"ts": ..., "event": ..., **fields}``. Events are emitted only
+  when metrics are enabled AND a path is set; writes are line-atomic
+  (single ``write()`` of one line under a lock, O_APPEND semantics)
+  so the sidecar worker process and the client can share a file.
+
+Environment:
+
+    SRJT_METRICS_ENABLED  "1"/"true"/"yes" arms instrumentation
+    SRJT_METRICS_LOG      JSON-lines event log path (optional)
+
+The cross-layer snapshot — this registry plus the retry orchestrator's
+stats plus native sidecar stats — is assembled by
+``runtime.stats_report()``; ``render_report()`` here is its pretty
+printer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "timer",
+    "event",
+    "record_op",
+    "snapshot",
+    "fold_worker_counters",
+    "reset",
+    "enable",
+    "disable",
+    "is_enabled",
+    "enabled",
+    "disabled",
+    "set_log_path",
+    "log_path",
+    "close_log",
+    "render_report",
+    "stage_report",
+]
+
+_N_BUCKETS = 64  # log2 buckets cover [1, 2^63); values clamp at the ends
+
+
+class Counter:
+    """Monotonic counter (thread-safe; a GIL-era ``+=`` is not atomic
+    across the read/add/store bytecodes, so increments lock)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def _snapshot(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (remote snapshots, pool sizes, ...)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def _snapshot(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed log2-bucket histogram: bucket k counts values in
+    [2^(k-1), 2^k) (bucket 0 holds values < 1, i.e. zero/negative
+    after int truncation). Preallocated — recording is allocation-free
+    modulo interpreter internals, safe on hot paths."""
+
+    __slots__ = ("_lock", "_buckets", "_count", "_sum", "_min", "_max")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets = [0] * _N_BUCKETS
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    @staticmethod
+    def bucket_index(value) -> int:
+        iv = int(value)
+        if iv <= 0:
+            return 0
+        b = iv.bit_length()  # 1 -> bucket 1 ([1,2)), 2..3 -> 2, 4..7 -> 3
+        return b if b < _N_BUCKETS else _N_BUCKETS - 1
+
+    def record(self, value) -> None:
+        idx = self.bucket_index(value)
+        with self._lock:
+            self._buckets[idx] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._buckets = [0] * _N_BUCKETS
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            buckets = {
+                # bucket k spans [2^(k-1), 2^k); label by the inclusive
+                # lower edge so readers can reconstruct the range
+                ("0" if k == 0 else str(1 << (k - 1))): n
+                for k, n in enumerate(self._buckets)
+                if n
+            }
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "buckets": buckets,
+            }
+
+
+class _NullMetric:
+    """Shared no-op stub handed out when metrics are disabled: every
+    mutator is a pass, so instrumented call sites stay branch-free."""
+
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def record(self, value) -> None:
+        pass
+
+    @property
+    def value(self):
+        return 0
+
+    @property
+    def count(self):
+        return 0
+
+
+_NULL = _NullMetric()
+
+
+class Registry:
+    """Name -> metric map. get-or-create under one lock; the returned
+    metric objects are internally locked, so holders increment without
+    re-entering the registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls()
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {type(m).__name__}, "
+                f"not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def value(self, name: str, default=0):
+        """Scalar read with a default — snapshot assembly for counters
+        that may never have been touched."""
+        m = self._metrics.get(name)
+        if m is None:
+            return default
+        if isinstance(m, Histogram):
+            return m._snapshot()
+        return m.value
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
+        plain JSON-serializable values only."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(items):
+            if isinstance(m, Counter):
+                out["counters"][name] = m._snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m._snapshot()
+            else:
+                out["histograms"][name] = m._snapshot()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m._reset()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process-wide registry. ALWAYS live: durable product counters
+    (memory split-retries, worker-side op counts) go through here
+    directly, independent of the SRJT_METRICS_ENABLED gate — the gate
+    governs hot-path instrumentation, not bookkeeping."""
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# enable gate + gated convenience accessors
+# ---------------------------------------------------------------------------
+
+_enabled = os.environ.get("SRJT_METRICS_ENABLED", "").lower() in ("1", "true", "yes")
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def enabled(log_path: Optional[str] = None):
+    """Scoped arming for tests/benches; optionally installs a scoped
+    event-log path."""
+    global _enabled
+    prev = _enabled
+    prev_path = log_path_holder = None
+    if log_path is not None:
+        prev_path = _log_path
+        set_log_path(log_path)
+        log_path_holder = log_path
+    _enabled = True
+    try:
+        yield _REGISTRY
+    finally:
+        _enabled = prev
+        if log_path_holder is not None:
+            set_log_path(prev_path)
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped disarming (the overhead-guard test's tool)."""
+    global _enabled
+    prev = _enabled
+    _enabled = False
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+def counter(name: str):
+    """Gated accessor: the real counter when armed, a no-op stub when
+    not — instrumented hot paths pay one boolean read disabled."""
+    return _REGISTRY.counter(name) if _enabled else _NULL
+
+
+def gauge(name: str):
+    return _REGISTRY.gauge(name) if _enabled else _NULL
+
+
+def histogram(name: str):
+    return _REGISTRY.histogram(name) if _enabled else _NULL
+
+
+# per-op handle cache: op_boundary resolves (calls counter, wall-us
+# histogram) once per op name instead of two dict lookups per dispatch
+_op_handles: Dict[str, tuple] = {}
+_op_handles_lock = threading.Lock()
+
+
+def record_op(name: str, seconds: float) -> None:
+    """One op dispatch: count + wall-clock histogram (microseconds).
+    Callers gate on is_enabled() BEFORE reading the clock."""
+    h = _op_handles.get(name)
+    if h is None:
+        with _op_handles_lock:
+            h = _op_handles.get(name)
+            if h is None:
+                h = (
+                    _REGISTRY.counter(f"op.{name}.calls"),
+                    _REGISTRY.histogram(f"op.{name}.wall_us"),
+                )
+                _op_handles[name] = h
+    h[0].inc()
+    h[1].record(seconds * 1e6)
+
+
+@contextlib.contextmanager
+def timer(name: str):
+    """Time a region into the op metrics namespace (``op.<name>.calls``
+    + ``op.<name>.wall_us``). No clock read when disabled."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_op(name, time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# structured JSON-lines event log
+# ---------------------------------------------------------------------------
+
+_log_lock = threading.Lock()
+_log_path: Optional[str] = os.environ.get("SRJT_METRICS_LOG") or None
+_log_file = None
+
+
+def log_path() -> Optional[str]:
+    return _log_path
+
+
+def set_log_path(path: Optional[str]) -> None:
+    """Install (or clear, with None) the event-log destination. The
+    file opens lazily on first event and appends — multiple processes
+    (sidecar worker + client) may share one path."""
+    global _log_path, _log_file
+    with _log_lock:
+        if _log_file is not None:
+            try:
+                _log_file.close()
+            finally:
+                _log_file = None
+        _log_path = path
+
+
+def close_log() -> None:
+    set_log_path(_log_path)  # closes the handle, keeps the path
+
+
+def event(name: str, **fields) -> None:
+    """Append one structured event line. Cheap no-op unless metrics are
+    enabled AND a log path is configured. One write() per line keeps
+    lines atomic under O_APPEND across processes."""
+    global _log_file
+    if not _enabled or _log_path is None:
+        return
+    rec = {"ts": round(time.time(), 6), "event": name}
+    rec.update(fields)
+    line = json.dumps(rec, default=str) + "\n"
+    with _log_lock:
+        # re-check under the lock: a concurrent set_log_path(None)
+        # between the fast-path guard above and here must not turn
+        # into open(None) — a bad/ripped-out path degrades the log,
+        # never the op being instrumented
+        if _log_path is None:
+            return
+        if _log_file is None:
+            try:
+                _log_file = open(_log_path, "a")
+            except OSError:
+                return
+        try:
+            _log_file.write(line)
+            _log_file.flush()
+        except (OSError, ValueError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# snapshots + reporting
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def fold_worker_counters(counters: Optional[dict]) -> None:
+    """Fold a sidecar WORKER's counter snapshot (the STATS verb's
+    ``snapshot.counters`` map) into this process's registry under
+    ``sidecar.worker.*`` — as GAUGES, because a remote snapshot is
+    last-write-wins and folding increments would double-count on every
+    poll. Shared by SupervisedClient.worker_stats (Python client) and
+    runtime.device_stats (native client) so the fold policy cannot
+    diverge between the two paths."""
+    for name, value in (counters or {}).items():
+        _REGISTRY.gauge(
+            name if name.startswith("sidecar.worker.")
+            else f"sidecar.worker.{name}"
+        ).set(value)
+
+
+def reset() -> None:
+    """Zero every metric (registered names survive; tests and bench
+    stage boundaries use this)."""
+    _REGISTRY.reset()
+
+
+def stage_report(stage: str) -> dict:
+    """Per-stage snapshot shape for bench emission: op timings, shuffle
+    movement, and retry counts — the three sections VERDICT items 5/7/8
+    audit — with zero defaults so the schema is stable even when a
+    stage never touched a section."""
+    from . import memory, retry
+
+    snap = _REGISTRY.snapshot()
+    ops = {}
+    for name, h in snap["histograms"].items():
+        if name.startswith("op.") and name.endswith(".wall_us") and h["count"]:
+            op = name[len("op."):-len(".wall_us")]
+            ops[op] = {
+                "calls": h["count"],
+                "wall_us_sum": round(h["sum"], 1),
+                "wall_us_max": round(h["max"], 1) if h["max"] is not None else None,
+            }
+    return {
+        "stage": stage,
+        "ops": ops,
+        "shuffle": {
+            "exchanges": _REGISTRY.value("shuffle.exchanges"),
+            "bytes_exchanged": _REGISTRY.value("shuffle.bytes_exchanged"),
+            "capacity_retries": _REGISTRY.value("shuffle.capacity_retries"),
+        },
+        "retry": retry.stats(),
+        "memory": {"split_retries": memory.split_retry_count()},
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human renderer for runtime.stats_report(): one aligned line per
+    scalar, histograms as count/sum/max."""
+    lines = []
+
+    def emit(prefix: str, obj):
+        if isinstance(obj, dict):
+            if set(obj) >= {"count", "sum", "buckets"}:  # histogram leaf
+                mx = obj.get("max")
+                lines.append(
+                    f"{prefix:<52} n={obj['count']} sum={obj['sum']:.1f}"
+                    + (f" max={mx:.1f}" if isinstance(mx, (int, float)) else "")
+                )
+                return
+            for k in sorted(obj):
+                emit(f"{prefix}.{k}" if prefix else str(k), obj[k])
+        else:
+            lines.append(f"{prefix:<52} {obj}")
+
+    emit("", report)
+    return "\n".join(lines)

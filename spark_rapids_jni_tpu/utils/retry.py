@@ -213,6 +213,9 @@ def record_capacity_retry(n: int = 1) -> None:
     """Called by the shuffle capacity re-try loop (parallel/shuffle.py)."""
     with _stats.lock:
         _stats.capacity_retries += n
+    from . import metrics
+
+    metrics.counter("shuffle.capacity_retries").inc(n)
 
 
 # ---------------------------------------------------------------------------
@@ -312,17 +315,22 @@ def call_with_retry(
     retries — re-running batches on a dead device strands the executor
     (the reference's CudaFatalTest contract).
     """
+    from . import metrics
+
     pol = policy if policy is not None else _policy
     last: Optional[RetryableError] = None
     for attempt in range(pol.max_attempts):
         with _stats.lock:
             _stats.attempts += 1
+        metrics.counter("retry.attempts").inc()
         _tls.depth = getattr(_tls, "depth", 0) + 1
         try:
             return fn(*args, **kwargs)
-        except FatalDeviceError:
+        except FatalDeviceError as e:
             with _stats.lock:
                 _stats.fatal += 1
+            metrics.counter("retry.fatal").inc()
+            metrics.event("retry.fatal", op=op_name, cls=type(e).__name__)
             raise
         except RetryableError as e:
             last = e
@@ -332,12 +340,25 @@ def call_with_retry(
             with _stats.lock:
                 _stats.retries += 1
                 _stats.backoff_ms_total += delay_ms
+            # per-error-class counters (the chaos assertions read these:
+            # one injected fault == one retry of its class)
+            cls = type(e).__name__
+            metrics.counter("retry.retries").inc()
+            metrics.counter(f"retry.retries.{cls}").inc()
+            metrics.histogram("retry.backoff_ms").record(delay_ms)
+            metrics.event(
+                "retry.backoff", op=op_name, attempt=attempt, cls=cls,
+                delay_ms=round(delay_ms, 3),
+            )
             if delay_ms > 0:
                 pol.sleep(delay_ms / 1000.0)
         finally:
             _tls.depth -= 1
     with _stats.lock:
         _stats.exhausted += 1
+    metrics.counter("retry.exhausted").inc()
+    metrics.counter(f"retry.exhausted.{type(last).__name__}").inc()
+    metrics.event("retry.exhausted", op=op_name, cls=type(last).__name__)
     raise last
 
 
@@ -398,6 +419,15 @@ def retry_with_split(
                 raise
             with _stats.lock:
                 _stats.splits += 1
+            from . import metrics
+
+            cls = type(e).__name__
+            metrics.counter("retry.splits").inc()
+            metrics.counter(f"retry.splits.{cls}").inc()
+            metrics.event(
+                "retry.split", op=op_name, depth=depth, cls=cls,
+                rows=_batch_rows(b),
+            )
             lo, hi = split(b)
             return combine([run(lo, depth + 1), run(hi, depth + 1)])
 

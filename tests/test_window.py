@@ -185,6 +185,32 @@ def test_partition_var_std(rng):
     np.testing.assert_allclose(sd, want_s, rtol=1e-9)
 
 
+def test_partition_var_pop_stddev_pop(rng):
+    # population variants (VERDICT item 6 first slice): same stable M2
+    # through the shared groupby kernel, divisor n, 0.0 at one valid row
+    t, df = _make(rng, n=400)
+    out = window_aggregate(
+        t, ["p"], [], [("v", "var_pop", "vp"), ("v", "stddev_pop", "sp")]
+    )
+    want_v = df.groupby("p")["v"].transform(lambda s: s.var(ddof=0)).values
+    want_s = df.groupby("p")["v"].transform(lambda s: s.std(ddof=0)).values
+    vp = np.asarray(out.column("vp").data).view(np.float64)
+    sp = np.asarray(out.column("sp").data).view(np.float64)
+    np.testing.assert_allclose(vp, want_v, rtol=1e-9)
+    np.testing.assert_allclose(sp, want_s, rtol=1e-9)
+    # the population gate is the same numeric gate as var/std
+    with pytest.raises(ValueError, match="numeric"):
+        n = 8
+        tb = Table(
+            [
+                Column(dt.INT32, data=jnp.zeros((n,), jnp.int32)),
+                Column(dt.BOOL8, data=jnp.ones((n,), jnp.uint8)),
+            ],
+            ["p", "b"],
+        )
+        window_aggregate(tb, ["p"], [], [("b", "var_pop", "x")])
+
+
 class TestSatelliteGuards:
     def test_order_defined_functions_require_order_by(self, rng):
         # ADVICE r5 low #3: rank/shift/scan over an arbitrary sort
