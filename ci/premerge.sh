@@ -52,6 +52,21 @@ assert lines, "chaos run produced no metrics events"
 assert all("ts" in r and "event" in r for r in lines)
 print(f"archived {len(lines)} metrics events -> artifacts/chaos_metrics.jsonl")
 EOF
+# deadline + circuit-breaker tier (ISSUE 3): the hang-storm profile
+# wedges hash_partition for 30 s at a time — far past the tight
+# SRJT_DEADLINE_SEC below — so every query must either complete or
+# raise DeadlineExceeded within budget. The hard `timeout` wrapper IS
+# the assertion that the subsystem works: a single uninterrupted hang
+# (or a wedged/leaked worker) blows the harness ceiling and fails the
+# gate. Runs the full deadline suite: budget propagation, backoff
+# truncation, breaker open->half-open->closed, spawn reaping, and the
+# storm acceptance test (which honors these env knobs).
+timeout -k 10 600 env SRJT_FAULTINJ_CONFIG=ci/chaos_hang.json \
+  SRJT_DEADLINE_SEC=3 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 \
+  python -m pytest tests/test_deadline.py -q
+
 # (the disabled-mode overhead guard —
 # tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
 # tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the

@@ -204,6 +204,12 @@ def all_to_all_exchange(
                 )
             return received, recv_mask, overflow
         if on_overflow == "retry" and capacity < per_shard:
+            # the capacity re-try loop consults the deadline/cancel
+            # token BETWEEN attempts (utils/deadline.py): an escalated
+            # re-execution never starts once the query budget is gone
+            from ..utils import deadline as deadline_mod
+
+            deadline_mod.check("all_to_all_exchange.capacity_retry")
             # geometric escalation: at most ceil(log2(per_shard/cap0))
             # re-executions before the cannot-overflow ceiling
             new_capacity = min(2 * int(capacity), per_shard)

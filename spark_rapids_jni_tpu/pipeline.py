@@ -35,7 +35,7 @@ from .columnar import Column, Table
 from .columnar import dtype as dt
 from .ops import bitutils
 from .ops.expressions import Expression
-from .utils import metrics
+from .utils import deadline, metrics
 from .utils.dispatch import op_boundary
 
 __all__ = ["Agg", "GroupKey", "JoinSpec", "PlanSpec", "CompiledPipeline", "compile_plan"]
@@ -230,6 +230,13 @@ class CompiledPipeline:
     # -- host wrapper -------------------------------------------------------
     @op_boundary("compiled_pipeline")
     def __call__(self, table: Table, builds: Optional[Dict[str, Table]] = None) -> Table:
+        """One batch through the compiled program. The op_boundary
+        wrapper makes this a deadline-scoped dispatch: pass
+        ``deadline_s=`` for a per-call budget (or set SRJT_DEADLINE_SEC
+        for the ambient per-query budget), and the whole call —
+        including armed retries and their backoffs — is bounded, with
+        a cooperative cancel point between the device dispatch and the
+        host-side result materialization."""
         plan = self.plan
         # end-to-end pipeline stats: batch/row throughput counters (the
         # op_boundary wrapper already records wall time per dispatch)
@@ -240,6 +247,9 @@ class CompiledPipeline:
         if want != have:
             raise ValueError(f"plan needs build tables {sorted(want)}, got {sorted(have)}")
         aggs, counts_all, num, n_oob, n_dup, n_bad_build = self._fn(table, builds or {})
+        # cancel point: a query whose budget died during the compiled
+        # dispatch stops HERE, before paying the host syncs/compaction
+        deadline.check("compiled_pipeline")
         if plan.joins:
             # one host sync covers both join mis-declaration classes
             dups, bad_build = int(n_dup), int(n_bad_build)

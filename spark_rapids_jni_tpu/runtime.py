@@ -757,9 +757,19 @@ def stats_report(pretty: bool = False):
     native sidecar's STATS report when one is connected (folded into
     the registry first so the ``metrics`` section is complete).
 
+    The ``retry`` section carries the deadline outcomes
+    (``deadline_exceeded`` — gave up on budget — vs ``exhausted`` —
+    gave up on attempts — plus ``backoff_truncated``); ``breaker`` is
+    the sidecar circuit breaker's state machine (state,
+    open/half-open/closed transition counts, fast-fails, last trip
+    cause); ``deadline`` reports the ambient SRJT_DEADLINE_SEC budget
+    and whether a scope is active at snapshot time.
+
     Returns a JSON-serializable dict; ``pretty=True`` returns the
     aligned text rendering (utils/metrics.render_report) instead —
     the one-command artifact VERDICT items 5/7/8 ask for."""
+    from . import sidecar
+    from .utils import deadline as deadline_mod
     from .utils import memory, metrics, retry
 
     native = device_stats(fold=True)
@@ -767,6 +777,11 @@ def stats_report(pretty: bool = False):
         "metrics": metrics.snapshot(),
         "retry": retry.stats(),
         "memory": {"split_retries": memory.split_retry_count()},
+        "breaker": sidecar.breaker().snapshot(),
+        "deadline": {
+            "default_budget_s": deadline_mod.default_budget(),
+            "active_scope": deadline_mod.current() is not None,
+        },
         "native_sidecar": native,
     }
     if pretty:
@@ -774,16 +789,25 @@ def stats_report(pretty: bool = False):
     return report
 
 
-def device_groupby_sum(keys, vals, num_keys: int):
+def device_groupby_sum(keys, vals, num_keys: int, deadline_s: Optional[float] = None):
     """GROUP BY SUM executed on the sidecar's device (the MXU Pallas
     kernel when the backend is a TPU). keys int64[n], vals float32[n].
 
     With the retry orchestrator armed (SRJT_RETRY_ENABLED=1 /
     utils.retry.enable()), RETRYABLE-classified native failures —
     including the native fault injector's ``RETRYABLE:``-prefixed
-    storms — re-run under bounded backoff before surfacing."""
+    storms — re-run under bounded backoff before surfacing.
+
+    ``deadline_s`` opens a per-call deadline scope (utils/deadline.py;
+    an ambient SRJT_DEADLINE_SEC applies when unset and no scope is
+    active): the orchestrator's backoffs truncate to the budget and
+    attempts stop with DeadlineExceeded when it is gone. The native
+    call itself blocks under the C++ client's own socket deadline
+    (SRJT_SIDECAR_TIMEOUT_SEC) — the budget bounds when attempts may
+    START; the socket deadline bounds how long one can run."""
     import numpy as np
 
+    from .utils import deadline as deadline_mod
     from .utils import retry
 
     lib = native_lib()
@@ -809,10 +833,13 @@ def device_groupby_sum(keys, vals, num_keys: int):
 
     # same nesting guard as utils/dispatch.py: when an enclosing armed
     # boundary already owns a retry loop, this op must not multiply it
-    if retry.is_enabled() and not retry.in_attempt():
-        retry.call_with_retry(attempt, op_name="device_groupby_sum")
-    else:
-        attempt()
+    with deadline_mod.op_scope(deadline_s) as d:
+        if d is not None:
+            d.check("device_groupby_sum")
+        if retry.is_enabled() and not retry.in_attempt():
+            retry.call_with_retry(attempt, op_name="device_groupby_sum")
+        else:
+            attempt()
     return sums, counts
 
 

@@ -86,6 +86,20 @@ via ``_dispatch`` — the host-CPU engine — so results keep flowing.
 prefixed ``RetryableError:`` / ``FatalDeviceError:`` (the worker's
 op_boundary taxonomy stringified over the wire) is re-raised as that
 class on the client, which is what makes remote faults retryable.
+
+Deadlines + circuit breaker (ISSUE 3): under an active deadline scope
+(utils/deadline.py) every request's socket deadline is
+``min(SRJT_SIDECAR_TIMEOUT_SEC, remaining budget)`` and reconnect
+loops abort the moment the budget is gone — an expired budget raises
+``DeadlineExceeded`` (non-retryable), never a raw socket timeout. The
+process-global circuit breaker (``breaker()``; states/knobs in
+utils/deadline.py, ``SRJT_BREAKER_THRESHOLD`` /
+``SRJT_BREAKER_COOLDOWN_SEC``) opens after consecutive supervision
+failures: while open, ``call()`` degrades to the host engine
+immediately — no dial, no timeout wait — and after the cooldown one
+half-open probe rides the device path; success restores device mode.
+Transitions are registry-direct metrics, visible in
+``runtime.stats_report()``.
 """
 
 from __future__ import annotations
@@ -95,6 +109,7 @@ import os
 import socket
 import struct
 import sys
+import threading
 import time
 
 OP_PING = 0
@@ -572,12 +587,19 @@ class SupervisedClient:
     # -- connection lifecycle ------------------------------------------------
 
     def connect(self) -> None:
-        from .utils import metrics
+        from .utils import deadline as deadline_mod, metrics
         from .utils.errors import RetryableError
 
+        # reconnect loops abort the moment the query budget is gone:
+        # DeadlineExceeded here, never a dial that cannot finish
+        d = deadline_mod.current()
+        timeout = self.deadline_s
+        if d is not None:
+            d.check("sidecar.connect")
+            timeout = min(timeout, max(d.remaining(), 1e-3))
         self.close()
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.settimeout(self.deadline_s)
+        s.settimeout(timeout)
         try:
             s.connect(self.sock_path)
         except (OSError, socket.timeout) as e:
@@ -626,22 +648,35 @@ class SupervisedClient:
 
     def _raw_request(self, op: int, payload: bytes):
         """One request/response exchange on the live socket, bounded by
-        one per-request deadline end to end. Any transport fault closes
-        the connection (desync discipline) and raises RetryableError."""
+        one per-request deadline end to end — under an active deadline
+        scope that is ``min(deadline_s, remaining budget)``, so a hung
+        worker can never cost more than the query has left. Any
+        transport fault closes the connection (desync discipline) and
+        raises RetryableError; an exhausted BUDGET raises
+        DeadlineExceeded instead (the caller must see the query
+        deadline, never a raw socket timeout)."""
+        from .utils import deadline as deadline_mod
         from .utils.errors import RetryableError
 
-        deadline = time.monotonic() + self.deadline_s
+        d = deadline_mod.current()
+        budget_s = self.deadline_s
+        if d is not None:
+            d.check(f"sidecar_op_{op}")
+            budget_s = min(budget_s, max(d.remaining(), 1e-3))
+        deadline = time.monotonic() + budget_s
         try:
-            self._sock.settimeout(self.deadline_s)
+            self._sock.settimeout(budget_s)
             self._sock.sendall(struct.pack("<IQ", op, len(payload)) + payload)
             hdr = self._recv_deadline(12, deadline)
             status, rlen = struct.unpack("<IQ", hdr)
             resp = self._recv_deadline(rlen, deadline) if rlen else b""
         except socket.timeout as e:
             self.close()
+            if d is not None and d.done():
+                raise d.exceeded(f"sidecar op {op}") from e
             raise RetryableError(
                 f"sidecar: DEADLINE_EXCEEDED: op {op} exceeded "
-                f"{self.deadline_s}s request deadline"
+                f"{budget_s:g}s request deadline"
             ) from e
         except (ConnectionError, OSError) as e:
             self.close()
@@ -671,7 +706,11 @@ class SupervisedClient:
         records a latency histogram (``sidecar.request_us``) and
         failures count under ``sidecar.request_failures``."""
         from .utils import metrics
-        from .utils.errors import FatalDeviceError, RetryableError
+        from .utils.errors import (
+            DeadlineExceeded,
+            FatalDeviceError,
+            RetryableError,
+        )
 
         if self._sock is None:
             # connect() owns the reconnect accounting (attribute +
@@ -710,6 +749,13 @@ class SupervisedClient:
             raise RetryableError(f"sidecar worker: {msg}")
         if msg.startswith("FatalDeviceError:"):
             raise FatalDeviceError(f"sidecar worker: {msg}")
+        if msg.startswith("DeadlineExceeded:"):
+            # the WORKER's own budget died (it inherits SRJT_DEADLINE_SEC
+            # through spawn_worker's env): same non-retryable class on
+            # this side, so the breaker records a failure, never a
+            # success, and the caller sees the deadline — not a raw
+            # RuntimeError
+            raise DeadlineExceeded(f"sidecar worker: {msg}")
         raise RuntimeError(f"sidecar worker: {msg}")
 
     # -- degrade-to-host orchestration ---------------------------------------
@@ -717,20 +763,54 @@ class SupervisedClient:
     def call(self, op: int, payload: bytes) -> bytes:
         """Run ``op`` on the worker under the retry orchestrator;
         degrade to the in-process host-CPU engine when the worker is
-        gone. The degrade is BOUNDED: worst case is
-        max_attempts x (deadline + backoff), then the host result."""
-        from .utils import retry
-        from .utils.errors import DeviceError
+        gone. The degrade is BOUNDED three ways (ISSUE 3): the worst
+        retry case is max_attempts x (deadline + backoff) — with every
+        socket deadline and backoff truncated to the remaining query
+        budget; an already-exhausted budget raises DeadlineExceeded up
+        front (the host engine cannot run in zero time either); and the
+        process-global circuit BREAKER fast-fails straight to the host
+        engine while open — no dial, no timeout wait — restoring device
+        mode via one half-open probe after the cooldown."""
+        from .utils import deadline as deadline_mod, metrics, retry
+        from .utils.errors import DeadlineExceeded, DeviceError
 
+        deadline_mod.check(f"sidecar_op_{op}")
+        br = breaker()
+        if not br.allow():
+            # open breaker: the device path is known-bad — degrade
+            # immediately, without paying a dial or a timeout wait
+            self.host_fallbacks += 1
+            metrics.counter("sidecar.host_fallbacks").inc()
+            metrics.event("sidecar.breaker_fast_fail", op=op_name(op))
+            return _dispatch(op, payload, "host-fallback")
         try:
-            return retry.call_with_retry(
+            resp = retry.call_with_retry(
                 self.request, op, payload, op_name=f"sidecar_op_{op}"
             )
+        except DeadlineExceeded:
+            # the budget died waiting on the device path: a supervision
+            # failure for breaker accounting, but the caller gets the
+            # deadline error — there is no time left to degrade into.
+            # DELIBERATE conflation: a device path that cannot answer
+            # within the budgets the workload actually uses is, for
+            # breaker purposes, unavailable — opening means later calls
+            # get the host engine's answer inside their budget instead
+            # of burning it waiting, and the half-open probe restores
+            # device mode the moment it keeps up again. A COOPERATIVE
+            # CANCEL is different: a user stopping their query says
+            # nothing about device health, so it releases the probe
+            # slot with no verdict instead of counting a failure.
+            d = deadline_mod.current()
+            if d is not None and d.cancelled() and not d.expired():
+                br.abort_probe()
+            else:
+                br.record_failure(cause="deadline")
+            self.close()
+            raise
         except DeviceError as e:
             # fatal worker (or retry exhaustion): the op still completes
             # — same kernels, host backend, in-process
-            from .utils import metrics
-
+            br.record_failure(cause=type(e).__name__)
             self.host_fallbacks += 1
             metrics.counter("sidecar.host_fallbacks").inc()
             metrics.event(
@@ -738,6 +818,19 @@ class SupervisedClient:
             )
             self.close()
             return _dispatch(op, payload, "host-fallback")
+        except Exception:
+            # semantic errors (ANSI cast failures, worker API errors)
+            # round-tripped the transport: a healthy device path
+            br.record_success()
+            raise
+        except BaseException:
+            # interrupt/exit mid-request: no health verdict either way —
+            # just release a half-open probe slot so the breaker cannot
+            # wedge in half-open with a probe that never settles
+            br.abort_probe()
+            raise
+        br.record_success()
+        return resp
 
     # -- observability -------------------------------------------------------
 
@@ -800,6 +893,32 @@ class SupervisedClient:
         return stats
 
 
+# ---------------------------------------------------------------------------
+# the sidecar path's circuit breaker (process-global: one device path,
+# one health verdict — every SupervisedClient shares it)
+# ---------------------------------------------------------------------------
+
+_BREAKER = None
+_BREAKER_LOCK = threading.Lock()
+
+
+def breaker():
+    """The process-global sidecar CircuitBreaker (utils/deadline.py):
+    after ``SRJT_BREAKER_THRESHOLD`` consecutive supervision failures
+    it opens and ``SupervisedClient.call`` degrades to the host engine
+    without dialing; a half-open probe after
+    ``SRJT_BREAKER_COOLDOWN_SEC`` restores device mode on success.
+    Lazy so env knobs are read at first use, not import."""
+    global _BREAKER
+    if _BREAKER is None:
+        with _BREAKER_LOCK:
+            if _BREAKER is None:
+                from .utils.deadline import CircuitBreaker
+
+                _BREAKER = CircuitBreaker("sidecar.breaker")
+    return _BREAKER
+
+
 def _cast_error_from_wire(resp: bytes):
     from .ops.cast_string import CastError
 
@@ -813,16 +932,38 @@ def _cast_error_from_wire(resp: bytes):
     return CastError(int(row), val)
 
 
+def _reap_worker(proc) -> None:
+    """Terminate and REAP a worker on a failed spawn: a leaked child
+    holds the chip (and a process-table slot) for the executor's
+    lifetime; a dead-but-unwaited one is a zombie. Best-effort — spawn
+    cleanup must never mask the original startup error."""
+    try:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=10)
+        else:
+            proc.wait()  # already exited: reap immediately
+    except Exception:
+        pass
+
+
 def spawn_worker(
     sock_path: str = None,
     python_exe: str = None,
     startup_timeout_s: float = 60.0,
     env: dict = None,
 ):
-    """Spawn ``python -m spark_rapids_jni_tpu.sidecar`` and wait for its
-    socket (the pure-Python twin of SidecarClient's fork/exec path in
-    native/src/sidecar.cc). Returns (Popen, sock_path). Caller owns
-    shutdown (OP_SHUTDOWN or terminate())."""
+    """Spawn ``python -m spark_rapids_jni_tpu.sidecar``, wait for its
+    socket, and verify a PING handshake round-trips (the pure-Python
+    twin of SidecarClient's fork/exec path in native/src/sidecar.cc).
+    Returns (Popen, sock_path). Caller owns shutdown (OP_SHUTDOWN or
+    terminate()). EVERY failure path — connect refused until timeout,
+    worker exit during startup, a failed handshake, even an interrupt
+    mid-wait — terminates and reaps the child before re-raising."""
     import subprocess
     import tempfile
 
@@ -843,28 +984,45 @@ def spawn_worker(
          "--socket", sock_path],
         env=full_env,
     )
-    deadline = time.monotonic() + startup_timeout_s
-    while True:
-        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        try:
-            probe.connect(sock_path)
-            probe.close()
-            return proc, sock_path
-        except OSError:
-            probe.close()
-        if proc.poll() is not None:
-            raise RuntimeError(
-                f"sidecar worker exited during startup (rc={proc.returncode})"
-            )
-        if time.monotonic() > deadline:
-            proc.terminate()
+    try:
+        t_deadline = time.monotonic() + startup_timeout_s
+        while True:
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            # generous per-probe timeout (bounded by the startup budget):
+            # the worker only listens once its backend is up, so a
+            # connected PING answers immediately — a short timeout here
+            # would re-PING on scheduling stalls and skew the worker's
+            # exact per-op request accounting
+            probe.settimeout(min(10.0, max(1.0, t_deadline - time.monotonic())))
             try:
-                proc.wait(timeout=10)  # reap: no zombie in the executor
-            except Exception:
-                proc.kill()
-                proc.wait(timeout=10)
-            raise RuntimeError("sidecar worker startup timed out")
-        time.sleep(0.05)
+                probe.connect(sock_path)
+                # the socket existing is not the worker being healthy:
+                # a PING must round-trip before the caller gets the
+                # process (the C++ twin's connect-then-PING discipline)
+                probe.sendall(struct.pack("<IQ", OP_PING, 0))
+                hdr = _recv_exact(probe, 12)
+                status, rlen = struct.unpack("<IQ", hdr)
+                if rlen:
+                    _recv_exact(probe, rlen)
+                if (status & ~ARENA_FLAG) != STATUS_OK:
+                    raise RuntimeError(
+                        "sidecar worker failed the startup PING handshake"
+                    )
+                return proc, sock_path
+            except (OSError, ConnectionError):
+                pass  # not listening / not answering yet: keep waiting
+            finally:
+                probe.close()
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"sidecar worker exited during startup (rc={proc.returncode})"
+                )
+            if time.monotonic() > t_deadline:
+                raise RuntimeError("sidecar worker startup timed out")
+            time.sleep(0.05)
+    except BaseException:
+        _reap_worker(proc)
+        raise
 
 
 def serve(sock_path: str) -> None:

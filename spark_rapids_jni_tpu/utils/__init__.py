@@ -1,6 +1,7 @@
 """Aux subsystems (SURVEY §5): op-boundary dispatch instrumentation,
 fault injection, tracing/profiling hooks, error classification, the
-retry orchestrator (backoff / split / capacity re-try), and the runtime
-metrics registry + structured event log (utils/metrics.py)."""
+retry orchestrator (backoff / split / capacity re-try), the runtime
+metrics registry + structured event log (utils/metrics.py), and the
+deadline/cancellation/circuit-breaker tier (utils/deadline.py)."""
 
-from . import dispatch, errors, faultinj, metrics, retry, tracing  # noqa: F401
+from . import deadline, dispatch, errors, faultinj, metrics, retry, tracing  # noqa: F401

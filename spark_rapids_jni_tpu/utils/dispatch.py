@@ -4,10 +4,10 @@ Every reference JNI export runs the same preamble — device binding,
 exception translation, NVTX range (RowConversionJni.cpp:42-57 pattern,
 SURVEY §2.2). ``op_boundary`` is that preamble for the TPU build: fault
 injection hook, tracing scope, backend-error classification (fatal vs
-retryable), and — when the retry orchestrator is armed
-(utils/retry.py, ``SRJT_RETRY_ENABLED=1``) — bounded retry with
-exponential backoff for RetryableError, all in one decorator applied
-to public ops.
+retryable), deadline scope/cancel point (utils/deadline.py), and —
+when the retry orchestrator is armed (utils/retry.py,
+``SRJT_RETRY_ENABLED=1``) — bounded retry with exponential backoff for
+RetryableError, all in one decorator applied to public ops.
 """
 
 from __future__ import annotations
@@ -15,10 +15,33 @@ from __future__ import annotations
 import functools
 import time
 
-from . import faultinj, metrics, tracing
+from . import deadline, faultinj, metrics, tracing
 from .errors import DeviceError, classify
 
 __all__ = ["op_boundary"]
+
+
+def _run_boundary(attempt, name: str):
+    """The dispatch core shared by the scoped and unscoped deadline
+    branches of ``op_boundary``: retry arming + metrics timing. Only the
+    OUTERMOST boundary owns the retry loop — a nested op's
+    RetryableError propagates to the outer attempt, so a persistent
+    failure costs max_attempts total re-runs, not
+    max_attempts^nesting-depth. The retry-dispatch decision is written
+    out twice so the disarmed-metrics path touches no clock."""
+    from . import retry
+
+    if not metrics.is_enabled():
+        if retry.is_enabled() and not retry.in_attempt():
+            return retry.call_with_retry(attempt, op_name=name)
+        return attempt()
+    t0 = time.perf_counter()
+    try:
+        if retry.is_enabled() and not retry.in_attempt():
+            return retry.call_with_retry(attempt, op_name=name)
+        return attempt()
+    finally:
+        metrics.record_op(name, time.perf_counter() - t0)
 
 
 def op_boundary(name: str):
@@ -32,6 +55,16 @@ def op_boundary(name: str):
     - backend exceptions are classified into Fatal/Retryable
       (CATCH_STD analog); host-side ValueError/TypeError/KeyError/
       IndexError pass through unchanged,
+    - DEADLINE (utils/deadline.py): every wrapped op accepts a reserved
+      ``deadline_s=`` keyword that opens a per-call budget scope; with
+      none, the OUTERMOST boundary under an ambient ``SRJT_DEADLINE_SEC``
+      opens the per-query scope — so one knob bounds the whole dispatch
+      including retries and backoff sleeps. Nested boundaries do not
+      open new scopes; they are cancel points consuming the enclosing
+      budget (``DeadlineExceeded`` raises before the body runs once the
+      budget is gone or the cancel token tripped). With no deadline
+      anywhere the extra cost is one reserved-kwarg pop plus a
+      context-var read,
     - with the retry orchestrator armed, RetryableError re-runs the op
       under the module RetryPolicy; FatalDeviceError NEVER retries.
       Disarmed (the default), RetryableError propagates to the caller
@@ -47,6 +80,8 @@ def op_boundary(name: str):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            budget_s = kwargs.pop("deadline_s", None)
+
             def attempt():
                 faultinj.maybe_inject(name)
                 with tracing.func_range(name):
@@ -63,25 +98,22 @@ def op_boundary(name: str):
                             raise
                         raise classify(e) from e
 
-            from . import retry
-
-            # only the OUTERMOST boundary owns the retry loop: a nested
-            # op's RetryableError propagates to the outer attempt, so a
-            # persistent failure costs max_attempts total re-runs, not
-            # max_attempts^nesting-depth. The retry-dispatch decision is
-            # written out twice so the disarmed-metrics path allocates
-            # nothing beyond what the seed paid (one boolean read).
-            if not metrics.is_enabled():
-                if retry.is_enabled() and not retry.in_attempt():
-                    return retry.call_with_retry(attempt, op_name=name)
-                return attempt()
-            t0 = time.perf_counter()
-            try:
-                if retry.is_enabled() and not retry.in_attempt():
-                    return retry.call_with_retry(attempt, op_name=name)
-                return attempt()
-            finally:
-                metrics.record_op(name, time.perf_counter() - t0)
+            # deadline scoping mirrors the retry nesting guard inside
+            # _run_boundary: one scope per query, owned by the boundary
+            # that opened it. The common fully-disarmed path pays one
+            # kwargs.pop, a context-var read, and one extra frame
+            # (_run_boundary) on top of what the seed paid — no closure
+            # beyond `attempt`, no clock, no context manager.
+            dl = deadline.current()
+            if budget_s is None and dl is None:
+                budget_s = deadline.default_budget()
+            if budget_s is not None:
+                with deadline.scope(budget_s) as d:
+                    d.check(name)
+                    return _run_boundary(attempt, name)
+            if dl is not None:
+                dl.check(name)  # nested boundary: cancel point only
+            return _run_boundary(attempt, name)
 
         return wrapper
 

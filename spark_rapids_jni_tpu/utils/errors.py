@@ -14,7 +14,13 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["DeviceError", "FatalDeviceError", "RetryableError", "classify"]
+__all__ = [
+    "DeviceError",
+    "FatalDeviceError",
+    "RetryableError",
+    "DeadlineExceeded",
+    "classify",
+]
 
 
 class DeviceError(RuntimeError):
@@ -27,6 +33,16 @@ class FatalDeviceError(DeviceError):
 
 class RetryableError(DeviceError):
     """Transient failure; the same batch may be retried on this device."""
+
+
+class DeadlineExceeded(DeviceError):
+    """The query's deadline budget is exhausted (or its cancel token
+    tripped; utils/deadline.py). Deliberately NOT a RetryableError —
+    retrying cannot manufacture time, so the orchestrator must never
+    re-run under it — and not Fatal: the device is healthy, the query
+    is out of budget. Distinct from the sidecar's per-request
+    DEADLINE_EXCEEDED socket timeout, which IS retryable (the next
+    attempt may have budget left)."""
 
 
 # Patterns in backend error text that indicate a dead device/client.

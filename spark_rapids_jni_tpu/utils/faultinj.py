@@ -14,6 +14,12 @@ sit. Feature parity:
   (plain RuntimeError — the FI_RETURN_VALUE analog), ``delay``
   (injected latency of ``delayMs`` milliseconds, no exception — the
   wedged-kernel analog that exercises timeout/deadline paths),
+  ``hang`` (a sleep of ``delayMs`` milliseconds — default 30000,
+  deliberately far past any sane deadline — that COOPERATIVELY polls
+  the context-local deadline/cancel token (utils/deadline.py) and
+  aborts with DeadlineExceeded the moment the budget dies: the chaos
+  tool for deadline-expiry and circuit-breaker paths; with no active
+  deadline the full hang is slept),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -34,6 +40,8 @@ Config schema (faultinj/README.md:61-141 shape)::
                              "interceptionCount": 2},
         "all_to_all_exchange": {"type": "delay", "percent": 30,
                                  "delayMs": 5, "after": 2, "ramp": 4},
+        "hash_partition": {"type": "hang", "percent": 50,
+                            "delayMs": 30000},
         "*": {"type": "fatal", "percent": 1}
       }
     }
@@ -91,11 +99,13 @@ def _parse(cfg: dict) -> None:
     _state.rules = {}
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
-        if kind not in ("fatal", "retryable", "exception", "delay"):
+        if kind not in ("fatal", "retryable", "exception", "delay", "hang"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
-        delay_ms = float(spec.get("delayMs", 50))
+        # a hang exists to outlive deadlines: its default sleep is 30 s,
+        # not the delay kind's 50 ms latency blip
+        delay_ms = float(spec.get("delayMs", 30000.0 if kind == "hang" else 50))
         after = int(spec.get("after", 0))
         ramp = int(spec.get("ramp", 0))
         if delay_ms < 0 or after < 0 or ramp < 0:
@@ -188,7 +198,37 @@ def maybe_inject(op_name: str) -> None:
         # delay storm cannot serialize every other dispatch behind it
         time.sleep(delay_ms / 1000.0)
         return
+    if kind == "hang":
+        _hang(op_name, delay_ms)  # outside the lock, like delay
+        return
     raise RuntimeError(f"injected exception in {op_name}")
+
+
+def _hang(op_name: str, delay_ms: float) -> None:
+    """``hang`` kind: the wedged-dispatch analog that sleeps far past
+    any deadline — but cooperatively. The sleep polls the context-local
+    deadline/cancel token (utils/deadline.py) in small slices and
+    raises DeadlineExceeded the moment the budget dies or the token
+    trips: exactly the interrupt a real wedged kernel lacks and the
+    deadline subsystem exists to provide. With no active deadline the
+    full hang is slept — a chaos profile pointing ``hang`` at an
+    unbudgeted op surfaces as the wall-clock it costs, which is the
+    correct loud failure for a mis-armed harness."""
+    from . import deadline as deadline_mod
+
+    end = time.monotonic() + delay_ms / 1000.0
+    while True:
+        d = deadline_mod.current()
+        if d is not None and d.done():
+            raise d.exceeded(f"hang fault in {op_name}")
+        now = time.monotonic()
+        if now >= end:
+            return
+        step = end - now
+        if d is not None:
+            # wake just past the deadline edge, not a poll interval late
+            step = min(step, max(d.remaining(), 0.0) + 0.005)
+        time.sleep(min(step, 0.05))
 
 
 # env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH.

@@ -521,6 +521,7 @@ def stage_report(stage: str) -> dict:
                 "wall_us_sum": round(h["sum"], 1),
                 "wall_us_max": round(h["max"], 1) if h["max"] is not None else None,
             }
+    rs = retry.stats()
     return {
         "stage": stage,
         "ops": ops,
@@ -529,8 +530,19 @@ def stage_report(stage: str) -> dict:
             "bytes_exchanged": _REGISTRY.value("shuffle.bytes_exchanged"),
             "capacity_retries": _REGISTRY.value("shuffle.capacity_retries"),
         },
-        "retry": retry.stats(),
+        "retry": rs,
         "memory": {"split_retries": memory.split_retry_count()},
+        # ISSUE 3 robustness counters: budget give-ups vs truncated
+        # backoffs, and the sidecar breaker's registry-direct gauges
+        "deadline": {
+            "deadline_exceeded": rs["deadline_exceeded"],
+            "backoff_truncated": rs["backoff_truncated"],
+        },
+        "breaker": {
+            "state": _REGISTRY.value("sidecar.breaker.state"),
+            "opened": _REGISTRY.value("sidecar.breaker.opened_total"),
+            "fast_fails": _REGISTRY.value("sidecar.breaker.fast_fails_total"),
+        },
     }
 
 

@@ -39,6 +39,16 @@ Configuration: environment (read once at import) or programmatic.
     SRJT_RETRY_SPLIT_DEPTH   max halvings in retry_with_split (default 3)
     SRJT_RETRY_SEED          jitter RNG seed (deterministic chaos runs)
 
+Deadline interplay (utils/deadline.py, ISSUE 3): under an active
+deadline scope no backoff sleep ever extends past the remaining budget
+(a backoff that would cross the deadline raises immediately, returning
+the residual budget to the caller) and the loop raises
+``DeadlineExceeded`` instead of starting an attempt (or a split) once
+the budget is gone or the cancel token tripped —
+``retry.deadline_exceeded`` / ``retry.backoff_truncated_total`` count
+the two outcomes so stats_report tells "gave up on budget" apart from
+"exhausted attempts".
+
 Op-boundary wiring (utils/dispatch.py): when the orchestrator is
 enabled, every ``op_boundary`` op retries injected/classified
 RetryableErrors transparently; disabled (the default) the seed's
@@ -55,7 +65,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
-from .errors import FatalDeviceError, RetryableError
+from .errors import DeadlineExceeded, FatalDeviceError, RetryableError
 
 __all__ = [
     "RetryPolicy",
@@ -169,7 +179,8 @@ class _Stats:
     """Cross-thread counters for observability and chaos assertions."""
 
     __slots__ = ("lock", "attempts", "retries", "splits", "capacity_retries",
-                 "fatal", "exhausted", "backoff_ms_total")
+                 "fatal", "exhausted", "backoff_ms_total",
+                 "deadline_exceeded", "backoff_truncated")
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -183,6 +194,8 @@ class _Stats:
         self.fatal = 0
         self.exhausted = 0
         self.backoff_ms_total = 0.0
+        self.deadline_exceeded = 0
+        self.backoff_truncated = 0
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -194,6 +207,8 @@ class _Stats:
                 "fatal": self.fatal,
                 "exhausted": self.exhausted,
                 "backoff_ms_total": self.backoff_ms_total,
+                "deadline_exceeded": self.deadline_exceeded,
+                "backoff_truncated": self.backoff_truncated,
             }
 
 
@@ -293,6 +308,24 @@ def enabled(**kwargs):
 # ---------------------------------------------------------------------------
 
 
+def _raise_deadline_exceeded(d, op_name: str, cause):
+    """The deadline budget died mid-orchestration: count it — the
+    ``retry.deadline_exceeded`` counter is how stats_report tells "gave
+    up on budget" from "exhausted attempts" — and raise DeadlineExceeded
+    chained to the last transient failure (the root cause the budget ran
+    out retrying)."""
+    from . import metrics
+
+    with _stats.lock:
+        _stats.deadline_exceeded += 1
+    metrics.counter("retry.deadline_exceeded").inc()
+    metrics.event(
+        "retry.deadline_exceeded", op=op_name,
+        cls=None if cause is None else type(cause).__name__,
+    )
+    raise d.exceeded(op_name) from cause
+
+
 def is_resource_exhausted(exc: BaseException) -> bool:
     """RESOURCE_EXHAUSTED-class: the failure scales with input size, so
     splitting the batch (not just waiting) is the productive retry."""
@@ -314,12 +347,25 @@ def call_with_retry(
     the final failure re-raises the LAST error. FatalDeviceError never
     retries — re-running batches on a dead device strands the executor
     (the reference's CudaFatalTest contract).
+
+    Deadline discipline (utils/deadline.py): under an active deadline
+    scope the orchestrator never STARTS an attempt once the budget is
+    gone or the cancel token tripped — it raises DeadlineExceeded
+    (chained to the last transient failure) instead — and a backoff
+    that would cross the deadline raises immediately rather than
+    sleeping out budget no attempt can use, so the worst case is
+    bounded by the budget, not by max_attempts x max_delay, and the
+    residual budget goes back to the caller.
     """
+    from . import deadline as deadline_mod
     from . import metrics
 
     pol = policy if policy is not None else _policy
     last: Optional[RetryableError] = None
     for attempt in range(pol.max_attempts):
+        d = deadline_mod.current()
+        if d is not None and d.done():
+            _raise_deadline_exceeded(d, op_name, last)
         with _stats.lock:
             _stats.attempts += 1
         metrics.counter("retry.attempts").inc()
@@ -332,11 +378,41 @@ def call_with_retry(
             metrics.counter("retry.fatal").inc()
             metrics.event("retry.fatal", op=op_name, cls=type(e).__name__)
             raise
+        except DeadlineExceeded:
+            # the budget died INSIDE the attempt (an interrupted hang, a
+            # sidecar request whose socket deadline was the remaining
+            # budget): same "gave up on budget" outcome as the loop-top
+            # guard, counted the same way
+            with _stats.lock:
+                _stats.deadline_exceeded += 1
+            metrics.counter("retry.deadline_exceeded").inc()
+            metrics.event("retry.deadline_exceeded", op=op_name, attempt=attempt)
+            raise
         except RetryableError as e:
             last = e
             if attempt == pol.max_attempts - 1:
                 break
             delay_ms = pol.backoff_ms(attempt)
+            if d is not None:
+                if d.done():
+                    _raise_deadline_exceeded(d, op_name, last)
+                rem_ms = d.remaining() * 1000.0
+                if delay_ms >= rem_ms:
+                    # the backoff would cross the deadline, so the
+                    # post-sleep outcome is already determined (the
+                    # loop-top guard would refuse the next attempt):
+                    # count the truncation, RETURN the residual budget
+                    # to the caller, and raise now instead of sleeping
+                    # out wall-clock nothing can use
+                    with _stats.lock:
+                        _stats.backoff_truncated += 1
+                    metrics.counter("retry.backoff_truncated_total").inc()
+                    metrics.event(
+                        "retry.backoff_truncated", op=op_name, attempt=attempt,
+                        delay_ms=round(delay_ms, 3),
+                        remaining_ms=round(rem_ms, 3),
+                    )
+                    _raise_deadline_exceeded(d, op_name, last)
             with _stats.lock:
                 _stats.retries += 1
                 _stats.backoff_ms_total += delay_ms
@@ -411,6 +487,15 @@ def retry_with_split(
         try:
             return call_with_retry(fn, b, op_name=op_name, policy=pol)
         except RetryableError as e:
+            # the reassembly loop consults the deadline/cancel token
+            # BETWEEN attempts: never start a split whose halves cannot
+            # finish inside the budget (call_with_retry guards each
+            # attempt, but the split decision itself is a cancel point)
+            from . import deadline as deadline_mod
+
+            d = deadline_mod.current()
+            if d is not None and d.done():
+                _raise_deadline_exceeded(d, op_name, e)
             if (
                 not is_resource_exhausted(e)
                 or depth >= pol.split_depth

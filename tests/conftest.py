@@ -37,6 +37,11 @@ def rng():
 # ~1030 s combined, round-4 run); a renamed test silently drops back
 # into the fast tier, which is the safe failure mode.
 _SLOW_TESTS = {
+    # the hang-storm acceptance burns ~6 budget expiries of wall-clock
+    # by design; ci/premerge.sh runs it env-armed in the dedicated
+    # deadline tier (no slow filter there), nightly runs it too
+    "test_deadline.py::TestChaosHangStorm::"
+    "test_every_query_completes_or_raises_deadline_exceeded_in_budget",
     "test_cast_decimal.py::test_edges",
     "test_cast_decimal.py::test_type_dispatch_by_precision",
     "test_concurrency.py::test_concurrent_executor_threads_isolated",

@@ -391,11 +391,13 @@ def test_sidecar_stats_verb_and_fold(tmp_path):
                 assert client.ping() == "cpu"
                 stats = client.worker_stats()
                 counters = stats["snapshot"]["counters"]
-                assert counters["sidecar.worker.requests.PING"] == 1
+                # 2 PINGs: spawn_worker's startup handshake + the
+                # explicit heartbeat above (ISSUE 3 spawn hardening)
+                assert counters["sidecar.worker.requests.PING"] == 2
                 assert counters["sidecar.worker.requests.STATS"] == 1
                 # folded into THIS process's registry as gauges
                 snap = metrics.snapshot()
-                assert snap["gauges"]["sidecar.worker.requests.PING"] == 1
+                assert snap["gauges"]["sidecar.worker.requests.PING"] == 2
                 # client-side supervision counters recorded too
                 assert snap["counters"]["sidecar.heartbeats"] == 1
                 # the stats poll must NOT count itself into the
