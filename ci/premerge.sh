@@ -67,6 +67,32 @@ timeout -k 10 600 env SRJT_FAULTINJ_CONFIG=ci/chaos_hang.json \
   SRJT_METRICS_ENABLED=1 \
   python -m pytest tests/test_deadline.py -q
 
+# memory-governor tier (ISSUE 4): the full memgov suite under a TIGHT
+# ambient device budget with metrics + the event log armed — admission
+# FIFO/byte-exactness, spill round-trips, deadline-truncated waits, and
+# the squeeze acceptance (spills + retry splits interleave, TPC-H q1
+# bit-identical). The chaos test inside loads ci/chaos_memgov.json
+# (spill_fail storm on the demotion choke point). Afterwards the
+# archived event log must PROVE forced spills happened: nonzero
+# memgov.spill volume is the artifact contract, mirroring the
+# chaos_metrics.jsonl gate above.
+rm -f artifacts/memgov_events.jsonl
+SRJT_DEVICE_MEMORY_BUDGET=400000 SRJT_SPILL_ENABLED=1 \
+  SRJT_RETRY_ENABLED=0 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/memgov_events.jsonl \
+  python -m pytest tests/test_memgov.py -q
+python - <<'EOF'
+import json
+lines = [json.loads(s) for s in open("artifacts/memgov_events.jsonl")]
+assert lines, "memgov tier produced no events"
+spilled = sum(r.get("nbytes", 0) for r in lines if r["event"] == "memgov.spill")
+assert spilled > 0, "low-budget tier forced no spills (memgov.spilled_bytes == 0)"
+kinds = {r["event"] for r in lines}
+assert "memgov.pressure" in kinds, "no pressure-loop events recorded"
+print(f"archived {len(lines)} memgov events ({spilled} bytes spilled) "
+      "-> artifacts/memgov_events.jsonl")
+EOF
+
 # (the disabled-mode overhead guard —
 # tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
 # tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the

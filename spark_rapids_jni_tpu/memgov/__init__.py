@@ -1,0 +1,305 @@
+"""Device memory governor: byte-weighted admission control + spillable
+buffer catalog + the pressure loop that connects them (ISSUE 4).
+
+The reference stack never lets tasks race each other into device OOM:
+the plugin gates concurrent tasks on the GPU with a semaphore and backs
+every cached batch with a spill framework (device->host->disk). Until
+this subsystem, the TPU tier had only the *predictive* estimator in
+utils/memory.py — per-op refusal, nothing limiting the AGGREGATE
+concurrent footprint, and over-budget data simply re-split or dropped.
+Theseus (PAPERS.md) shows a memory-hierarchy-aware catalog that demotes
+cold buffers to host is what scales query processing past HBM; Thallus
+motivates keeping the demoted representation transport-ready. This
+package is that subsystem, in three cooperating parts:
+
+- **admission** (`admission.py`): a byte-weighted semaphore over
+  ``memory.device_memory_budget()``. ``op_boundary``
+  (utils/dispatch.py) acquires it with each op's footprint estimate
+  before dispatch (only the OUTERMOST boundary per thread — the retry
+  nesting discipline). FIFO fairness, an optional
+  ``SRJT_ADMISSION_MAX_CONCURRENT`` cap, and waits that cooperate with
+  utils/deadline.py: a wait never outlives the query budget
+  (denial-on-dead-budget raises ``DeadlineExceeded``), and sustained
+  over-budget demand raises the existing retryable
+  ``MemoryBudgetExceeded`` so the retry orchestrator's split path
+  engages.
+- **catalog** (`catalog.py`): ``SpillableHandle``s wrapping device
+  arrays (pipeline build tables, shuffle exchange buffers, sidecar
+  arena registrations) with pin/unpin semantics, LRU-ordered demotion
+  device->host (numpy) ->disk under pressure, and transparent
+  re-materialization on access — bit-identical round-trips.
+- **pressure** (`pressure.py`): invoked by the admission controller
+  when an acquire would block — spills unpinned catalog entries until
+  the request fits, with the compiled-executable cache
+  (parallel/_smcache) as an opt-in last resort.
+
+Activation mirrors the metrics-stub pattern: ``SRJT_SPILL_ENABLED``
+arms the governor explicitly; unset, it arms exactly when an operator
+declared a budget (``SRJT_DEVICE_MEMORY_BUDGET``). Disabled (the seed
+posture), the only hot-path cost in ``op_boundary`` is one reserved-
+kwarg pop plus one boolean read — no estimate, no locks, no registry
+touch. Observability is registry-direct (utils/metrics durable-counter
+contract): ``memgov.admitted/queued/rejected/spilled_bytes/respilled``
+counters, ``memgov.queue_wait_us`` / ``memgov.spill_us`` histograms,
+and a ``memgov`` section in ``runtime.stats_report()``.
+
+Environment:
+
+    SRJT_SPILL_ENABLED            "1"/"true" arms the governor ("0"
+                                  disarms even with a budget set);
+                                  unset: armed iff
+                                  SRJT_DEVICE_MEMORY_BUDGET is set.
+                                  The arming decision is frozen at
+                                  import (hot path = one boolean
+                                  read); arm a live process with
+                                  enable()
+    SRJT_DEVICE_MEMORY_BUDGET     device byte budget (utils/memory.py;
+                                  read live)
+    SRJT_ADMISSION_MAX_CONCURRENT admitted-op cap (default 0: bytes
+                                  only)
+    SRJT_ADMISSION_MAX_WAIT_SEC   queue wait before the retryable
+                                  MemoryBudgetExceeded (default 30)
+    SRJT_SPILL_DIR                disk-tier directory (default: a
+                                  per-process dir under the system
+                                  tempdir)
+    SRJT_HOST_MEMORY_BUDGET       host-tier byte budget; past it,
+                                  host entries demote to disk
+                                  (default 0: unlimited)
+    SRJT_MEMGOV_HEADROOM          input-bytes -> footprint multiplier
+                                  for the default op estimate
+                                  (default 2.0: XLA temps)
+    SRJT_MEMGOV_DROP_SMCACHE      "1" lets the pressure loop clear the
+                                  compiled-executable cache as a last
+                                  resort (default off: recompiles are
+                                  expensive)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+from .admission import Admission, AdmissionController
+from .catalog import (
+    TIER_DEVICE,
+    TIER_DISK,
+    TIER_HOST,
+    BufferCatalog,
+    SpillableHandle,
+)
+
+__all__ = [
+    "Admission",
+    "AdmissionController",
+    "BufferCatalog",
+    "SpillableHandle",
+    "TIER_DEVICE",
+    "TIER_HOST",
+    "TIER_DISK",
+    "controller",
+    "catalog",
+    "admit",
+    "ensure_fits",
+    "estimate_call_bytes",
+    "enable",
+    "disable",
+    "is_enabled",
+    "enabled",
+    "in_admission",
+    "stats_section",
+    "reset",
+]
+
+
+def _env_enabled() -> bool:
+    raw = os.environ.get("SRJT_SPILL_ENABLED")
+    if raw is not None and raw != "":
+        return raw.lower() in ("1", "true", "yes")
+    # no explicit arming: govern exactly when an operator declared a
+    # budget — a declared budget with no enforcement is the seed bug
+    # this subsystem exists to close
+    return bool(os.environ.get("SRJT_DEVICE_MEMORY_BUDGET"))
+
+
+_enabled = _env_enabled()
+
+
+def enable() -> None:
+    """Arm the governor (op_boundary admission + pressure spilling)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def enabled():
+    """Scoped arming for tests (pair with SRJT_DEVICE_MEMORY_BUDGET via
+    monkeypatch for a deterministic capacity)."""
+    global _enabled
+    prev = _enabled
+    _enabled = True
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# process-wide singletons (one device, one budget, one catalog)
+# ---------------------------------------------------------------------------
+
+# RLock: controller() builds its catalog via catalog() while holding it
+_lock = threading.RLock()
+_catalog: Optional[BufferCatalog] = None
+_controller: Optional[AdmissionController] = None
+
+
+def catalog() -> BufferCatalog:
+    """The process-wide spillable buffer catalog."""
+    global _catalog
+    if _catalog is None:
+        with _lock:
+            if _catalog is None:
+                _catalog = BufferCatalog()
+    return _catalog
+
+
+def controller() -> AdmissionController:
+    """The process-wide admission controller (shares the catalog so the
+    pressure loop spills what the process actually cached)."""
+    global _controller
+    if _controller is None:
+        with _lock:
+            if _controller is None:
+                _controller = AdmissionController(catalog=catalog())
+    return _controller
+
+
+def reset() -> None:
+    """Fresh singletons (tests): closes the catalog — dropping every
+    entry and its spill files — and discards queued admission state.
+    The enable gate is left as-is."""
+    global _catalog, _controller
+    with _lock:
+        cat, _catalog, _controller = _catalog, None, None
+    if cat is not None:
+        cat.close()
+    _tls.depth = 0
+    _tls.current = None
+
+
+# ---------------------------------------------------------------------------
+# op-boundary integration (utils/dispatch.py)
+# ---------------------------------------------------------------------------
+
+# per-thread nesting guard, mirroring utils/retry.py: only the
+# OUTERMOST op_boundary on a thread owns an admission — a nested op's
+# footprint is part of its parent's, and double-admitting would
+# deadlock the byte semaphore against itself
+_tls = threading.local()
+
+
+def in_admission() -> bool:
+    """True while this thread holds an op_boundary admission."""
+    return getattr(_tls, "depth", 0) > 0
+
+
+def _headroom() -> float:
+    from ..utils.retry import env_float
+
+    return env_float(os.environ, "SRJT_MEMGOV_HEADROOM", 2.0, positive=True)
+
+
+def estimate_call_bytes(args=(), kwargs=None) -> int:
+    """Default per-op footprint: the summed nbytes of every array leaf
+    in the call (Tables and Columns are jax pytrees, so their lanes
+    flatten out) times SRJT_MEMGOV_HEADROOM — XLA temps routinely need
+    a small multiple of the declared inputs. Ops with data-dependent
+    buffer growth pass an explicit ``memory_bytes=`` instead."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves((tuple(args), kwargs or {})):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return int(total * _headroom())
+
+
+def admit(name: str, args=(), kwargs=None, nbytes=None) -> Optional[Admission]:
+    """Acquire the byte-weighted admission for one op dispatch, or None
+    when the governor is disarmed / an enclosing boundary already holds
+    one. The caller MUST release the returned Admission (op_boundary
+    does so in a finally)."""
+    if not _enabled or getattr(_tls, "depth", 0) > 0:
+        return None
+    if nbytes is None:
+        nbytes = estimate_call_bytes(args, kwargs)
+    adm = controller().acquire(int(nbytes), name=name)
+    _tls.depth = 1
+    _tls.current = adm
+    adm._on_release = _clear_tls
+    return adm
+
+
+def _clear_tls() -> None:
+    _tls.depth = 0
+    _tls.current = None
+
+
+def ensure_fits(nbytes: int, name: str = "op") -> None:
+    """Non-queueing fit check for IN-OP footprint escalations (the
+    shuffle capacity-doubling loop): run the pressure loop until
+    ``nbytes`` fits the budget, else raise the retryable
+    ``MemoryBudgetExceeded`` so the caller splits instead of driving
+    XLA into an OOM. No-op when the governor is disarmed. The thread's
+    held op admission (if any) does not count against its own
+    escalation — instead it GROWS to the escalated footprint, so
+    concurrent admissions see the doubled buffers as reserved."""
+    if not _enabled:
+        return
+    controller().ensure_fits(
+        int(nbytes), name=name, admission=getattr(_tls, "current", None)
+    )
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def stats_section() -> dict:
+    """The ``memgov`` section of runtime.stats_report(): registry
+    counters (always-on) plus admission/catalog snapshots when the
+    singletons exist — a stats poll never instantiates them."""
+    from ..utils import metrics
+
+    reg = metrics.registry()
+    out = {
+        "enabled": _enabled,
+        "admitted": reg.value("memgov.admitted"),
+        "queued": reg.value("memgov.queued"),
+        "rejected": reg.value("memgov.rejected"),
+        "spilled_bytes": reg.value("memgov.spilled_bytes"),
+        "spills": reg.value("memgov.spills"),
+        "respilled": reg.value("memgov.respilled"),
+        "rematerialized_bytes": reg.value("memgov.rematerialized_bytes"),
+        "spill_failures": reg.value("memgov.spill_failures"),
+        "queue_wait_us": reg.value("memgov.queue_wait_us", default=None),
+        "spill_us": reg.value("memgov.spill_us", default=None),
+    }
+    if _controller is not None:
+        out["admission"] = _controller.snapshot()
+    if _catalog is not None:
+        out["catalog"] = _catalog.snapshot()
+    return out

@@ -1,0 +1,461 @@
+"""Spillable buffer catalog: the memory hierarchy's demotion tier.
+
+The reference plugin backs every cached batch with its spill framework
+(device buffers demote to host, host to disk, everything
+re-materializes on access) — which is what lets it cache aggressively
+without racing into OOM. ``BufferCatalog`` is that framework for the
+TPU tier: a ``SpillableHandle`` wraps any jax pytree of arrays (a bare
+array, a columnar ``Table``, a pipeline build table) with pin/unpin
+semantics, LRU-ordered demotion device->host (numpy) ->disk
+(``SRJT_SPILL_DIR``) under pressure, and transparent re-materialization
+on ``get()``. Demoted leaves are exact byte copies (numpy round-trips
+IEEE bit patterns and integer lanes unchanged), so a
+spill->re-materialize cycle is bit-identical — the invariant
+tests/test_memgov.py round-trips.
+
+Accounting-only entries (``register_host_bytes``: sidecar arena memfds)
+carry a size but no payload; they make host-tier consumers visible to
+the budget, ``runtime.stats_report()``, and the sidecar STATS verb
+without ever spilling.
+
+A spill frees the CATALOG's reference; arrays a caller already holds
+from ``get()`` stay valid (refcounted) — the governor's accounting is
+advisory until the last reference drops, like every cache-eviction
+scheme over shared buffers.
+
+Observability is registry-direct (utils/metrics; the durable-counter
+contract — a spill is a rare recovery event, not a hot path):
+``memgov.spills`` / ``memgov.spilled_bytes`` / ``memgov.respilled`` /
+``memgov.rematerialized`` / ``memgov.rematerialized_bytes`` /
+``memgov.spill_failures`` counters, ``memgov.spill_us`` /
+``memgov.rematerialize_us`` histograms, ``memgov.catalog.*_bytes`` and
+``memgov.arena_bytes``/``memgov.arenas`` gauges. Chaos hook: every
+demotion crosses ``faultinj.maybe_inject("memgov.spill")``, so a
+``spill_fail`` rule keyed on ``memgov.spill`` makes spills fail
+injectably — a failed spill leaves the entry resident and is counted,
+never raised past the pressure loop.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.errors import RetryableError
+
+__all__ = [
+    "TIER_DEVICE",
+    "TIER_HOST",
+    "TIER_DISK",
+    "SpillableHandle",
+    "BufferCatalog",
+]
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+
+
+def _registry():
+    from ..utils import metrics
+
+    return metrics.registry()
+
+
+class SpillableHandle:
+    """One catalog entry: a pytree of array leaves at exactly one tier.
+
+    Mutations happen under the owning catalog's lock (the public
+    methods delegate); holders touch only ``get``/``pin``/``unpin``/
+    ``spill``/``close`` and the read-only properties.
+    """
+
+    __slots__ = (
+        "key",
+        "kind",
+        "nbytes",
+        "spill_count",
+        "_catalog",
+        "_treedef",
+        "_n_leaves",
+        "_device",
+        "_host",
+        "_disk_path",
+        "_pins",
+        "_seq",
+        "_closed",
+    )
+
+    def __init__(self, catalog: "BufferCatalog", key: str, kind: str,
+                 nbytes: int, treedef, device_leaves: Optional[List]):
+        self.key = key
+        self.kind = kind
+        self.nbytes = int(nbytes)
+        self.spill_count = 0
+        self._catalog = catalog
+        self._treedef = treedef
+        self._n_leaves = 0 if device_leaves is None else len(device_leaves)
+        self._device = device_leaves
+        self._host: Optional[List[np.ndarray]] = None
+        self._disk_path: Optional[str] = None
+        self._pins = 0
+        self._seq = 0
+        self._closed = False
+
+    @property
+    def tier(self) -> str:
+        if self._device is not None:
+            return TIER_DEVICE
+        if self._disk_path is not None:
+            return TIER_DISK
+        return TIER_HOST
+
+    @property
+    def pinned(self) -> bool:
+        return self._pins > 0
+
+    @property
+    def spillable(self) -> bool:
+        """Payload-carrying, unpinned, and still device-resident."""
+        return (
+            not self._closed
+            and self._treedef is not None
+            and self._pins == 0
+            and self._device is not None
+        )
+
+    def pin(self) -> "SpillableHandle":
+        """Hold the entry at its current tier (a pinned device entry
+        never spills; re-materialization still works on get)."""
+        with self._catalog._lock:
+            self._pins += 1
+        return self
+
+    def unpin(self) -> None:
+        with self._catalog._lock:
+            if self._pins > 0:
+                self._pins -= 1
+
+    def get(self):
+        """The wrapped value, re-materialized to the device tier if it
+        was demoted — transparent access, LRU-refreshing."""
+        return self._catalog._get(self)
+
+    def spill(self, to_disk: bool = False) -> None:
+        """Force a demotion (tests / explicit cold-set management); a
+        pinned entry raises ValueError."""
+        self._catalog._force_spill(self, to_disk=to_disk)
+
+    def close(self) -> None:
+        self._catalog.unregister(self.key)
+
+
+class BufferCatalog:
+    """key -> SpillableHandle map with LRU demotion under one lock."""
+
+    def __init__(
+        self,
+        spill_dir: Optional[str] = None,
+        host_budget: Optional[int] = None,
+        clock=time.monotonic,
+    ):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, SpillableHandle] = {}
+        self._seq = 0
+        self._clock = clock
+        self._spill_dir = spill_dir  # resolved lazily on first disk spill
+        if host_budget is None:
+            raw = os.environ.get("SRJT_HOST_MEMORY_BUDGET")
+            host_budget = int(raw) if raw else 0
+        self._host_budget = int(host_budget)  # 0 == unlimited
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, key: str, value, pinned: bool = False,
+                 kind: str = "buffer") -> SpillableHandle:
+        """Wrap ``value`` (any jax pytree of arrays: jnp array, Table,
+        tuple of lanes) as a spillable device-tier entry. Re-registering
+        a key replaces (and closes) the previous entry."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        nbytes = sum(int(getattr(x, "nbytes", 0)) for x in leaves)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._close_locked(old)
+            h = SpillableHandle(self, key, kind, nbytes, treedef, list(leaves))
+            h._pins = 1 if pinned else 0
+            self._seq += 1
+            h._seq = self._seq
+            self._entries[key] = h
+            self._update_gauges_locked()
+        return h
+
+    def register_host_bytes(self, key: str, nbytes: int, pinned: bool = True,
+                            kind: str = "arena") -> SpillableHandle:
+        """Accounting-only HOST-tier entry: a size with no payload (the
+        sidecar's mmap'd arena memfds). Pinned by default — the bytes
+        are owned elsewhere; the catalog only makes them visible."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._close_locked(old)
+            h = SpillableHandle(self, key, kind, int(nbytes), None, None)
+            h._pins = 1 if pinned else 0
+            self._seq += 1
+            h._seq = self._seq
+            self._entries[key] = h
+            self._update_gauges_locked()
+        return h
+
+    def unregister(self, key: str) -> bool:
+        with self._lock:
+            h = self._entries.pop(key, None)
+            if h is None:
+                return False
+            self._close_locked(h)
+            self._update_gauges_locked()
+            return True
+
+    def close(self) -> None:
+        """Drop every entry (removing disk-spill files)."""
+        with self._lock:
+            for h in list(self._entries.values()):
+                self._close_locked(h)
+            self._entries.clear()
+            self._update_gauges_locked()
+
+    def _close_locked(self, h: SpillableHandle) -> None:
+        h._closed = True
+        h._device = None
+        h._host = None
+        if h._disk_path is not None:
+            try:
+                os.unlink(h._disk_path)
+            except OSError:
+                pass
+            h._disk_path = None
+
+    # -- accounting ----------------------------------------------------------
+
+    def _tier_bytes_locked(self, tier: str) -> int:
+        return sum(h.nbytes for h in self._entries.values() if h.tier == tier)
+
+    def device_bytes(self) -> int:
+        with self._lock:
+            return self._tier_bytes_locked(TIER_DEVICE)
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._tier_bytes_locked(TIER_HOST)
+
+    def disk_bytes(self) -> int:
+        with self._lock:
+            return self._tier_bytes_locked(TIER_DISK)
+
+    def spillable_device_bytes(self) -> int:
+        """Device bytes the pressure loop may still reclaim."""
+        with self._lock:
+            return sum(h.nbytes for h in self._entries.values() if h.spillable)
+
+    def pinned_device_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                h.nbytes
+                for h in self._entries.values()
+                if h.tier == TIER_DEVICE and not h.spillable
+            )
+
+    def _update_gauges_locked(self) -> None:
+        reg = _registry()
+        reg.gauge("memgov.catalog.entries").set(len(self._entries))
+        for tier in (TIER_DEVICE, TIER_HOST, TIER_DISK):
+            reg.gauge(f"memgov.catalog.{tier}_bytes").set(
+                self._tier_bytes_locked(tier)
+            )
+        arenas = [h for h in self._entries.values() if h.kind == "arena"]
+        reg.gauge("memgov.arenas").set(len(arenas))
+        reg.gauge("memgov.arena_bytes").set(sum(h.nbytes for h in arenas))
+
+    def snapshot(self) -> dict:
+        """JSON-clean shape for runtime.stats_report()."""
+        with self._lock:
+            arenas = [h for h in self._entries.values() if h.kind == "arena"]
+            return {
+                "entries": len(self._entries),
+                "device_bytes": self._tier_bytes_locked(TIER_DEVICE),
+                "host_bytes": self._tier_bytes_locked(TIER_HOST),
+                "disk_bytes": self._tier_bytes_locked(TIER_DISK),
+                "pinned_device_bytes": sum(
+                    h.nbytes
+                    for h in self._entries.values()
+                    if h.tier == TIER_DEVICE and h._pins > 0
+                ),
+                "arenas": len(arenas),
+                "arena_bytes": sum(h.nbytes for h in arenas),
+            }
+
+    # -- demotion ------------------------------------------------------------
+
+    def _resolve_spill_dir(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = os.environ.get("SRJT_SPILL_DIR") or os.path.join(
+                tempfile.gettempdir(), f"srjt-spill-{os.getpid()}"
+            )
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+    def _spill_locked(self, h: SpillableHandle) -> None:
+        """device -> host. Raises RetryableError when the chaos
+        ``spill_fail`` rule fires (caller skips the entry); afterwards
+        enforces the host budget by demoting LRU host entries to disk."""
+        from ..utils import faultinj, metrics
+
+        reg = _registry()
+        t0 = time.perf_counter()
+        faultinj.maybe_inject("memgov.spill")
+        h._host = [np.asarray(x) for x in h._device]
+        h._device = None
+        if h.spill_count:
+            reg.counter("memgov.respilled").inc()
+        h.spill_count += 1
+        reg.counter("memgov.spills").inc()
+        reg.counter("memgov.spilled_bytes").inc(h.nbytes)
+        reg.histogram("memgov.spill_us").record((time.perf_counter() - t0) * 1e6)
+        metrics.event("memgov.spill", key=h.key, nbytes=h.nbytes, tier=TIER_HOST)
+        if self._host_budget > 0:
+            try:
+                self._enforce_host_budget_locked()
+            except OSError:
+                # disk tier unavailable (full disk, bad SRJT_SPILL_DIR):
+                # the host copy above already stands — degrade to an
+                # over-budget host tier, never fail the device spill
+                reg.counter("memgov.spill_failures").inc()
+                metrics.event("memgov.spill_failed", key=h.key, tier=TIER_DISK)
+
+    def _demote_disk_locked(self, h: SpillableHandle) -> None:
+        """host -> disk: one .npz per entry under SRJT_SPILL_DIR."""
+        from ..utils import metrics
+
+        reg = _registry()
+        t0 = time.perf_counter()
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", h.key)
+        path = os.path.join(
+            self._resolve_spill_dir(), f"{safe}-{h._seq}.npz"
+        )
+        np.savez(path, **{f"a{i}": leaf for i, leaf in enumerate(h._host)})
+        h._disk_path = path
+        h._host = None
+        reg.counter("memgov.disk_spills").inc()
+        reg.counter("memgov.disk_spilled_bytes").inc(h.nbytes)
+        reg.histogram("memgov.spill_us").record((time.perf_counter() - t0) * 1e6)
+        metrics.event("memgov.spill", key=h.key, nbytes=h.nbytes, tier=TIER_DISK)
+
+    def _enforce_host_budget_locked(self) -> None:
+        over = self._tier_bytes_locked(TIER_HOST) - self._host_budget
+        if over <= 0:
+            return
+        victims = sorted(
+            (
+                h
+                for h in self._entries.values()
+                if h.tier == TIER_HOST and h._pins == 0 and h._treedef is not None
+            ),
+            key=lambda h: h._seq,
+        )
+        for h in victims:
+            if over <= 0:
+                break
+            self._demote_disk_locked(h)
+            over -= h.nbytes
+
+    def _force_spill(self, h: SpillableHandle, to_disk: bool = False) -> None:
+        with self._lock:
+            if h._closed:
+                raise ValueError(f"catalog entry {h.key!r} is closed")
+            if h._pins > 0:
+                raise ValueError(f"catalog entry {h.key!r} is pinned")
+            if h._device is not None:
+                self._spill_locked(h)
+            if to_disk and h._host is not None:
+                self._demote_disk_locked(h)
+            self._update_gauges_locked()
+
+    def spill_until(self, need_bytes: int, name: str = "pressure") -> int:
+        """Demote LRU-ordered unpinned device entries until at least
+        ``need_bytes`` are reclaimed (or nothing spillable remains).
+        Returns the bytes freed. An injected spill failure skips that
+        entry (counted ``memgov.spill_failures``) and moves on — the
+        pressure loop degrades, never crashes the admission path."""
+        from ..utils import metrics
+
+        reg = _registry()
+        freed = 0
+        with self._lock:
+            victims = sorted(
+                (h for h in self._entries.values() if h.spillable),
+                key=lambda h: h._seq,
+            )
+            for h in victims:
+                if freed >= need_bytes:
+                    break
+                try:
+                    self._spill_locked(h)
+                except (RetryableError, OSError):
+                    # injected spill_fail, or a real I/O failure: either
+                    # way the entry stays resident and the loop degrades
+                    # — admission must never crash on a sick spill tier
+                    reg.counter("memgov.spill_failures").inc()
+                    metrics.event("memgov.spill_failed", key=h.key)
+                    continue
+                freed += h.nbytes
+            self._update_gauges_locked()
+        return freed
+
+    # -- access / re-materialization -----------------------------------------
+
+    def _get(self, h: SpillableHandle):
+        import jax
+        from ..utils import metrics
+
+        reg = _registry()
+        with self._lock:
+            if h._closed:
+                raise ValueError(f"catalog entry {h.key!r} is closed")
+            if h._treedef is None:
+                raise ValueError(
+                    f"catalog entry {h.key!r} is accounting-only (no payload)"
+                )
+            self._seq += 1
+            h._seq = self._seq  # LRU refresh
+            if h._device is None:
+                t0 = time.perf_counter()
+                if h._disk_path is not None:
+                    with np.load(h._disk_path) as z:
+                        h._host = [z[f"a{i}"] for i in range(h._n_leaves)]
+                    try:
+                        os.unlink(h._disk_path)
+                    except OSError:
+                        pass
+                    h._disk_path = None
+                import jax.numpy as jnp
+
+                h._device = [jnp.asarray(x) for x in h._host]
+                h._host = None
+                reg.counter("memgov.rematerialized").inc()
+                reg.counter("memgov.rematerialized_bytes").inc(h.nbytes)
+                reg.histogram("memgov.rematerialize_us").record(
+                    (time.perf_counter() - t0) * 1e6
+                )
+                metrics.event(
+                    "memgov.rematerialize", key=h.key, nbytes=h.nbytes
+                )
+                self._update_gauges_locked()
+            return jax.tree_util.tree_unflatten(h._treedef, h._device)

@@ -1,0 +1,64 @@
+"""The pressure loop: turn blocked admissions into demotions.
+
+When an admission would block (admission.py) the governor does not just
+wait — it RECLAIMS: unpinned catalog entries demote device->host in LRU
+order until the request fits (catalog.spill_until). That is the
+Theseus/reference-spill-framework discipline: cold cached state yields
+to hot in-flight work, and the request only queues for demand the
+catalog cannot absorb.
+
+Last resort (off by default, ``SRJT_MEMGOV_DROP_SMCACHE=1`` arms it):
+when spilling freed nothing and nothing spillable remains, drop the
+memoized jit(shard_map) executables (parallel/_smcache) — compiled
+programs hold device constants the accounting never sees. The entries
+recompile on next use, so this trades latency for headroom; it is the
+valve an operator opens on a genuinely HBM-starved fleet, not a
+default. The cache is only touched when its module is already loaded —
+a process that never compiled a distributed op has nothing to drop.
+
+Metrics are registry-direct: ``memgov.pressure_events`` counts
+invocations, ``memgov.smcache_dropped`` the executables dropped; the
+per-spill counters/histograms live with the catalog.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["relieve"]
+
+
+def _drop_smcache_armed() -> bool:
+    return os.environ.get("SRJT_MEMGOV_DROP_SMCACHE", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def relieve(need_bytes: int, catalog, name: str = "op") -> int:
+    """Free up to ``need_bytes`` of accounted device bytes by demoting
+    catalog entries (LRU, unpinned first — only, ever). Returns the
+    bytes reclaimed; the caller re-checks its admission condition —
+    relieve never raises for coming up short."""
+    from ..utils import metrics
+
+    reg = metrics.registry()
+    reg.counter("memgov.pressure_events").inc()
+    freed = catalog.spill_until(need_bytes, name=name)
+    if (
+        freed < need_bytes
+        and catalog.spillable_device_bytes() == 0
+        and _drop_smcache_armed()
+    ):
+        # sys.modules lookup, not an import: never pay for (or trigger)
+        # the parallel tier just to find an empty cache
+        smc = sys.modules.get("spark_rapids_jni_tpu.parallel._smcache")
+        if smc is not None:
+            n = smc.clear()
+            if n:
+                reg.counter("memgov.smcache_dropped").inc(n)
+                metrics.event("memgov.smcache_dropped", entries=n, op=name)
+    metrics.event(
+        "memgov.pressure", op=name, need=int(need_bytes), freed=freed
+    )
+    return freed

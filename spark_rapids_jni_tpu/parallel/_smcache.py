@@ -49,3 +49,18 @@ def cached_sm(key, build: Callable):
     else:
         _CACHE.move_to_end(key)
     return f
+
+
+def entry_count() -> int:
+    return len(_CACHE)
+
+
+def clear() -> int:
+    """Drop every memoized executable; returns how many were dropped.
+    Compiled programs hold device constants, so this frees real device
+    memory at the cost of recompiling on next use — the memory
+    governor's pressure loop (memgov/pressure.py) calls it as an
+    opt-in last resort (SRJT_MEMGOV_DROP_SMCACHE=1)."""
+    n = len(_CACHE)
+    _CACHE.clear()
+    return n

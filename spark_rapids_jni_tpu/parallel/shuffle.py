@@ -166,17 +166,21 @@ def all_to_all_exchange(
     if capacity is None:
         capacity = per_shard  # safe: one shard can absorb everything
 
+    from .. import memgov
     from ..utils import metrics
 
     armed = metrics.is_enabled()
+    governed = on_overflow == "retry" and memgov.is_enabled()
     # per-GLOBAL-ROW wire cost: the collective moves capacity-padded
     # [n_parts, capacity] buckets per shard per array (NOT the dense
     # row payload) plus the 1-byte/slot occupancy mask — the padded
     # footprint is what a GB/s artifact must divide by, and it changes
-    # each time the escalation loop doubles capacity
+    # each time the escalation loop doubles capacity. ONE cost model:
+    # the metrics wire accounting and the governor's escalation
+    # estimate read the same number
     row_bytes = (
         sum(int(a.nbytes) // max(a.shape[0], 1) for a in arrays) + 1
-        if armed else 0
+        if armed or governed else 0
     )
     t0 = time.perf_counter() if armed else 0.0
     wire_bytes = 0
@@ -213,6 +217,22 @@ def all_to_all_exchange(
             # geometric escalation: at most ceil(log2(per_shard/cap0))
             # re-executions before the cannot-overflow ceiling
             new_capacity = min(2 * int(capacity), per_shard)
+            # memory governor (memgov/, ISSUE 4): the doubled bucket
+            # matrices are a footprint the op's original admission never
+            # covered — route the escalated estimate through the
+            # controller (which GROWS the held admission on success) so
+            # a doubling that cannot fit spills cold catalog buffers or
+            # raises the retryable MemoryBudgetExceeded (the split
+            # path), never an XLA OOM
+            if governed:
+                from ..utils.memory import exchange_bytes_estimate
+
+                memgov.ensure_fits(
+                    exchange_bytes_estimate(
+                        row_bytes, n_parts, int(new_capacity)
+                    ),
+                    "all_to_all_exchange.capacity_retry",
+                )
             metrics.event(
                 "shuffle.capacity_escalation", axis=axis,
                 capacity=int(capacity), new_capacity=int(new_capacity),

@@ -139,7 +139,41 @@ class CompiledPipeline:
     def __init__(self, plan: PlanSpec):
         self.plan = plan
         self._fn = jax.jit(self._trace)
+        self._build_handles: Dict[str, object] = {}
+        self._build_finalizer = None
         metrics.counter("pipeline.compiles").inc()
+
+    # -- spillable build tables (memgov/, ISSUE 4) --------------------------
+    def register_build(self, name: str, table: Table) -> None:
+        """Attach a BUILD table to this pipeline through the memory
+        governor's spillable catalog: ``__call__`` materializes it
+        automatically (no ``builds`` entry needed), and BETWEEN calls
+        the table may demote device->host(->disk) under memory pressure
+        and re-materialize transparently — bit-identical — on the next
+        batch. During a call the handle is pinned so the pressure loop
+        cannot demote it mid-dispatch. Registration is bookkeeping
+        (always-on); demotion only ever happens under an armed
+        governor's pressure loop. A dropped pipeline cleans up after
+        itself (weakref finalizer), so catalog entries and their spill
+        files never outlive the pipeline that registered them."""
+        import weakref
+
+        from . import memgov
+
+        cat = memgov.catalog()
+        key = f"pipeline.build.{id(self)}.{name}"
+        self._build_handles[name] = cat.register(key, table, kind="build")
+        if self._build_finalizer is None:
+            # the callback must not capture self: it holds the handle
+            # DICT (shared, mutated by register/unregister) instead
+            self._build_finalizer = weakref.finalize(
+                self, _drop_build_handles, self._build_handles
+            )
+
+    def unregister_builds(self) -> None:
+        """Drop this pipeline's registered build tables from the
+        catalog (and any spill files backing them)."""
+        _drop_build_handles(self._build_handles)
 
     # -- traced body (ONE program) -----------------------------------------
     def _trace(self, table: Table, builds: Dict[str, Table]):
@@ -242,11 +276,24 @@ class CompiledPipeline:
         # op_boundary wrapper already records wall time per dispatch)
         metrics.counter("pipeline.batches").inc()
         metrics.counter("pipeline.rows").inc(table.num_rows)
-        want = {js.build for js in plan.joins}
-        have = set(builds or {})
-        if want != have:
-            raise ValueError(f"plan needs build tables {sorted(want)}, got {sorted(have)}")
-        aggs, counts_all, num, n_oob, n_dup, n_bad_build = self._fn(table, builds or {})
+        # catalog-registered build tables fill in (re-materializing if
+        # demoted); an explicit `builds` entry of the same name wins
+        pinned = []
+        if self._build_handles:
+            builds = dict(builds or {})
+            for name, h in self._build_handles.items():
+                if name not in builds:
+                    pinned.append(h.pin())
+                    builds[name] = h.get()
+        try:
+            want = {js.build for js in plan.joins}
+            have = set(builds or {})
+            if want != have:
+                raise ValueError(f"plan needs build tables {sorted(want)}, got {sorted(have)}")
+            aggs, counts_all, num, n_oob, n_dup, n_bad_build = self._fn(table, builds or {})
+        finally:
+            for h in pinned:
+                h.unpin()
         # cancel point: a query whose budget died during the compiled
         # dispatch stops HERE, before paying the host syncs/compaction
         deadline.check("compiled_pipeline")
@@ -577,6 +624,14 @@ def _wrap_result(data, valid, how: str) -> Column:
         return Column(dt.FLOAT64, data=data, validity=valid)
     # f32-lane aggregates store into the FLOAT64 bit format
     return Column(dt.FLOAT64, data=bitutils.float_store(data.astype(jnp.float64), dt.FLOAT64), validity=valid)
+
+
+def _drop_build_handles(handles: Dict[str, object]) -> None:
+    """Close a pipeline's registered build handles (module-level so the
+    weakref finalizer keeps no reference to the pipeline itself)."""
+    for h in handles.values():
+        h.close()
+    handles.clear()
 
 
 def compile_plan(plan: PlanSpec) -> CompiledPipeline:

@@ -763,12 +763,15 @@ def stats_report(pretty: bool = False):
     the sidecar circuit breaker's state machine (state,
     open/half-open/closed transition counts, fast-fails, last trip
     cause); ``deadline`` reports the ambient SRJT_DEADLINE_SEC budget
-    and whether a scope is active at snapshot time.
+    and whether a scope is active at snapshot time; ``memgov`` is the
+    memory governor (ISSUE 4): admission counters and queue-wait
+    histogram, spilled/re-materialized bytes, and the catalog's
+    per-tier occupancy including sidecar arena registrations.
 
     Returns a JSON-serializable dict; ``pretty=True`` returns the
     aligned text rendering (utils/metrics.render_report) instead —
     the one-command artifact VERDICT items 5/7/8 ask for."""
-    from . import sidecar
+    from . import memgov, sidecar
     from .utils import deadline as deadline_mod
     from .utils import memory, metrics, retry
 
@@ -777,6 +780,7 @@ def stats_report(pretty: bool = False):
         "metrics": metrics.snapshot(),
         "retry": retry.stats(),
         "memory": {"split_retries": memory.split_retry_count()},
+        "memgov": memgov.stats_section(),
         "breaker": sidecar.breaker().snapshot(),
         "deadline": {
             "default_budget_s": deadline_mod.default_budget(),

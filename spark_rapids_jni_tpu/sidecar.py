@@ -392,16 +392,23 @@ def _op_decimal128(payload: bytes, div: bool) -> bytes:
 
 
 def _op_stats(backend: str) -> bytes:
-    """STATS verb: the worker's metrics-registry snapshot as JSON. The
-    worker counts per-op requests/errors registry-direct (always on,
-    independent of SRJT_METRICS_ENABLED — the verb must answer even
-    when hot-path instrumentation is disarmed)."""
+    """STATS verb: the worker's metrics-registry snapshot as JSON plus
+    the memory governor's section (admission + catalog state — arena
+    registrations surface here AND as ``memgov.arena*`` gauges in the
+    snapshot). The worker counts per-op requests/errors registry-direct
+    (always on, independent of SRJT_METRICS_ENABLED — the verb must
+    answer even when hot-path instrumentation is disarmed)."""
     import json
 
+    from . import memgov
     from .utils import metrics
 
     return json.dumps(
-        {"backend": backend, "snapshot": metrics.snapshot()}
+        {
+            "backend": backend,
+            "snapshot": metrics.snapshot(),
+            "memgov": memgov.stats_section(),
+        }
     ).encode()
 
 
@@ -433,10 +440,16 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     """One client connection: its own optional arena, its own thread."""
     import mmap
 
+    from . import memgov
     from .utils import metrics
 
     reg = metrics.registry()  # worker-side counters: always-on
     arena = None  # mmap over the client's memfd
+    # memory-governor bookkeeping (always-on, like the request counters):
+    # the mmap'd arena is host memory no budget would otherwise see —
+    # it registers as a host-tier PINNED catalog entry, keyed per
+    # connection, and surfaces in the STATS verb / stats_report()
+    arena_key = f"sidecar.arena.conn{id(conn)}"
     fds: list = []
     try:
         while True:
@@ -476,6 +489,10 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                         arena.close()
                     arena = mmap.mmap(fd, size)
                     os.close(fd)
+                    # re-registering the key replaces the old size
+                    memgov.catalog().register_host_bytes(
+                        arena_key, size, pinned=True, kind="arena"
+                    )
                     conn.sendall(struct.pack("<IQ", STATUS_OK, 0))
                     continue
                 if op == OP_SHUTDOWN:
@@ -515,6 +532,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     finally:
         if arena is not None:
             arena.close()
+            memgov.catalog().unregister(arena_key)
         for fd in fds:
             os.close(fd)
         conn.close()

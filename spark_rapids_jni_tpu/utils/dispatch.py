@@ -17,6 +17,7 @@ import time
 
 from . import deadline, faultinj, metrics, tracing
 from .errors import DeviceError, classify
+from .. import memgov
 
 __all__ = ["op_boundary"]
 
@@ -74,36 +75,59 @@ def op_boundary(name: str):
       and wall-clock histogram (``op.<name>.calls`` /
       ``op.<name>.wall_us``) spanning the full boundary including any
       retries/backoff; disarmed, the only cost is one boolean read —
-      no clock, no registry touch.
+      no clock, no registry touch,
+    - MEMORY GOVERNOR (memgov/, ISSUE 4): with the governor armed
+      (``SRJT_SPILL_ENABLED``, or implicitly by a declared
+      ``SRJT_DEVICE_MEMORY_BUDGET``), the OUTERMOST boundary on a
+      thread acquires the byte-weighted admission semaphore with the
+      op's footprint estimate before dispatch — the reserved
+      ``memory_bytes=`` keyword overrides the default input-bytes ×
+      ``SRJT_MEMGOV_HEADROOM`` estimate — and releases it after.
+      Admission sits INSIDE the retry attempt: a retryable admission
+      denial (``MemoryBudgetExceeded``) rides the orchestrator's
+      backoff/split machinery like any other RESOURCE_EXHAUSTED class.
+      Disarmed (the default), the cost is one reserved-kwarg pop plus
+      one boolean read — the metrics-stub pattern.
     """
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             budget_s = kwargs.pop("deadline_s", None)
+            mem_bytes = kwargs.pop("memory_bytes", None)
 
             def attempt():
                 faultinj.maybe_inject(name)
-                with tracing.func_range(name):
-                    try:
-                        return fn(*args, **kwargs)
-                    except DeviceError:
-                        raise
-                    except (ValueError, TypeError, KeyError, IndexError):
-                        raise
-                    except Exception as e:  # backend / runtime failures
-                        if type(e).__module__.startswith("spark_rapids_jni_tpu"):
-                            # the op's own documented API errors (CastError,
-                            # ParquetReadError, ...) are results, not failures
+                adm = (
+                    memgov.admit(name, args, kwargs, mem_bytes)
+                    if memgov.is_enabled()
+                    else None
+                )
+                try:
+                    with tracing.func_range(name):
+                        try:
+                            return fn(*args, **kwargs)
+                        except DeviceError:
                             raise
-                        raise classify(e) from e
+                        except (ValueError, TypeError, KeyError, IndexError):
+                            raise
+                        except Exception as e:  # backend / runtime failures
+                            if type(e).__module__.startswith("spark_rapids_jni_tpu"):
+                                # the op's own documented API errors (CastError,
+                                # ParquetReadError, ...) are results, not failures
+                                raise
+                            raise classify(e) from e
+                finally:
+                    if adm is not None:
+                        adm.release()
 
             # deadline scoping mirrors the retry nesting guard inside
             # _run_boundary: one scope per query, owned by the boundary
-            # that opened it. The common fully-disarmed path pays one
-            # kwargs.pop, a context-var read, and one extra frame
-            # (_run_boundary) on top of what the seed paid — no closure
-            # beyond `attempt`, no clock, no context manager.
+            # that opened it. The common fully-disarmed path pays two
+            # kwargs.pops, a boolean read (memgov gate), a context-var
+            # read, and one extra frame (_run_boundary) on top of what
+            # the seed paid — no closure beyond `attempt`, no clock, no
+            # context manager.
             dl = deadline.current()
             if budget_s is None and dl is None:
                 budget_s = deadline.default_budget()

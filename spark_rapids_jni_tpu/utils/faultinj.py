@@ -19,7 +19,11 @@ sit. Feature parity:
   the context-local deadline/cancel token (utils/deadline.py) and
   aborts with DeadlineExceeded the moment the budget dies: the chaos
   tool for deadline-expiry and circuit-breaker paths; with no active
-  deadline the full hang is slept),
+  deadline the full hang is slept), ``spill_fail`` (a RetryableError
+  meant for the memory governor's demotion choke point — key the rule
+  ``"memgov.spill"``, which the spillable catalog (memgov/catalog.py)
+  crosses on every spill; the catalog absorbs the failure, counts it,
+  and keeps the entry resident),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -99,7 +103,8 @@ def _parse(cfg: dict) -> None:
     _state.rules = {}
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
-        if kind not in ("fatal", "retryable", "exception", "delay", "hang"):
+        if kind not in ("fatal", "retryable", "exception", "delay", "hang",
+                        "spill_fail"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -193,6 +198,12 @@ def maybe_inject(op_name: str) -> None:
         raise FatalDeviceError(f"injected fatal fault in {op_name}")
     if kind == "retryable":
         raise RetryableError(f"injected retryable fault in {op_name}")
+    if kind == "spill_fail":
+        # the memory governor's demotion chaos (memgov/catalog.py calls
+        # maybe_inject("memgov.spill") around every spill): same
+        # retryable class, distinct message — the catalog catches it,
+        # counts memgov.spill_failures, and leaves the entry resident
+        raise RetryableError(f"injected spill failure in {op_name}")
     if kind == "delay":
         # latency, not failure: sleeps OUTSIDE the injector lock so a
         # delay storm cannot serialize every other dispatch behind it

@@ -10,6 +10,14 @@ footprint BEFORE dispatch and, over budget, either raise
 ``MemoryBudgetExceeded`` (a ``RetryableError``: Spark task retry
 semantics apply) or split the batch and re-run — never drive XLA into
 an allocator OOM that may poison the client.
+
+ENFORCEMENT lives in ``spark_rapids_jni_tpu/memgov`` (ISSUE 4): the
+byte-weighted admission controller gates every outermost op_boundary
+dispatch on this module's budget, and the spillable buffer catalog
+demotes cold buffers device->host->disk under pressure. This module
+keeps the shared pieces both tiers consume: the budget resolution
+(memoized backend probe, live env override, live ``bytes_in_use``
+subtraction) and the footprint estimators.
 """
 
 from __future__ import annotations
@@ -57,30 +65,64 @@ def _note_split() -> None:
     metrics.event("memory.split_retry")
 
 
-def device_memory_budget() -> int:
-    """Usable device bytes for a single op's working buffers.
+# memoized backend probe: resolving the budget used to re-import jax
+# and re-read memory_stats() on EVERY call, which the memgov admission
+# controller now makes per-dispatch. The resolved limit is cached; the
+# env override stays live (the test hook), and live bytes_in_use is
+# subtracted when the backend reports it.
+_RESOLVED: "int | None" = None
+_STATS_DEV = None  # device whose memory_stats() reports live bytes_in_use
+_MIN_BUDGET = 64 << 20  # floor after bytes_in_use subtraction
 
-    Resolution order: ``SRJT_DEVICE_MEMORY_BUDGET`` (bytes; the test
-    hook and the operator override), the backend's reported limit when
-    it exposes one, else a platform default (v5e HBM less runtime
-    reserve; host RAM share on CPU). The budget is per-op headroom, not
-    the raw chip size: XLA temps routinely need a small multiple of the
-    declared buffers."""
-    env = os.environ.get("SRJT_DEVICE_MEMORY_BUDGET")
-    if env:
-        return int(env)
+
+def _resolve_backend_budget() -> int:
+    """One-time probe of the backend's reported limit (or the platform
+    default); remembers the device handle when it can report live
+    ``bytes_in_use``."""
+    global _STATS_DEV
     try:
         import jax
 
         dev = jax.local_devices()[0]
         stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
         if stats and stats.get("bytes_limit"):
+            if stats.get("bytes_in_use") is not None:
+                _STATS_DEV = dev
             return int(stats["bytes_limit"] * 0.5)
         if dev.platform == "tpu":
             return 8 << 30  # half of v5e's 16 GB HBM
     except Exception:
         pass
     return 4 << 30  # conservative CPU-tier default
+
+
+def device_memory_budget() -> int:
+    """Usable device bytes for a single op's working buffers.
+
+    Resolution order: ``SRJT_DEVICE_MEMORY_BUDGET`` (bytes; read LIVE —
+    the test hook and the operator override), else the memoized backend
+    probe — the reported limit when the backend exposes one, else a
+    platform default (v5e HBM less runtime reserve; host RAM share on
+    CPU) — minus the backend's live ``bytes_in_use`` when it reports
+    one (floored at 64 MiB so transient allocator spikes degrade to
+    splitting, never to a zero budget). The budget is per-op headroom,
+    not the raw chip size: XLA temps routinely need a small multiple of
+    the declared buffers."""
+    env = os.environ.get("SRJT_DEVICE_MEMORY_BUDGET")
+    if env:
+        return int(env)
+    global _RESOLVED
+    if _RESOLVED is None:
+        _RESOLVED = _resolve_backend_budget()
+    budget = _RESOLVED
+    if _STATS_DEV is not None:
+        try:
+            in_use = int(_STATS_DEV.memory_stats().get("bytes_in_use") or 0)
+        except Exception:
+            in_use = 0
+        if in_use:
+            budget = max(budget - in_use, _MIN_BUDGET)
+    return budget
 
 
 def exchange_bytes_estimate(row_bytes: int, n_parts: int, capacity: int) -> int:

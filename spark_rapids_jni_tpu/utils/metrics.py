@@ -532,6 +532,15 @@ def stage_report(stage: str) -> dict:
         },
         "retry": rs,
         "memory": {"split_retries": memory.split_retry_count()},
+        # ISSUE 4 memory-governor counters: admissions vs queue/reject
+        # pressure, and the spill volume the squeeze artifacts audit
+        "memgov": {
+            "admitted": _REGISTRY.value("memgov.admitted"),
+            "queued": _REGISTRY.value("memgov.queued"),
+            "rejected": _REGISTRY.value("memgov.rejected"),
+            "spilled_bytes": _REGISTRY.value("memgov.spilled_bytes"),
+            "respilled": _REGISTRY.value("memgov.respilled"),
+        },
         # ISSUE 3 robustness counters: budget give-ups vs truncated
         # backoffs, and the sidecar breaker's registry-direct gauges
         "deadline": {
