@@ -93,6 +93,40 @@ print(f"archived {len(lines)} memgov events ({spilled} bytes spilled) "
       "-> artifacts/memgov_events.jsonl")
 EOF
 
+# crash-storm tier (ISSUE 5): the full sidecar-pool + integrity suite
+# with the crash/corrupt chaos profile armed INSIDE real workers — a
+# pool of 2 survives kill -9 mid-query (failover + arena re-hydration)
+# and every injected corruption surfaces as DataCorruption, never a
+# wrong answer. The hard timeout is the leaked/wedged-worker assertion;
+# the archived event log must PROVE the storm fired: nonzero
+# sidecar.pool.failovers (worker deaths failed over) and nonzero
+# sidecar.integrity.crc_mismatch (corruptions caught) are the artifact
+# contract, with zero test failures above them.
+rm -f artifacts/crash_metrics.jsonl
+timeout -k 10 900 env SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/crash_metrics.jsonl \
+  python -m pytest tests/test_sidecar_pool.py -q
+python - <<'EOF'
+import json
+lines = [json.loads(s) for s in open("artifacts/crash_metrics.jsonl")]
+assert lines, "crash-storm tier produced no events"
+kinds = {r["event"] for r in lines}
+assert "sidecar.pool.worker_death" in kinds, "no worker death recorded"
+assert "sidecar.pool.respawn" in kinds, "no respawn recorded"
+assert "sidecar.pool.rehydrate" in kinds, "no arena re-hydration recorded"
+assert "integrity.crc_mismatch" in kinds, "no corruption caught"
+deaths = sum(1 for r in lines if r["event"] == "sidecar.pool.worker_death")
+failovers = sum(1 for r in lines
+                if r["event"] == "sidecar.pool.worker_death" and r.get("live", 0) > 0)
+mismatches = sum(1 for r in lines if r["event"] == "integrity.crc_mismatch")
+assert failovers > 0, "no failover observed (every death left the pool dark)"
+assert mismatches > 0, "no crc_mismatch observed"
+print(f"archived {len(lines)} crash events ({deaths} deaths, "
+      f"{failovers} failovers, {mismatches} corruptions caught) "
+      "-> artifacts/crash_metrics.jsonl")
+EOF
+
 # (the disabled-mode overhead guard —
 # tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
 # tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the

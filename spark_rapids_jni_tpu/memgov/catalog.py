@@ -11,7 +11,12 @@ semantics, LRU-ordered demotion device->host (numpy) ->disk
 on ``get()``. Demoted leaves are exact byte copies (numpy round-trips
 IEEE bit patterns and integer lanes unchanged), so a
 spill->re-materialize cycle is bit-identical — the invariant
-tests/test_memgov.py round-trips.
+tests/test_memgov.py round-trips. Disk spills are CRC-framed
+(utils/integrity.py; ISSUE 5): the container carries a checksum
+verified on re-materialization, so a bit-rotted or truncated spill
+raises retryable ``DataCorruption`` (the caller re-computes via the
+retry/split machinery) instead of silently feeding wrong bytes back
+into a query.
 
 Accounting-only entries (``register_host_bytes``: sidecar arena memfds)
 carry a size but no payload; they make host-tier consumers visible to
@@ -60,6 +65,9 @@ __all__ = [
 TIER_DEVICE = "device"
 TIER_HOST = "host"
 TIER_DISK = "disk"
+
+# disk-spill container magic (ISSUE 5): [magic 8][u32 crc][u64 len][npz]
+_SPILL_MAGIC = b"SRJTSPL1"
 
 
 def _registry():
@@ -341,8 +349,14 @@ class BufferCatalog:
                 metrics.event("memgov.spill_failed", key=h.key, tier=TIER_DISK)
 
     def _demote_disk_locked(self, h: SpillableHandle) -> None:
-        """host -> disk: one .npz per entry under SRJT_SPILL_DIR."""
-        from ..utils import metrics
+        """host -> disk: one CRC-framed .npz container per entry under
+        SRJT_SPILL_DIR (utils/integrity.py: magic + u32 CRC + u64 len +
+        npz payload, verified on re-materialization — a bit-rotted or
+        truncated spill surfaces as retryable DataCorruption, never as
+        wrong rows)."""
+        import io
+
+        from ..utils import integrity, metrics
 
         reg = _registry()
         t0 = time.perf_counter()
@@ -350,7 +364,17 @@ class BufferCatalog:
         path = os.path.join(
             self._resolve_spill_dir(), f"{safe}-{h._seq}.npz"
         )
-        np.savez(path, **{f"a{i}": leaf for i, leaf in enumerate(h._host)})
+        buf = io.BytesIO()
+        np.savez(buf, **{f"a{i}": leaf for i, leaf in enumerate(h._host)})
+        blob = buf.getvalue()
+        with open(path, "wb") as f:
+            if integrity.is_enabled():
+                f.write(_SPILL_MAGIC)
+                f.write(integrity.pack_crc(integrity.checksum(blob)))
+                f.write(len(blob).to_bytes(8, "little"))
+            # integrity off: plain npz, no hashing anywhere (the loader
+            # accepts both forms, so toggling mid-life stays safe)
+            f.write(blob)
         h._disk_path = path
         h._host = None
         reg.counter("memgov.disk_spills").inc()
@@ -421,6 +445,61 @@ class BufferCatalog:
 
     # -- access / re-materialization -----------------------------------------
 
+    def _load_disk_locked(self, h: SpillableHandle) -> None:
+        """disk -> host half of re-materialization: parse the CRC-framed
+        container and VERIFY before trusting a byte (ISSUE 5). A
+        mismatch — bit rot, truncation, a torn write — closes the entry
+        (the only copy is bad; keeping it would serve the corruption
+        again) and raises retryable ``DataCorruption`` so the caller's
+        retry/split machinery re-computes from source instead of
+        returning wrong rows. Legacy unframed .npz files (pre-integrity
+        spills) still load, unverified."""
+        import io
+
+        from ..utils import integrity, metrics
+
+        path = h._disk_path
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            if raw[: len(_SPILL_MAGIC)] == _SPILL_MAGIC:
+                crc = integrity.unpack_crc(raw, len(_SPILL_MAGIC))
+                blen = int.from_bytes(
+                    raw[len(_SPILL_MAGIC) + 4 : len(_SPILL_MAGIC) + 12], "little"
+                )
+                blob = raw[len(_SPILL_MAGIC) + 12 :]
+                if integrity.is_enabled():
+                    _registry().counter("sidecar.integrity.spills_checked").inc()
+                    if len(blob) != blen:
+                        raise integrity.raise_corruption(
+                            "memgov.spill", f"{h.key}: truncated ({len(blob)} != {blen})"
+                        )
+                    integrity.verify(blob, crc, "memgov.spill")
+            else:
+                blob = raw  # pre-integrity spill file: no trailer to check
+            with np.load(io.BytesIO(blob)) as z:
+                h._host = [z[f"a{i}"] for i in range(h._n_leaves)]
+        except Exception as e:
+            # corrupt (DataCorruption) or unreadable (zipfile/KeyError/
+            # OSError — the same disease without a checksum to name it):
+            # retire the entry and its file, then surface the corruption
+            from ..utils.errors import DataCorruption
+
+            metrics.event("memgov.spill_corrupt", key=h.key, path=path)
+            self._entries.pop(h.key, None)
+            self._close_locked(h)
+            self._update_gauges_locked()
+            if isinstance(e, DataCorruption):
+                raise
+            raise integrity.raise_corruption(
+                "memgov.spill", f"{h.key}: unreadable spill file ({e})"
+            ) from e
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        h._disk_path = None
+
     def _get(self, h: SpillableHandle):
         import jax
         from ..utils import metrics
@@ -438,13 +517,7 @@ class BufferCatalog:
             if h._device is None:
                 t0 = time.perf_counter()
                 if h._disk_path is not None:
-                    with np.load(h._disk_path) as z:
-                        h._host = [z[f"a{i}"] for i in range(h._n_leaves)]
-                    try:
-                        os.unlink(h._disk_path)
-                    except OSError:
-                        pass
-                    h._disk_path = None
+                    self._load_disk_locked(h)
                 import jax.numpy as jnp
 
                 h._device = [jnp.asarray(x) for x in h._host]

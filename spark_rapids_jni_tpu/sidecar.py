@@ -11,8 +11,19 @@ process never hosts a Python interpreter; the native library falls back
 to its host-CPU engine when no sidecar/chip is available.
 
 Wire protocol (little-endian):
-  request:  [u32 op] [u64 payload_len] [payload]
-  response: [u32 status(0=ok)] [u64 payload_len] [payload | utf-8 error]
+  request:  [u32 op] [u64 payload_len] [u32 crc?] [payload]
+  response: [u32 status(0=ok)] [u64 payload_len] [u32 crc?] [payload | utf-8 error]
+
+Integrity (ISSUE 5): a client that sets the CRC_FLAG bit (0x40000000)
+of ``op`` appends a 4-byte CRC trailer (utils/integrity.py) right
+after the 12-byte header, covering the payload wherever it lives
+(socket stream or arena); the worker verifies it — a mismatch answers
+``status 1`` with a ``DataCorruption:`` message (retryable: the client
+re-sends) — and echoes the flag back on the response with a trailer of
+its own, which the client verifies before trusting a byte. The flag is
+negotiated PER FRAME, so the native C++ client (which never sets it)
+keeps the legacy framing byte for byte, and ``SRJT_INTEGRITY_CHECKS=0``
+restores the seed posture with zero extra syscalls.
 
 Round 5 shared-memory data plane (VERDICT r4 missing #2): a client may
 send OP_SET_ARENA (9, payload = u64 size) with a memfd attached via
@@ -87,6 +98,14 @@ prefixed ``RetryableError:`` / ``FatalDeviceError:`` (the worker's
 op_boundary taxonomy stringified over the wire) is re-raised as that
 class on the client, which is what makes remote faults retryable.
 
+Crash tolerance (ISSUE 5): a SINGLE worker is a single point of
+failure for all device state — ``sidecar_pool.SidecarPool`` supervises
+N of these workers with health-checked routing, failover, automatic
+respawn, and SET_ARENA re-hydration (the pool owns the arena memfd, so
+a replacement worker re-maps the same pages). The circuit breaker
+below then guards the POOL: it records failures only when every worker
+is unhealthy.
+
 Deadlines + circuit breaker (ISSUE 3): under an active deadline scope
 (utils/deadline.py) every request's socket deadline is
 ``min(SRJT_SIDECAR_TIMEOUT_SEC, remaining budget)`` and reconnect
@@ -146,6 +165,8 @@ def op_name(op: int) -> str:
     return _OP_NAMES.get(op, f"OP_{op}")
 
 ARENA_FLAG = 0x80000000  # high bit of op/status: payload at arena[0:len]
+CRC_FLAG = 0x40000000  # op/status bit: a u32 CRC trailer follows the header
+_FLAG_MASK = ARENA_FLAG | CRC_FLAG
 
 STATUS_OK = 0
 STATUS_ERROR = 1
@@ -441,7 +462,8 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     import mmap
 
     from . import memgov
-    from .utils import metrics
+    from .utils import faultinj, integrity, metrics
+    from .utils.errors import DataCorruption
 
     reg = metrics.registry()  # worker-side counters: always-on
     arena = None  # mmap over the client's memfd
@@ -451,6 +473,24 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     # connection, and surfaces in the STATS verb / stats_report()
     arena_key = f"sidecar.arena.conn{id(conn)}"
     fds: list = []
+
+    def reply(status: int, body: bytes, with_crc: bool, crc_body: bytes = None):
+        """One response frame. ``crc_body`` is what the trailer covers
+        when it differs from the bytes on the wire — the injected
+        ``corrupt`` chaos flips bytes AFTER checksumming, exactly like
+        a transport fault, so the client's CRC check MUST fail."""
+        trailer = b""
+        if with_crc and integrity.is_enabled():
+            status |= CRC_FLAG
+            trailer = integrity.pack_crc(
+                integrity.checksum(body if crc_body is None else crc_body)
+            )
+        if status & ~_FLAG_MASK == STATUS_OK and arena is not None and 0 < len(body) <= len(arena):
+            arena[: len(body)] = body
+            conn.sendall(struct.pack("<IQ", status | ARENA_FLAG, len(body)) + trailer)
+        else:
+            conn.sendall(struct.pack("<IQ", status, len(body)) + trailer + body)
+
     try:
         while True:
             try:
@@ -458,16 +498,40 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             except ConnectionError:
                 return  # client went away: this connection only
             wire_op, plen = struct.unpack("<IQ", hdr)
-            op = wire_op & ~ARENA_FLAG
+            op = wire_op & ~_FLAG_MASK
             in_arena = bool(wire_op & ARENA_FLAG)
+            with_crc = bool(wire_op & CRC_FLAG)
             reg.counter(f"sidecar.worker.requests.{op_name(op)}").inc()
+            # the CRC trailer rides the SOCKET right after the header,
+            # even for arena-resident payloads — read it before any
+            # early-out so the stream stays framed
+            req_crc = (
+                integrity.unpack_crc(_recv_exact(conn, 4, fds)) if with_crc else None
+            )
             if in_arena:
                 if arena is None or plen > len(arena):
-                    conn.sendall(struct.pack("<IQ", STATUS_ERROR, 0))
+                    # retryable by prefix: a redialed connection lost its
+                    # per-connection arena — the client replays SET_ARENA
+                    # and re-sends (sidecar_pool._ensure_arena)
+                    reply(
+                        STATUS_ERROR,
+                        b"RetryableError: arena request without an uploaded"
+                        b" arena (re-send SET_ARENA)",
+                        with_crc,
+                    )
                     continue
                 payload = bytes(arena[:plen])
             else:
                 payload = _recv_exact(conn, plen, fds) if plen else b""
+            if req_crc is not None and integrity.is_enabled():
+                reg.counter("sidecar.integrity.frames_checked").inc()
+                try:
+                    integrity.verify(payload, req_crc, "sidecar.request")
+                except DataCorruption as e:
+                    # taxonomy prefix on the wire: the client re-raises
+                    # DataCorruption (retryable) and re-sends the frame
+                    reply(STATUS_ERROR, f"{type(e).__name__}: {e}".encode(), with_crc)
+                    continue
             # chaos mode (VERDICT r4 item 7): SRJT_CHAOS_EXIT_ON_OP=<n>
             # makes the worker DIE mid-op — after consuming the request,
             # before any response — modeling the round-4 "kernel fault"
@@ -477,6 +541,12 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             if chaos is not None and op == int(chaos):
                 os._exit(42)
             try:
+                # per-request fault hook (ISSUE 5): `crash` rules keyed
+                # `sidecar.worker.<OP>` SIGKILL the worker here — after
+                # consuming the request, before any response — and
+                # error kinds surface as status-1 replies
+                if faultinj.is_enabled():
+                    faultinj.maybe_inject(f"sidecar.worker.{op_name(op)}")
                 if op == OP_SET_ARENA:
                     (size,) = struct.unpack_from("<Q", payload, 0)
                     if not fds:
@@ -486,14 +556,22 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                         os.close(extra)
                     fds.clear()
                     if arena is not None:
+                        # replace = unregister-then-register: close the
+                        # old mapping AND retire its accounting entry
+                        # before the new map exists, so a failed re-map
+                        # can't leave stale host-tier bytes and a
+                        # successful one never double-counts
+                        # (regression: memgov.arena* gauges stay flat
+                        # across re-uploads)
                         arena.close()
+                        arena = None
+                        memgov.catalog().unregister(arena_key)
                     arena = mmap.mmap(fd, size)
                     os.close(fd)
-                    # re-registering the key replaces the old size
                     memgov.catalog().register_host_bytes(
                         arena_key, size, pinned=True, kind="arena"
                     )
-                    conn.sendall(struct.pack("<IQ", STATUS_OK, 0))
+                    reply(STATUS_OK, b"", with_crc)
                     continue
                 if op == OP_SHUTDOWN:
                     conn.sendall(struct.pack("<IQ", 0, 0))
@@ -509,11 +587,13 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                     reg.histogram(f"sidecar.worker.op_us.{op_name(op)}").record(
                         (time.perf_counter() - t0) * 1e6
                     )
-                if arena is not None and 0 < len(resp) <= len(arena):
-                    arena[: len(resp)] = resp
-                    conn.sendall(struct.pack("<IQ", STATUS_OK | ARENA_FLAG, len(resp)))
-                else:
-                    conn.sendall(struct.pack("<IQ", STATUS_OK, len(resp)) + resp)
+                wire_resp = resp
+                if faultinj.is_enabled():
+                    # `corrupt` chaos: flips bytes BELOW the checksum
+                    wire_resp = faultinj.maybe_corrupt(
+                        f"sidecar.worker.{op_name(op)}", resp
+                    )
+                reply(STATUS_OK, wire_resp, with_crc, crc_body=resp)
             except Exception as e:  # report, keep serving
                 from .ops.cast_string import CastError
 
@@ -525,10 +605,9 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                     sv = e.string_with_error
                     val = sv.encode() if isinstance(sv, str) else (bytes(sv) if sv else b"")
                     msg = struct.pack("<qB", int(e.row_with_error), 1 if sv is None else 0) + val
-                    conn.sendall(struct.pack("<IQ", STATUS_CAST_ERROR, len(msg)) + msg)
+                    reply(STATUS_CAST_ERROR, msg, with_crc)
                 else:
-                    msg = f"{type(e).__name__}: {e}".encode()
-                    conn.sendall(struct.pack("<IQ", STATUS_ERROR, len(msg)) + msg)
+                    reply(STATUS_ERROR, f"{type(e).__name__}: {e}".encode(), with_crc)
     finally:
         if arena is not None:
             arena.close()
@@ -601,6 +680,11 @@ class SupervisedClient:
         self._ever_connected = False
         self.reconnects = 0  # supervision observability: REDIALS only
         self.host_fallbacks = 0
+        # shared-memory data plane (set by the pool after SET_ARENA):
+        # the worker opportunistically answers through the arena once a
+        # connection has one, so the client must be able to READ
+        # ARENA_FLAG responses even for stream requests
+        self.arena_mm = None
 
     # -- connection lifecycle ------------------------------------------------
 
@@ -664,7 +748,7 @@ class SupervisedClient:
             buf.extend(chunk)
         return bytes(buf)
 
-    def _raw_request(self, op: int, payload: bytes):
+    def _raw_request(self, op: int, payload: bytes, arena_len: int = None):
         """One request/response exchange on the live socket, bounded by
         one per-request deadline end to end — under an active deadline
         scope that is ``min(deadline_s, remaining budget)``, so a hung
@@ -672,9 +756,14 @@ class SupervisedClient:
         transport fault closes the connection (desync discipline) and
         raises RetryableError; an exhausted BUDGET raises
         DeadlineExceeded instead (the caller must see the query
-        deadline, never a raw socket timeout)."""
-        from .utils import deadline as deadline_mod
-        from .utils.errors import RetryableError
+        deadline, never a raw socket timeout).
+
+        With ``arena_len`` the request payload is RESIDENT at
+        ``arena_mm[0:arena_len]`` (the shared-memory data plane): only
+        the header — and the CRC trailer, computed over the ARENA bytes
+        — crosses the socket, under ``wire_op | ARENA_FLAG``."""
+        from .utils import deadline as deadline_mod, integrity
+        from .utils.errors import DataCorruption, RetryableError
 
         d = deadline_mod.current()
         budget_s = self.deadline_s
@@ -682,12 +771,47 @@ class SupervisedClient:
             d.check(f"sidecar_op_{op}")
             budget_s = min(budget_s, max(d.remaining(), 1e-3))
         deadline = time.monotonic() + budget_s
+        # integrity (ISSUE 5): one boolean read when off — the frame is
+        # byte-identical to the legacy protocol, same single sendall.
+        # When on, the 4-byte CRC trailer rides the SAME sendall and the
+        # worker echoes the flag back with a trailer this side verifies.
+        use_crc = integrity.is_enabled()
+        wire_op = (op | CRC_FLAG) if use_crc else op
+        if arena_len is None:
+            body, plen = payload, len(payload)
+        else:
+            if self.arena_mm is None or arena_len > len(self.arena_mm):
+                raise ValueError(
+                    "arena_len given but no client-side arena is mapped"
+                )
+            wire_op |= ARENA_FLAG
+            body, plen, payload = bytes(self.arena_mm[:arena_len]), arena_len, b""
+        trailer = (
+            integrity.pack_crc(integrity.checksum(body)) if use_crc else b""
+        )
         try:
             self._sock.settimeout(budget_s)
-            self._sock.sendall(struct.pack("<IQ", op, len(payload)) + payload)
+            self._sock.sendall(
+                struct.pack("<IQ", wire_op, plen) + trailer + payload
+            )
             hdr = self._recv_deadline(12, deadline)
             status, rlen = struct.unpack("<IQ", hdr)
-            resp = self._recv_deadline(rlen, deadline) if rlen else b""
+            resp_crc = (
+                integrity.unpack_crc(self._recv_deadline(4, deadline))
+                if status & CRC_FLAG
+                else None
+            )
+            if status & ARENA_FLAG:
+                # the worker answered through the shared arena: only the
+                # header (and CRC trailer) crossed the socket — a client
+                # without the mapping cannot honor the frame (desync)
+                if self.arena_mm is None or rlen > len(self.arena_mm):
+                    raise ConnectionError(
+                        "arena-flagged response without a client-side arena"
+                    )
+                resp = bytes(self.arena_mm[:rlen])
+            else:
+                resp = self._recv_deadline(rlen, deadline) if rlen else b""
         except socket.timeout as e:
             self.close()
             if d is not None and d.done():
@@ -699,8 +823,20 @@ class SupervisedClient:
         except (ConnectionError, OSError) as e:
             self.close()
             raise RetryableError(f"sidecar: Socket closed mid-request ({e})") from e
+        if resp_crc is not None and integrity.is_enabled():
+            from .utils import metrics
+
+            metrics.registry().counter("sidecar.integrity.frames_checked").inc()
+            try:
+                integrity.verify(resp, resp_crc, "sidecar.response")
+            except DataCorruption:
+                # the stream is still framed (full frame consumed) but a
+                # link that corrupts one frame gets the desync treatment:
+                # close now, dial fresh on the retry that re-fetches
+                self.close()
+                raise
         self._last_io = time.monotonic()
-        return status & ~ARENA_FLAG, resp
+        return status & ~_FLAG_MASK, resp
 
     def ping(self) -> str:
         """Heartbeat round-trip; returns the worker's backend name."""
@@ -717,14 +853,18 @@ class SupervisedClient:
             raise RetryableError("sidecar: PING failed (worker unhealthy)")
         return resp.decode()
 
-    def request(self, op: int, payload: bytes) -> bytes:
+    def request(self, op: int, payload: bytes, arena_len: int = None) -> bytes:
         """Supervised exchange: reconnect when needed, heartbeat stale
         connections, classify worker-side errors into the
         fatal/retryable taxonomy. With metrics armed, every exchange
         records a latency histogram (``sidecar.request_us``) and
-        failures count under ``sidecar.request_failures``."""
+        failures count under ``sidecar.request_failures``.
+        ``arena_len`` routes the request through the shared-memory data
+        plane (see ``_raw_request``) under the SAME deadline clamp,
+        CRC protocol, and taxonomy as a stream frame."""
         from .utils import metrics
         from .utils.errors import (
+            DataCorruption,
             DeadlineExceeded,
             FatalDeviceError,
             RetryableError,
@@ -745,7 +885,7 @@ class SupervisedClient:
         armed = metrics.is_enabled()
         t0 = time.perf_counter() if armed else 0.0
         try:
-            status, resp = self._raw_request(op, payload)
+            status, resp = self._raw_request(op, payload, arena_len)
         except Exception:
             metrics.counter("sidecar.request_failures").inc()
             raise
@@ -763,6 +903,12 @@ class SupervisedClient:
             raise _cast_error_from_wire(resp)
         # worker-side failure text carries the taxonomy prefix from the
         # worker's own op_boundary classification
+        if msg.startswith("DataCorruption:"):
+            # the WORKER's CRC check rejected our request frame: the
+            # payload rotted in flight — retryable, the retry re-sends
+            # (checked before the RetryableError prefix: corruption is
+            # its own class so chaos assertions can tell them apart)
+            raise DataCorruption(f"sidecar worker: {msg}")
         if msg.startswith("RetryableError:"):
             raise RetryableError(f"sidecar worker: {msg}")
         if msg.startswith("FatalDeviceError:"):
@@ -893,7 +1039,7 @@ class SupervisedClient:
             ) from e
         finally:
             s.close()
-        if (status & ~ARENA_FLAG) != STATUS_OK:
+        if (status & ~_FLAG_MASK) != STATUS_OK:
             raise RetryableError("sidecar: STATS failed (worker unhealthy)")
         try:
             stats = json.loads(resp.decode("utf-8", "replace"))
@@ -1022,7 +1168,7 @@ def spawn_worker(
                 status, rlen = struct.unpack("<IQ", hdr)
                 if rlen:
                     _recv_exact(probe, rlen)
-                if (status & ~ARENA_FLAG) != STATUS_OK:
+                if (status & ~_FLAG_MASK) != STATUS_OK:
                     raise RuntimeError(
                         "sidecar worker failed the startup PING handshake"
                     )

@@ -18,6 +18,7 @@ __all__ = [
     "DeviceError",
     "FatalDeviceError",
     "RetryableError",
+    "DataCorruption",
     "DeadlineExceeded",
     "classify",
 ]
@@ -33,6 +34,16 @@ class FatalDeviceError(DeviceError):
 
 class RetryableError(DeviceError):
     """Transient failure; the same batch may be retried on this device."""
+
+
+class DataCorruption(RetryableError):
+    """A CRC-checked payload failed verification (utils/integrity.py):
+    a wire frame, a disk-spill file, or a shuffle exchange whose bytes
+    changed between producer and consumer. RETRYABLE by design — the
+    device and the data source are healthy; the COPY is bad, so the
+    retry/split machinery re-fetches or re-computes instead of
+    returning wrong rows (Thallus's checksummed-transport posture:
+    corruption must surface as an error, never as an answer)."""
 
 
 class DeadlineExceeded(DeviceError):
@@ -73,6 +84,10 @@ _RETRYABLE_MARKERS = (
     "Connection refused",
     "Connection reset",
     "Broken pipe",
+    # integrity layer (utils/integrity.py): a stringified DataCorruption
+    # crossing a process boundary (sidecar wire taxonomy) must stay
+    # retryable — re-fetching is exactly the productive recovery
+    "CRC mismatch",
 )
 
 

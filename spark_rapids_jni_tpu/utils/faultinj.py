@@ -23,7 +23,17 @@ sit. Feature parity:
   meant for the memory governor's demotion choke point — key the rule
   ``"memgov.spill"``, which the spillable catalog (memgov/catalog.py)
   crosses on every spill; the catalog absorbs the failure, counts it,
-  and keeps the entry resident),
+  and keeps the entry resident), ``crash`` (the process SIGKILLs
+  ITSELF the moment the rule fires — armed inside a sidecar worker,
+  whose request loop injects under ``sidecar.worker.<OP>`` keys, this
+  is the kill-9-mid-query chaos the worker-pool failover tier
+  (sidecar_pool.py) exists to survive: the request is consumed, no
+  response is ever written, the client sees a dead transport), ``corrupt``
+  (byte-flips a payload AFTER its CRC is computed — modeling in-flight
+  corruption the integrity layer (utils/integrity.py) must catch;
+  inert under ``maybe_inject``, it fires only through
+  ``maybe_corrupt(op, data)``, the hook the sidecar worker crosses on
+  every response),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -62,7 +72,14 @@ from typing import Dict, Optional
 
 from .errors import FatalDeviceError, RetryableError
 
-__all__ = ["configure", "configure_from_file", "disable", "maybe_inject", "is_enabled"]
+__all__ = [
+    "configure",
+    "configure_from_file",
+    "disable",
+    "maybe_inject",
+    "maybe_corrupt",
+    "is_enabled",
+]
 
 
 class _Rule:
@@ -104,7 +121,7 @@ def _parse(cfg: dict) -> None:
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
         if kind not in ("fatal", "retryable", "exception", "delay", "hang",
-                        "spill_fail"):
+                        "spill_fail", "crash", "corrupt"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -164,36 +181,61 @@ def _reload_if_changed() -> None:
         _state.mtime = m
 
 
+def _draw_locked(op_name: str, corrupt: bool):
+    """Locked half of fault arming shared by ``maybe_inject`` and
+    ``maybe_corrupt``: resolve the rule, run the `after`/`ramp`/budget
+    scheduling, draw the RNG, and return (kind, delay_ms) when the rule
+    fires, else None. ``corrupt`` selects which rule family this call
+    site services — a ``corrupt`` rule never burns scheduling state or
+    budget on a ``maybe_inject`` dispatch (its choke point is the
+    payload producer), and vice versa."""
+    _reload_if_changed()
+    rule = _state.rules.get(op_name) or _state.rules.get("*")
+    if rule is None:
+        return None
+    if (rule.kind == "corrupt") != corrupt:
+        return None
+    if rule.budget is not None and rule.budget <= 0:
+        return None
+    # scheduling: count every matching dispatch; hold fire for the
+    # first `after`, then ramp the effective percent over `ramp`
+    # armed dispatches. The RNG draw happens only once armed, so a
+    # seeded storm is bit-reproducible regardless of `after`.
+    rule.calls += 1
+    if rule.calls <= rule.after:
+        return None
+    percent = rule.percent
+    if rule.ramp:
+        armed = rule.calls - rule.after
+        percent *= min(1.0, armed / rule.ramp)
+    if _state.rng.uniform(0, 100) >= percent:
+        return None
+    if rule.budget is not None:
+        rule.budget -= 1
+    return rule.kind, rule.delay_ms
+
+
 def maybe_inject(op_name: str) -> None:
     """Called by op_boundary before dispatch; raises the configured
-    fault, sleeps (``delay`` kind), or returns. Cheap when disabled
-    (one attribute read)."""
+    fault, sleeps (``delay`` kind), SIGKILLs the process (``crash``
+    kind), or returns. Cheap when disabled (one attribute read).
+    ``corrupt`` rules are inert here — they fire through
+    ``maybe_corrupt`` at the payload producer."""
     if not _state.enabled:
         return
     with _state.lock:
-        _reload_if_changed()
-        rule = _state.rules.get(op_name) or _state.rules.get("*")
-        if rule is None:
+        hit = _draw_locked(op_name, corrupt=False)
+        if hit is None:
             return
-        if rule.budget is not None and rule.budget <= 0:
-            return
-        # scheduling: count every matching dispatch; hold fire for the
-        # first `after`, then ramp the effective percent over `ramp`
-        # armed dispatches. The RNG draw happens only once armed, so a
-        # seeded storm is bit-reproducible regardless of `after`.
-        rule.calls += 1
-        if rule.calls <= rule.after:
-            return
-        percent = rule.percent
-        if rule.ramp:
-            armed = rule.calls - rule.after
-            percent *= min(1.0, armed / rule.ramp)
-        if _state.rng.uniform(0, 100) >= percent:
-            return
-        if rule.budget is not None:
-            rule.budget -= 1
-        kind = rule.kind
-        delay_ms = rule.delay_ms
+        kind, delay_ms = hit
+    if kind == "crash":
+        # the kill-9 mid-op chaos (ISSUE 5): the request was consumed,
+        # no response will ever be written, the peer sees a dead
+        # transport. SIGKILL self — no atexit, no flush, no cleanup —
+        # exactly the failure the pool's failover must survive.
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
     if kind == "fatal":
         raise FatalDeviceError(f"injected fatal fault in {op_name}")
     if kind == "retryable":
@@ -240,6 +282,33 @@ def _hang(op_name: str, delay_ms: float) -> None:
             # wake just past the deadline edge, not a poll interval late
             step = min(step, max(d.remaining(), 0.0) + 0.005)
         time.sleep(min(step, 0.05))
+
+
+def maybe_corrupt(op_name: str, data: bytes) -> bytes:
+    """Chaos hook for payload producers (the sidecar worker crosses it
+    on every response, keyed ``sidecar.worker.<OP>``): when a matched
+    ``corrupt`` rule fires, return a byte-flipped COPY of ``data`` —
+    the producer computes its CRC over the original first, so the
+    corruption models the transport flipping bits after checksumming
+    and the integrity layer MUST catch it. Honors the same
+    `after`/`ramp`/budget scheduling as every other kind. Returns
+    ``data`` unchanged when disabled, unmatched, or empty."""
+    if not _state.enabled or not data:
+        return data
+    with _state.lock:
+        hit = _draw_locked(op_name, corrupt=True)
+        if hit is None:
+            return data
+        # up to 8 contiguous bytes XOR 0xFF at a seeded offset: enough
+        # to defeat any checksum, deterministic under the profile seed
+        off = _state.rng.randrange(len(data))
+    buf = bytearray(data)
+    for i in range(off, min(off + 8, len(buf))):
+        buf[i] ^= 0xFF
+    from . import metrics
+
+    metrics.event("faultinj.corrupt", op=op_name, offset=off, nbytes=len(data))
+    return bytes(buf)
 
 
 # env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH.

@@ -1,0 +1,200 @@
+"""End-to-end data integrity: CRC-checked frames and spills (ISSUE 5).
+
+Thallus (PAPERS.md) checksums its columnar transport frames because on
+a data path, silent corruption is indistinguishable from a wrong
+answer: a truncated-then-resynced socket frame or a bit-rotted spill
+file returns plausible rows, not an error. Until this module the stack
+had zero checksums anywhere. It now provides the ONE helper every
+data-at-rest / data-in-flight boundary shares:
+
+- sidecar wire frames (sidecar.py): request and response payloads in
+  BOTH directions carry a 4-byte CRC trailer right after the 12-byte
+  header (the ``CRC_FLAG`` high bit of op/status marks its presence,
+  negotiated per frame so the native C++ client — which never sets the
+  bit — keeps its existing framing),
+- memgov disk spills (memgov/catalog.py): every spill file is written
+  as a framed container (magic + CRC + length + npz payload) and
+  verified on re-materialization,
+- shuffle exchanges (parallel/shuffle.py): an order-independent
+  payload checksum over the bytes entering and leaving the all-to-all
+  (row order changes across the exchange, byte MULTISET must not).
+
+A mismatch anywhere raises ``DataCorruption`` (utils/errors.py) — a
+RETRYABLE taxonomy member, so the retry/split machinery re-fetches or
+re-executes instead of returning wrong data — and lands registry-direct
+under ``sidecar.integrity.*`` (``crc_mismatch`` total plus a
+per-surface ``crc_mismatch.<where>`` breakdown; the durable-counter
+contract: corruption is a rare recovery event, never gated off).
+
+Checksum algorithm: CRC-32C (Castagnoli) via the optional ``crc32c``
+accelerator module when importable, else zlib's C-speed CRC-32. The
+polynomial choice is process-local and symmetric — every producer and
+consumer (sidecar worker child processes included: they inherit the
+same interpreter/env) resolves the same implementation through this
+helper, so the two ends of a frame always agree. The trailer carries
+no algorithm id; a deployment must not mix interpreters with and
+without the accelerator across the sidecar boundary (PACKAGING.md
+knob table).
+
+Environment:
+
+    SRJT_INTEGRITY_CHECKS  "0"/"false" disables every check (frames go
+                           out without trailers, spills skip verify,
+                           exchanges skip the payload checksum — the
+                           seed posture, no extra syscalls or hashing
+                           anywhere). Default: enabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import struct
+import zlib
+
+from .errors import DataCorruption
+
+__all__ = [
+    "checksum",
+    "checksum_name",
+    "verify",
+    "raise_corruption",
+    "enable",
+    "disable",
+    "is_enabled",
+    "enabled",
+    "disabled",
+    "CRC_LEN",
+    "pack_crc",
+    "unpack_crc",
+]
+
+CRC_LEN = 4  # the trailer is one little-endian u32, whatever the impl
+
+try:  # optional accelerator: real CRC-32C when the wheel is present
+    import crc32c as _crc32c_mod
+
+    def _crc(data, value: int = 0) -> int:
+        return _crc32c_mod.crc32c(data, value)
+
+    _CRC_NAME = "crc32c"
+except ImportError:  # zlib's C implementation: same 32-bit contract
+
+    def _crc(data, value: int = 0) -> int:
+        return zlib.crc32(data, value)
+
+    _CRC_NAME = "crc32-zlib"
+
+
+def checksum(data, value: int = 0) -> int:
+    """32-bit CRC of ``data`` (bytes-like); chainable via ``value``."""
+    return _crc(data, value) & 0xFFFFFFFF
+
+
+def checksum_name() -> str:
+    """Which implementation this process resolved (observability)."""
+    return _CRC_NAME
+
+
+def pack_crc(crc: int) -> bytes:
+    return struct.pack("<I", crc & 0xFFFFFFFF)
+
+
+def unpack_crc(raw: bytes, offset: int = 0) -> int:
+    return struct.unpack_from("<I", raw, offset)[0]
+
+
+# ---------------------------------------------------------------------------
+# enable gate (one boolean read on every guarded path)
+# ---------------------------------------------------------------------------
+
+_enabled = os.environ.get("SRJT_INTEGRITY_CHECKS", "").lower() not in (
+    "0",
+    "false",
+    "no",
+)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def enabled():
+    global _enabled
+    prev = _enabled
+    _enabled = True
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+@contextlib.contextmanager
+def disabled():
+    global _enabled
+    prev = _enabled
+    _enabled = False
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# verification + the corruption accounting every surface shares
+# ---------------------------------------------------------------------------
+
+
+def raise_corruption(where: str, detail: str = "") -> "DataCorruption":
+    """Count a CRC mismatch (total + per-surface) and return the
+    DataCorruption to raise — callers ``raise raise_corruption(...)``
+    so the counter can never drift from the error. The message carries
+    the taxonomy prefix the sidecar wire protocol re-classifies on."""
+    from . import metrics
+
+    reg = metrics.registry()
+    reg.counter("sidecar.integrity.crc_mismatch").inc()
+    reg.counter(f"sidecar.integrity.crc_mismatch.{where}").inc()
+    metrics.event("integrity.crc_mismatch", where=where, detail=detail)
+    return DataCorruption(
+        f"CRC mismatch in {where}{f' ({detail})' if detail else ''} — "
+        "payload corrupted in flight or at rest; re-fetch required"
+    )
+
+
+def verify(data, expected_crc: int, where: str) -> None:
+    """Check ``data`` against the expected 32-bit CRC; mismatch counts
+    and raises DataCorruption. No-op while the gate is off."""
+    if not _enabled:
+        return
+    got = checksum(data)
+    if got != (expected_crc & 0xFFFFFFFF):
+        raise raise_corruption(
+            where, f"expected 0x{expected_crc & 0xFFFFFFFF:08x}, got 0x{got:08x}"
+        )
+
+
+def stats_section() -> dict:
+    """The ``integrity`` section of runtime.stats_report()."""
+    from . import metrics
+
+    reg = metrics.registry()
+    return {
+        "enabled": _enabled,
+        "algorithm": _CRC_NAME,
+        "crc_mismatch": reg.value("sidecar.integrity.crc_mismatch"),
+        "frames_checked": reg.value("sidecar.integrity.frames_checked"),
+        "spills_checked": reg.value("sidecar.integrity.spills_checked"),
+        "exchanges_checked": reg.value("sidecar.integrity.exchanges_checked"),
+    }

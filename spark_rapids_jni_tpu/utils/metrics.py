@@ -483,18 +483,18 @@ def snapshot() -> dict:
     return _REGISTRY.snapshot()
 
 
-def fold_worker_counters(counters: Optional[dict]) -> None:
+def fold_worker_counters(counters: Optional[dict], prefix: str = "sidecar.worker.") -> None:
     """Fold a sidecar WORKER's counter snapshot (the STATS verb's
     ``snapshot.counters`` map) into this process's registry under
-    ``sidecar.worker.*`` — as GAUGES, because a remote snapshot is
+    ``prefix`` — as GAUGES, because a remote snapshot is
     last-write-wins and folding increments would double-count on every
-    poll. Shared by SupervisedClient.worker_stats (Python client) and
-    runtime.device_stats (native client) so the fold policy cannot
-    diverge between the two paths."""
+    poll. Shared by SupervisedClient.worker_stats (Python client),
+    runtime.device_stats (native client), and the worker pool
+    (sidecar_pool.py, which keys PER WORKER: ``sidecar.worker.w<id>.*``)
+    so the fold policy cannot diverge between the paths."""
     for name, value in (counters or {}).items():
         _REGISTRY.gauge(
-            name if name.startswith("sidecar.worker.")
-            else f"sidecar.worker.{name}"
+            name if name.startswith(prefix) else f"{prefix}{name}"
         ).set(value)
 
 
@@ -551,6 +551,19 @@ def stage_report(stage: str) -> dict:
             "state": _REGISTRY.value("sidecar.breaker.state"),
             "opened": _REGISTRY.value("sidecar.breaker.opened_total"),
             "fast_fails": _REGISTRY.value("sidecar.breaker.fast_fails_total"),
+        },
+        # ISSUE 5 crash-tolerance counters: pool failovers/respawns and
+        # the integrity layer's caught-corruption tally — the crash-storm
+        # artifacts assert on exactly these
+        "pool": {
+            "live": _REGISTRY.value("sidecar.pool.live"),
+            "failovers": _REGISTRY.value("sidecar.pool.failovers"),
+            "respawns": _REGISTRY.value("sidecar.pool.respawns"),
+            "rehydrations": _REGISTRY.value("sidecar.pool.rehydrations"),
+        },
+        "integrity": {
+            "crc_mismatch": _REGISTRY.value("sidecar.integrity.crc_mismatch"),
+            "frames_checked": _REGISTRY.value("sidecar.integrity.frames_checked"),
         },
     }
 

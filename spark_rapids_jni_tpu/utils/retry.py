@@ -5,9 +5,16 @@ analog for the TPU tier).
 The error taxonomy (utils/errors.py) splits device failures into
 ``FatalDeviceError`` (executor must be replaced — NEVER retried here)
 and ``RetryableError`` (transient — Spark task-retry semantics re-run
-the batch). The seed classified but never recovered: a RetryableError
-propagated straight to the caller and killed the query. This module
-closes that loop with three strategies:
+the batch). ``DataCorruption`` (ISSUE 5, utils/integrity.py) is a
+RetryableError subclass with re-FETCH semantics: a CRC-rejected wire
+frame, spill file, or shuffle exchange re-runs here like any transient
+fault — the re-execution reads fresh bytes, which is exactly the
+productive recovery (and its retries are visible as their own class:
+``retry.retries.DataCorruption``). Splitting never engages for
+corruption — halving a batch cannot fix a rotten copy — only for the
+RESOURCE_EXHAUSTED class below. The seed classified but never
+recovered: a RetryableError propagated straight to the caller and
+killed the query. This module closes that loop with three strategies:
 
 1. **Bounded retry + exponential backoff + jitter**
    (``call_with_retry``): re-run the failed operation up to

@@ -475,6 +475,12 @@ class _FakeWorker:
             while True:
                 hdr = sidecar._recv_exact(conn, 12)
                 op, plen = struct.unpack("<IQ", hdr)
+                if op & sidecar.CRC_FLAG:
+                    # integrity-framed request (ISSUE 5): consume the
+                    # 4-byte trailer to stay framed; replying without
+                    # the flag is the legacy-peer posture
+                    sidecar._recv_exact(conn, 4)
+                    op &= ~sidecar.CRC_FLAG
                 if plen:
                     sidecar._recv_exact(conn, plen)
                 if self.wedge:
