@@ -1,0 +1,246 @@
+"""Data-plane benchmark: slab-arena pool scaling + 2-process exchange (ISSUE 6).
+
+Two stages, each emitting BENCH rows (JSON lines, the bench.py /
+microbench.py discipline; ``SRJT_RESULTS`` appends them to a file):
+
+- **pool**: arena-resident op throughput at pool sizes 1/2/4. Each
+  worker is a REAL spawned sidecar process with a fixed worker-side
+  op delay armed through faultinj (``--delay-ms``, default 10 — the
+  stand-in for device-op latency, so the measurement is transport
+  concurrency, not host CPU count). Client threads hammer
+  ``SidecarPool.call_arena`` concurrently; ops/s scales with pool size
+  exactly when per-request regions let arena ops overlap. Under the
+  PR 5 single-buffer arena this was ~1.0x by construction (one
+  ``_arena_io_lock`` serialized every worker); the premerge gate
+  asserts pool 2 >= 1.5x pool 1 from these rows.
+- **exchange**: 2-process distributed hash-partition exchange MB/s —
+  rank 0 here, rank 1 a spawned ``parallel.shuffle --exchange-worker``
+  peer, partitions crossing TCP as versioned columnar frames under
+  retry + CRC. Bytes counted at the sockets this process touches
+  (``shuffle.tcp.bytes_in/out``), and the distributed groupby result
+  is verified bit-identical to the single-process oracle before the
+  row is emitted.
+
+Usage::
+
+    python benchmarks/bench_pool.py                     # both stages
+    python benchmarks/bench_pool.py --sizes 1,2 --ops 40 --delay-ms 20
+    python benchmarks/bench_pool.py --stage exchange --exchange-rows 500000
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("SRJT_METRICS_ENABLED", "1")  # byte counters feed the rows
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from spark_rapids_jni_tpu import sidecar, sidecar_pool
+from spark_rapids_jni_tpu.ops.copying import concatenate, slice_table
+from spark_rapids_jni_tpu.parallel import shuffle
+from spark_rapids_jni_tpu.utils import metrics, retry
+
+import struct
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+    out_path = os.environ.get("SRJT_RESULTS")
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _counter(name: str) -> int:
+    return metrics.registry().value(name)
+
+
+def _groupby_payload(n: int = 600, k: int = 16, seed: int = 3) -> bytes:
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, k, n).astype(np.int64)
+    vals = rng.standard_normal(n).astype(np.float32)
+    return struct.pack("<IQ", k, n) + keys.tobytes() + vals.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# stage 1: pool scaling on arena-resident ops
+# ---------------------------------------------------------------------------
+
+
+def bench_pool_sizes(sizes, ops: int, threads: int, delay_ms: int,
+                     startup_timeout_s: float) -> dict:
+    """ops/s of ``call_arena(GROUPBY_SUM_F32)`` per pool size; returns
+    {size: ops_per_s}. The worker-side ``delay`` fault (percent 100,
+    unbounded) puts a fixed latency floor under every op, so overlap —
+    not host parallelism — is what the ratio measures."""
+    fd, cfg_path = tempfile.mkstemp(prefix="srjt-bench-delay-", suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump({"faults": {"sidecar.worker.GROUPBY_SUM_F32": {
+            "type": "delay", "percent": 100, "delayMs": int(delay_ms)}}}, f)
+    payload = _groupby_payload()
+    want = sidecar._dispatch(sidecar.OP_GROUPBY_SUM_F32, payload, "cpu")
+    results: dict = {}
+    try:
+        for size in sizes:
+            pool = sidecar_pool.SidecarPool(
+                size=size, deadline_s=60, heartbeat_s=1e9,
+                startup_timeout_s=startup_timeout_s,
+                env={"SRJT_FAULTINJ_CONFIG": cfg_path},
+            )
+            try:
+                # warm: slab creation + one arena round-trip per worker
+                # (round-robin), correctness checked against the host
+                with retry.enabled(max_attempts=6, base_delay_ms=1):
+                    for _ in range(size):
+                        assert pool.call_arena(
+                            sidecar.OP_GROUPBY_SUM_F32, payload
+                        ) == want, "pool warmup diverged from host oracle"
+                tickets = itertools.count()
+                errs: list = []
+
+                def hammer():
+                    try:
+                        with retry.enabled(max_attempts=6, base_delay_ms=1):
+                            while next(tickets) < ops:
+                                pool.call_arena(
+                                    sidecar.OP_GROUPBY_SUM_F32, payload
+                                )
+                    except Exception as e:  # surfaced after join
+                        errs.append(e)
+
+                ts = [threading.Thread(target=hammer) for _ in range(threads)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                secs = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+            finally:
+                pool.shutdown()
+            results[size] = ops / secs
+            _emit({
+                "metric": "pool_arena_ops_per_s",
+                "pool_size": size,
+                "value": round(ops / secs, 2),
+                "unit": "ops/s",
+                "ops": ops,
+                "threads": threads,
+                "delay_ms": delay_ms,
+                "secs": round(secs, 4),
+                "vs_pool1": round(results[size] / results[sizes[0]], 3)
+                if sizes[0] in results else None,
+            })
+    finally:
+        os.unlink(cfg_path)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# stage 2: 2-process TCP exchange MB/s
+# ---------------------------------------------------------------------------
+
+def _spawn_peer(parent_addr: str, rows: int, seed: int):
+    return shuffle.spawn_exchange_peer(parent_addr, rows, seed)
+
+
+def bench_exchange(rows: int, seed: int = 13) -> float:
+    """Time one full 2-process exchange round (partition both ways +
+    result fetch), verify the distributed groupby bit-identical to the
+    single-process oracle, and report MB/s over the bytes this process
+    moved through its sockets."""
+    full = shuffle._demo_table(rows, seed=seed)
+    ref = shuffle._local_groupby_sum(full)
+    lo, hi = shuffle._shard_bounds(rows, 2, 0)
+    shard0 = slice_table(full, lo, hi)
+
+    shuffle.hash_partition(shard0, 2, ["k"])  # compile excluded (bench discipline)
+    ex0 = shuffle.TcpExchange(0)
+    proc = None
+    try:
+        proc, peer_addr = _spawn_peer(ex0.address, rows, seed)
+        b0 = _counter("shuffle.tcp.bytes_in") + _counter("shuffle.tcp.bytes_out")
+        t0 = time.perf_counter()
+        with retry.enabled(max_attempts=40, base_delay_ms=25, max_delay_ms=250):
+            local0 = ex0.exchange_table(shard0, ["k"], {1: peer_addr}, epoch=0)
+            res0 = shuffle._local_groupby_sum(local0)
+            res1 = ex0.fetch(peer_addr, 1, 1)
+        secs = time.perf_counter() - t0
+        moved = (
+            _counter("shuffle.tcp.bytes_in")
+            + _counter("shuffle.tcp.bytes_out")
+            - b0
+        )
+        got = concatenate(
+            [res0, shuffle.Table(res1.columns, ["k", "s", "c"])]
+        )
+        order = np.argsort(np.asarray(got.column("k").data))
+        for name in ("k", "s", "c"):
+            assert np.array_equal(
+                np.asarray(got.column(name).data)[order],
+                np.asarray(ref.column(name).data),
+            ), f"distributed groupby diverged from single-process ({name})"
+    finally:
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+        ex0.close()
+    mbps = moved / secs / 1e6
+    _emit({
+        "metric": "exchange_2proc_mb_per_s",
+        "value": round(mbps, 2),
+        "unit": "MB/s",
+        "rows": rows,
+        "bytes_moved": moved,
+        "secs": round(secs, 4),
+        "bit_identical": True,
+    })
+    return mbps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--stage", choices=["pool", "exchange", "all"], default="all")
+    ap.add_argument("--sizes", default="1,2,4",
+                    help="comma-separated pool sizes (default 1,2,4)")
+    ap.add_argument("--ops", type=int, default=60,
+                    help="arena ops per pool size (default 60)")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--delay-ms", type=int, default=10,
+                    help="worker-side per-op latency floor (default 10)")
+    ap.add_argument("--startup-timeout", type=float, default=180.0)
+    ap.add_argument("--exchange-rows", type=int, default=250_000)
+    args = ap.parse_args()
+
+    if args.stage in ("pool", "all"):
+        sizes = [int(s) for s in args.sizes.split(",") if s]
+        res = bench_pool_sizes(
+            sizes, args.ops, args.threads, args.delay_ms, args.startup_timeout
+        )
+        _emit({
+            "metric": "pool_arena_scaling",
+            "value": {str(s): round(res[s] / res[sizes[0]], 3) for s in sizes},
+            "unit": "x vs pool 1",
+            "delay_ms": args.delay_ms,
+        })
+    if args.stage in ("exchange", "all"):
+        bench_exchange(args.exchange_rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

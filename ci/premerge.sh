@@ -127,6 +127,58 @@ print(f"archived {len(lines)} crash events ({deaths} deaths, "
       "-> artifacts/crash_metrics.jsonl")
 EOF
 
+# data-plane tier (ISSUE 6): the slab-arena / frame-codec / TCP-exchange
+# suite env-armed (retry + metrics + event log) under a hard timeout.
+# The two-REAL-process acceptance inside arms ci/chaos_crash.json's
+# exchange keys in the peer: one kill -9 mid-serve and one frame
+# corruption, final distributed groupby bit-identical. The archived
+# event log must PROVE the storm fired — a caught frame corruption
+# (integrity.crc_mismatch) and a peer respawn are the artifact
+# contract. The session-scoped slab-leak assertion in tests/conftest.py
+# rides every pytest invocation in this file.
+rm -f artifacts/data_plane_metrics.jsonl
+timeout -k 10 900 env SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/data_plane_metrics.jsonl \
+  python -m pytest tests/test_data_plane.py -q
+python - <<'EOF'
+import json
+lines = [json.loads(s) for s in open("artifacts/data_plane_metrics.jsonl")]
+assert lines, "data-plane tier produced no events"
+kinds = {r["event"] for r in lines}
+assert "integrity.crc_mismatch" in kinds, "no frame corruption caught"
+assert "exchange.peer_respawn" in kinds, "no peer crash/respawn recorded"
+print(f"archived {len(lines)} data-plane events -> "
+      "artifacts/data_plane_metrics.jsonl")
+EOF
+
+# pool-scaling gate (ISSUE 6 acceptance): arena-resident ops/s at pool
+# size 2 must be >= 1.5x pool size 1 on the bench_pool workload (REAL
+# spawned workers, 20 ms worker-side latency floor, 8 client threads).
+# Under the PR 5 single-buffer arena this ratio was ~1.0 by
+# construction; the per-request slab regions are what buy the overlap.
+# The 2-process exchange MB/s row rides along and must verify the
+# distributed groupby bit-identical before it is emitted.
+rm -f artifacts/bench_pool.jsonl
+timeout -k 10 600 env SRJT_RESULTS=artifacts/bench_pool.jsonl \
+  python benchmarks/bench_pool.py --sizes 1,2 --ops 40 --threads 8 \
+  --delay-ms 20 --exchange-rows 150000
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/bench_pool.jsonl")]
+pool = {r["pool_size"]: r["value"] for r in rows
+        if r.get("metric") == "pool_arena_ops_per_s"}
+assert 1 in pool and 2 in pool, f"missing pool sizes in BENCH rows: {pool}"
+ratio = pool[2] / pool[1]
+assert ratio >= 1.5, (
+    f"pool 2 scaling {ratio:.2f}x < 1.5x over pool 1 "
+    f"({pool[2]:.1f} vs {pool[1]:.1f} ops/s): arena ops serialized?")
+exch = [r for r in rows if r.get("metric") == "exchange_2proc_mb_per_s"]
+assert exch and exch[0].get("bit_identical"), "no verified exchange BENCH row"
+print(f"pool scaling {ratio:.2f}x (1={pool[1]:.1f}, 2={pool[2]:.1f} ops/s), "
+      f"exchange {exch[0]['value']} MB/s -> artifacts/bench_pool.jsonl")
+EOF
+
 # (the disabled-mode overhead guard —
 # tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
 # tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the

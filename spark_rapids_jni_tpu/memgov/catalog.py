@@ -349,32 +349,26 @@ class BufferCatalog:
                 metrics.event("memgov.spill_failed", key=h.key, tier=TIER_DISK)
 
     def _demote_disk_locked(self, h: SpillableHandle) -> None:
-        """host -> disk: one CRC-framed .npz container per entry under
-        SRJT_SPILL_DIR (utils/integrity.py: magic + u32 CRC + u64 len +
-        npz payload, verified on re-materialization — a bit-rotted or
+        """host -> disk: one versioned columnar FRAME per entry under
+        SRJT_SPILL_DIR (columnar/frames.py: magic + schema header +
+        per-leaf CRC, verified on re-materialization — a bit-rotted or
         truncated spill surfaces as retryable DataCorruption, never as
-        wrong rows)."""
-        import io
-
-        from ..utils import integrity, metrics
+        wrong rows). The same codec the sidecar wire and the TCP
+        exchange emit (ISSUE 6); with integrity checks off the frame is
+        written unchecked (flags clear, no hashing anywhere). Legacy
+        spill containers (SRJTSPL1 envelope, plain npz) written before
+        this layout still load — see ``_load_disk_locked``."""
+        from ..columnar import frames
+        from ..utils import metrics
 
         reg = _registry()
         t0 = time.perf_counter()
         safe = re.sub(r"[^A-Za-z0-9_.-]", "_", h.key)
         path = os.path.join(
-            self._resolve_spill_dir(), f"{safe}-{h._seq}.npz"
+            self._resolve_spill_dir(), f"{safe}-{h._seq}.frm"
         )
-        buf = io.BytesIO()
-        np.savez(buf, **{f"a{i}": leaf for i, leaf in enumerate(h._host)})
-        blob = buf.getvalue()
         with open(path, "wb") as f:
-            if integrity.is_enabled():
-                f.write(_SPILL_MAGIC)
-                f.write(integrity.pack_crc(integrity.checksum(blob)))
-                f.write(len(blob).to_bytes(8, "little"))
-            # integrity off: plain npz, no hashing anywhere (the loader
-            # accepts both forms, so toggling mid-life stays safe)
-            f.write(blob)
+            f.write(frames.encode_leaves(h._host))
         h._disk_path = path
         h._host = None
         reg.counter("memgov.disk_spills").inc()
@@ -446,39 +440,60 @@ class BufferCatalog:
     # -- access / re-materialization -----------------------------------------
 
     def _load_disk_locked(self, h: SpillableHandle) -> None:
-        """disk -> host half of re-materialization: parse the CRC-framed
-        container and VERIFY before trusting a byte (ISSUE 5). A
-        mismatch — bit rot, truncation, a torn write — closes the entry
-        (the only copy is bad; keeping it would serve the corruption
-        again) and raises retryable ``DataCorruption`` so the caller's
+        """disk -> host half of re-materialization: decode the columnar
+        frame and VERIFY before trusting a byte (ISSUE 5/6). A mismatch
+        — bit rot, truncation, a torn write — closes the entry (the
+        only copy is bad; keeping it would serve the corruption again)
+        and raises retryable ``DataCorruption`` so the caller's
         retry/split machinery re-computes from source instead of
-        returning wrong rows. Legacy unframed .npz files (pre-integrity
-        spills) still load, unverified."""
+        returning wrong rows. Migration (ISSUE 6 satellite): spill
+        containers written before the frame layout — the SRJTSPL1
+        CRC-envelope around npz, and plain unframed npz — still load
+        through their original paths, so a process upgrade never
+        strands a spill."""
         import io
 
+        from ..columnar import frames
         from ..utils import integrity, metrics
 
         path = h._disk_path
         try:
             with open(path, "rb") as f:
                 raw = f.read()
-            if raw[: len(_SPILL_MAGIC)] == _SPILL_MAGIC:
-                crc = integrity.unpack_crc(raw, len(_SPILL_MAGIC))
-                blen = int.from_bytes(
-                    raw[len(_SPILL_MAGIC) + 4 : len(_SPILL_MAGIC) + 12], "little"
-                )
-                blob = raw[len(_SPILL_MAGIC) + 12 :]
-                if integrity.is_enabled():
+            if frames.is_frame(raw):
+                # count a CHECKED re-materialization only when the
+                # frame carries CRCs AND checks are armed — a frame
+                # written under SRJT_INTEGRITY_CHECKS=0 decodes
+                # unverified even if checks were re-enabled since
+                if integrity.is_enabled() and frames.is_checked(raw):
                     _registry().counter("sidecar.integrity.spills_checked").inc()
-                    if len(blob) != blen:
-                        raise integrity.raise_corruption(
-                            "memgov.spill", f"{h.key}: truncated ({len(blob)} != {blen})"
-                        )
-                    integrity.verify(blob, crc, "memgov.spill")
+                # per-leaf CRCs verified inside the codec (when armed);
+                # a tampered leaf raises DataCorruption counted under
+                # memgov.spill like the legacy envelope did
+                h._host = frames.decode_leaves(raw, where="memgov.spill")
+                if len(h._host) != h._n_leaves:
+                    raise integrity.raise_corruption(
+                        "memgov.spill",
+                        f"{h.key}: leaf count {len(h._host)} != {h._n_leaves}",
+                    )
             else:
-                blob = raw  # pre-integrity spill file: no trailer to check
-            with np.load(io.BytesIO(blob)) as z:
-                h._host = [z[f"a{i}"] for i in range(h._n_leaves)]
+                if raw[: len(_SPILL_MAGIC)] == _SPILL_MAGIC:
+                    crc = integrity.unpack_crc(raw, len(_SPILL_MAGIC))
+                    blen = int.from_bytes(
+                        raw[len(_SPILL_MAGIC) + 4 : len(_SPILL_MAGIC) + 12], "little"
+                    )
+                    blob = raw[len(_SPILL_MAGIC) + 12 :]
+                    if integrity.is_enabled():
+                        _registry().counter("sidecar.integrity.spills_checked").inc()
+                        if len(blob) != blen:
+                            raise integrity.raise_corruption(
+                                "memgov.spill", f"{h.key}: truncated ({len(blob)} != {blen})"
+                            )
+                        integrity.verify(blob, crc, "memgov.spill")
+                else:
+                    blob = raw  # pre-integrity spill file: no trailer to check
+                with np.load(io.BytesIO(blob)) as z:
+                    h._host = [z[f"a{i}"] for i in range(h._n_leaves)]
         except Exception as e:
             # corrupt (DataCorruption) or unreadable (zipfile/KeyError/
             # OSError — the same disease without a checksum to name it):

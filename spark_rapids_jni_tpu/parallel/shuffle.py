@@ -37,12 +37,36 @@ occupied-slot count against the rows sent. A mismatch raises retryable
 ``DataCorruption`` (op_boundary's armed retry re-executes the
 exchange), counted under ``sidecar.integrity.crc_mismatch`` — the
 Thallus posture: transport corruption must be an error, never rows.
+
+Cross-process TCP exchange (ISSUE 6): the in-mesh collective above
+remains the fast path WITHIN one runtime; ``TcpExchange`` adds the
+cross-PROCESS mode — two single-host runtimes exchanging hash
+partitions as versioned columnar frames (columnar/frames.py, the same
+codec sidecar wire payloads and memgov spills use) over plain TCP
+sockets. Pull-based: each peer serves its published partitions, so the
+deadline/retry/breaker/CRC machinery rides the FETCH side unchanged —
+a tampered frame decodes to retryable ``DataCorruption`` and the retry
+re-fetches; a crashed peer is a connection fault the retry outlives
+(supervisors respawn peers; published partitions are recomputed
+deterministically). ``SRJT_EXCHANGE_MODE`` (default ``mesh``) is the
+transport selector for callers that host a cross-process rank — the
+exchange-worker harness and benchmarks consult ``exchange_mode()``;
+the in-library collectives (``exchange_by_key`` etc.) always use the
+mesh and ignore it. Peers are addressed ``rank=host:port``. The
+two-process
+harness behind ``python -m spark_rapids_jni_tpu.parallel.shuffle
+--exchange-worker`` drives the distributed-groupby acceptance test and
+``benchmarks/bench_pool.py``'s exchange MB/s row.
 """
 
 from __future__ import annotations
 
+import os
+import socket as socket_mod
+import struct
+import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +81,15 @@ from ..ops.copying import gather
 from ..utils.dispatch import op_boundary
 from ._smcache import cached_sm, shard_map
 
-__all__ = ["hash_partition", "all_to_all_exchange", "exchange_by_key"]
+__all__ = [
+    "hash_partition",
+    "all_to_all_exchange",
+    "exchange_by_key",
+    "exchange_mode",
+    "TcpExchange",
+    "exchange_breaker",
+    "spawn_exchange_peer",
+]
 
 
 @op_boundary("hash_partition")
@@ -353,3 +385,642 @@ def exchange_by_key(
         data = next(it)
         pairs.append((data, next(it) if nullable else None))
     return pairs, recv_mask, overflow
+
+
+# ---------------------------------------------------------------------------
+# cross-process TCP exchange (ISSUE 6): hash partitions as columnar
+# frames between two single-host runtimes, pull-based so deadline +
+# retry + breaker + CRC ride the fetch side unchanged
+# ---------------------------------------------------------------------------
+
+_EXC_MAGIC = b"SRJTEXC1"
+_EXC_REQ = struct.Struct("<8sIII")  # magic, verb, epoch, part
+_EXC_RESP = struct.Struct("<IQ")  # status, payload length
+_EXC_GET = 1
+_EXC_OK = 0
+_EXC_RETRY = 1  # partition not (yet) published here: retryable
+_EXC_ERR = 2
+
+
+def exchange_mode() -> str:
+    """``SRJT_EXCHANGE_MODE``: ``mesh`` (default — the in-process
+    ``lax.all_to_all`` fast path) or ``tcp`` (cross-process
+    ``TcpExchange`` framing). Consulted by callers that choose a
+    transport — the ``--exchange-worker`` harness and benchmarks; the
+    in-library mesh collectives always use the collective and do not
+    read this knob."""
+    mode = os.environ.get("SRJT_EXCHANGE_MODE", "mesh").lower()
+    if mode not in ("mesh", "tcp"):
+        import warnings
+
+        warnings.warn(f"shuffle: unknown SRJT_EXCHANGE_MODE={mode!r}; using mesh")
+        return "mesh"
+    return mode
+
+
+_EXC_BREAKER = None
+_EXC_BREAKER_LOCK = threading.Lock()
+
+
+def exchange_breaker():
+    """Process-global breaker for the TCP exchange path (mirrors
+    sidecar.breaker()): consecutive fetch failures open it and further
+    fetches fast-fail retryably without paying a dial; a half-open
+    probe after the cooldown restores the path. States land under
+    ``shuffle.exchange.breaker.*``."""
+    global _EXC_BREAKER
+    if _EXC_BREAKER is None:
+        with _EXC_BREAKER_LOCK:
+            if _EXC_BREAKER is None:
+                from ..utils.deadline import CircuitBreaker
+
+                _EXC_BREAKER = CircuitBreaker("shuffle.exchange.breaker")
+    return _EXC_BREAKER
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _recv_exact_tcp(sock, n: int, deadline: float) -> bytes:
+    """Read exactly n bytes under a whole-request deadline (the
+    SupervisedClient._recv_deadline discipline: the socket timeout
+    shrinks to the remaining budget each iteration)."""
+    buf = bytearray()
+    while len(buf) < n:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket_mod.timeout("exchange deadline exhausted")
+        sock.settimeout(remaining)
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("exchange: peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpExchange:
+    """One runtime's end of the cross-process exchange: a server that
+    publishes this rank's outgoing partitions (encoded once as
+    columnar frames) and a fetch client that pulls this rank's
+    incoming partitions from peers under deadline + retry + breaker +
+    CRC.
+
+    Keys are ``(epoch, part)`` — an epoch is one exchange round (query
+    stage), ``part`` the destination rank. A fetch for a partition not
+    yet published parks on a condition server-side (bounded) and then
+    answers retryably, so peer startup races cost latency, never
+    wrong answers. Chaos hooks: each served request crosses
+    ``faultinj.maybe_inject("exchange.serve")`` (``crash``/``delay``
+    kinds) and each response frame crosses
+    ``faultinj.maybe_corrupt("exchange.frame", ...)`` AFTER encoding —
+    exactly like a transport flipping bits under the CRC, which the
+    decoder must catch."""
+
+    def __init__(self, rank: int, bind: str = "127.0.0.1:0",
+                 deadline_s: Optional[float] = None,
+                 publish_wait_s: float = 10.0,
+                 retain_epochs: Optional[int] = None):
+        from ..utils.retry import env_float
+
+        self.rank = int(rank)
+        if deadline_s is None:
+            deadline_s = env_float(
+                os.environ, "SRJT_EXCHANGE_TIMEOUT_SEC", 30.0, positive=True
+            )
+        self.deadline_s = float(deadline_s)
+        self.publish_wait_s = float(publish_wait_s)
+        if retain_epochs is None:
+            try:
+                retain_epochs = int(
+                    os.environ.get("SRJT_EXCHANGE_RETAIN_EPOCHS", "4")
+                )
+            except ValueError:
+                retain_epochs = 4
+        # publish() evicts everything older than the newest
+        # `retain_epochs` distinct epochs: a long-lived runtime doing
+        # one exchange round per query stage must not accumulate every
+        # encoded partition forever, while a crashed peer's
+        # respawn-republish window (the previous few epochs) stays
+        # servable
+        self.retain_epochs = max(int(retain_epochs), 1)
+        self._frames: Dict[Tuple[int, int], bytes] = {}
+        self._lock = threading.Lock()
+        self._published = threading.Condition(self._lock)
+        self._closed = False
+        host, port = _parse_addr(bind)
+        self._srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        self._srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.address = "%s:%d" % self._srv.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"srjt-exchange-r{self.rank}",
+        )
+        self._accept_thread.start()
+
+    # -- server side ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn) -> None:
+        from ..utils import faultinj, metrics
+
+        try:
+            conn.settimeout(self.deadline_s)
+            while True:
+                try:
+                    hdr = b""
+                    while len(hdr) < _EXC_REQ.size:
+                        chunk = conn.recv(_EXC_REQ.size - len(hdr))
+                        if not chunk:
+                            return
+                        hdr += chunk
+                except (OSError, socket_mod.timeout):
+                    return
+                magic, verb, epoch, part = _EXC_REQ.unpack(hdr)
+                if magic != _EXC_MAGIC or verb != _EXC_GET:
+                    conn.sendall(_EXC_RESP.pack(_EXC_ERR, 0))
+                    return
+                # chaos choke point: `crash` kills the serving process
+                # mid-request (the peer sees a dead transport and
+                # retries), `delay` models a slow peer
+                if faultinj.is_enabled():
+                    faultinj.maybe_inject("exchange.serve")
+                with self._published:
+                    end = time.monotonic() + self.publish_wait_s
+                    blob = self._frames.get((epoch, part))
+                    while blob is None and not self._closed:
+                        left = end - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._published.wait(left)
+                        blob = self._frames.get((epoch, part))
+                if blob is None:
+                    conn.sendall(
+                        _EXC_RESP.pack(_EXC_RETRY, 0)
+                    )
+                    continue
+                wire = blob
+                if faultinj.is_enabled():
+                    # flips bytes AFTER the frame (and its CRCs) was
+                    # encoded — the fetcher's decode MUST catch it
+                    wire = faultinj.maybe_corrupt("exchange.frame", blob)
+                conn.sendall(_EXC_RESP.pack(_EXC_OK, len(wire)) + wire)
+                metrics.counter("shuffle.tcp.bytes_out").inc(len(wire))
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def publish(self, epoch: int, partitions: Dict[int, "Table"]) -> None:
+        """Encode and expose this rank's outgoing partitions for
+        ``epoch`` (one frame per destination rank, per-column CRC under
+        the integrity gate). Idempotent per key — a respawned peer
+        re-publishing identical deterministic partitions is a no-op."""
+        from ..columnar import frames as frames_mod
+        from ..utils import metrics
+
+        encoded = {
+            (int(epoch), int(part)): frames_mod.encode_table(t)
+            for part, t in partitions.items()
+        }
+        evicted = 0
+        with self._published:
+            self._frames.update(encoded)
+            epochs = sorted({e for e, _ in self._frames})
+            for old in epochs[: max(len(epochs) - self.retain_epochs, 0)]:
+                stale = [k for k in self._frames if k[0] == old]
+                for k in stale:
+                    del self._frames[k]
+                evicted += len(stale)
+            self._published.notify_all()
+        metrics.counter("shuffle.tcp.published").inc(len(encoded))
+        if evicted:
+            metrics.counter("shuffle.tcp.frames_evicted").inc(evicted)
+
+    def drop_epoch(self, epoch: int) -> int:
+        """Release one exchange round's published frames (e.g. after
+        every peer has fetched); returns the number dropped."""
+        with self._published:
+            stale = [k for k in self._frames if k[0] == int(epoch)]
+            for k in stale:
+                del self._frames[k]
+        return len(stale)
+
+    # -- fetch side ----------------------------------------------------------
+
+    def _fetch_once(self, addr: str, epoch: int, part: int) -> "Table":
+        """One fetch attempt — the unit the retry orchestrator re-runs.
+        Transport faults and not-yet-published answers raise
+        RetryableError; a frame whose bytes rotted raises retryable
+        DataCorruption from the decoder; an exhausted query budget
+        raises DeadlineExceeded (never a raw socket timeout)."""
+        from ..columnar import frames as frames_mod
+        from ..utils import deadline as deadline_mod, metrics
+        from ..utils.errors import RetryableError
+
+        d = deadline_mod.current()
+        budget_s = self.deadline_s
+        if d is not None:
+            d.check("tcp_exchange_fetch")
+            budget_s = min(budget_s, max(d.remaining(), 1e-3))
+        deadline = time.monotonic() + budget_s
+        host, port = _parse_addr(addr)
+        s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        try:
+            s.settimeout(budget_s)
+            try:
+                s.connect((host, port))
+                s.sendall(_EXC_REQ.pack(_EXC_MAGIC, _EXC_GET, epoch, part))
+                status, blen = _EXC_RESP.unpack(
+                    _recv_exact_tcp(s, _EXC_RESP.size, deadline)
+                )
+                blob = _recv_exact_tcp(s, blen, deadline) if blen else b""
+            except socket_mod.timeout as e:
+                if d is not None and d.done():
+                    raise d.exceeded("tcp exchange fetch") from e
+                raise RetryableError(
+                    f"shuffle exchange: DEADLINE_EXCEEDED: fetch of "
+                    f"(epoch {epoch}, part {part}) from {addr} exceeded "
+                    f"{budget_s:g}s"
+                ) from e
+            except (ConnectionError, OSError) as e:
+                raise RetryableError(
+                    f"shuffle exchange: UNAVAILABLE: peer {addr} "
+                    f"({e})"
+                ) from e
+        finally:
+            s.close()
+        if status == _EXC_RETRY:
+            raise RetryableError(
+                f"shuffle exchange: UNAVAILABLE: peer {addr} has not "
+                f"published (epoch {epoch}, part {part}) yet"
+            )
+        if status != _EXC_OK:
+            # _EXC_ERR means the peer rejected our magic/verb: a
+            # misaddressed or version-skewed peer, deterministic on
+            # every attempt — fail fast instead of burning the whole
+            # retry budget on a config error (the transient cases are
+            # _EXC_RETRY and the transport faults above)
+            from ..utils.errors import FatalDeviceError
+
+            raise FatalDeviceError(
+                f"shuffle exchange: peer {addr} answered error status "
+                f"{status} (protocol mismatch — wrong service or "
+                "version-skewed peer?)"
+            )
+        metrics.counter("shuffle.tcp.bytes_in").inc(len(blob))
+        # decode verifies the frame header + every column CRC: a
+        # tampered exchange is retryable DataCorruption, never rows
+        return frames_mod.decode_table(blob, where="shuffle.exchange")
+
+    def fetch(self, addr: str, epoch: int, part: int) -> "Table":
+        """Pull one partition from ``addr`` under retry + breaker +
+        deadline. Corruption and transport faults retry; exhaustion
+        records a breaker failure and re-raises retryably (the caller's
+        supervisor may respawn the peer and call again)."""
+        from ..utils import metrics, retry
+        from ..utils.errors import DeadlineExceeded, RetryableError
+
+        br = exchange_breaker()
+        if not br.allow():
+            raise RetryableError(
+                "shuffle exchange: UNAVAILABLE: exchange breaker open "
+                f"(peer {addr})"
+            )
+        t0 = time.perf_counter()
+        try:
+            table = retry.call_with_retry(
+                self._fetch_once, addr, epoch, part,
+                op_name="tcp_exchange_fetch",
+            )
+        except DeadlineExceeded:
+            br.record_failure(cause="deadline")
+            raise
+        except RetryableError:
+            br.record_failure(cause="unavailable")
+            raise
+        except BaseException:
+            br.abort_probe()
+            raise
+        br.record_success()
+        metrics.counter("shuffle.tcp.fetches").inc()
+        metrics.histogram("shuffle.tcp.fetch_us").record(
+            (time.perf_counter() - t0) * 1e6
+        )
+        return table
+
+    # -- the one-call partition exchange -------------------------------------
+
+    def exchange_table(self, table: "Table", key_cols: Sequence[str],
+                       peers: Dict[int, str], epoch: int = 0) -> "Table":
+        """Hash-repartition ``table`` across this rank and ``peers``
+        (rank -> "host:port", this rank excluded): rows of one key all
+        land on hash(key) % world, whatever process they started in.
+        Publishes the outgoing partitions, pulls this rank's partition
+        from every peer, and returns the concatenation in rank order —
+        a deterministic row order, so downstream aggregation is
+        reproducible bit for bit."""
+        from ..ops.copying import concatenate, slice_table
+
+        world = len(peers) + 1
+        ranks = sorted(set(peers) | {self.rank})
+        if len(ranks) != world or ranks != list(range(world)):
+            raise ValueError(
+                f"exchange peers must cover ranks 0..{world - 1} "
+                f"(got self={self.rank}, peers={sorted(peers)})"
+            )
+        partitioned, offsets = hash_partition(table, world, key_cols)
+        bounds = list(offsets) + [partitioned.num_rows]
+        parts = {
+            p: slice_table(partitioned, bounds[p], bounds[p + 1])
+            for p in range(world)
+        }
+        self.publish(epoch, {p: t for p, t in parts.items() if p != self.rank})
+        # pull every peer's partition CONCURRENTLY (wall-clock = the
+        # slowest peer, not the sum; a slow peer must not stall pulls
+        # from peers already serving), then reassemble in rank order so
+        # row order — and therefore downstream aggregation — stays
+        # deterministic. contextvars.copy_context() carries the
+        # caller's deadline scope into each fetch thread (retry arming
+        # is module-global and inherits on its own).
+        import contextvars
+
+        fetched: Dict[int, "Table"] = {}
+        errs: List[BaseException] = []
+
+        def _pull(r: int, addr: str, ctx) -> None:
+            try:
+                fetched[r] = ctx.run(self.fetch, addr, epoch, self.rank)
+            except BaseException as e:
+                errs.append(e)
+
+        pulls = [
+            threading.Thread(
+                target=_pull, args=(r, peers[r], contextvars.copy_context())
+            )
+            for r in ranks
+            if r != self.rank
+        ]
+        for t in pulls:
+            t.start()
+        for t in pulls:
+            t.join()
+        if errs:
+            raise errs[0]
+        received = []
+        names = list(table.names)
+        for r in ranks:
+            if r == self.rank:
+                received.append(parts[self.rank])
+            else:
+                # frames carry schema (dtypes/validity), not names —
+                # the caller owns the naming, so re-apply its schema
+                received.append(Table(fetched[r].columns, names))
+        return concatenate(received)
+
+    def close(self) -> None:
+        with self._published:
+            self._closed = True
+            self._frames.clear()
+            self._published.notify_all()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# two-process harness: the CLI tests/test_data_plane.py and
+# benchmarks/bench_pool.py spawn as the peer rank. Deterministic by
+# construction (seeded data, integer-exact sums), so a respawned
+# incarnation recomputes and republishes identical partitions — which
+# is what makes a kill -9'd peer survivable by plain refetching.
+# ---------------------------------------------------------------------------
+
+
+def _demo_table(rows: int, seed: int, num_keys: int = 64) -> Table:
+    """The harness's deterministic workload: int64 keys + int64 values
+    (integer sums are associative bit-for-bit, so the distributed
+    result is comparable to the single-process one exactly)."""
+    import numpy as np  # noqa: F811  (module-level np is fine; explicit)
+
+    from ..columnar import Column, Table as _Table
+    from ..columnar.dtype import INT64
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, num_keys, rows).astype(np.int64)
+    vals = rng.integers(-1000, 1000, rows).astype(np.int64)
+    return _Table(
+        [Column(INT64, data=jnp.asarray(keys)), Column(INT64, data=jnp.asarray(vals))],
+        ["k", "v"],
+    )
+
+
+def _local_groupby_sum(table: Table) -> Table:
+    """Exact int64 groupby (sum + count) over the harness table,
+    sorted by key — the deterministic per-rank aggregation whose
+    concatenation must be bit-identical to the single-process run."""
+    import numpy as np
+
+    from ..columnar import Column, Table as _Table
+    from ..columnar.dtype import INT64
+
+    keys = np.asarray(table.column("k").data)
+    vals = np.asarray(table.column("v").data)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    sums = np.zeros(len(uniq), np.int64)
+    counts = np.zeros(len(uniq), np.int64)
+    np.add.at(sums, inv, vals)
+    np.add.at(counts, inv, 1)
+    return _Table(
+        [
+            Column(INT64, data=jnp.asarray(uniq)),
+            Column(INT64, data=jnp.asarray(sums)),
+            Column(INT64, data=jnp.asarray(counts)),
+        ],
+        ["k", "s", "c"],
+    )
+
+
+def _shard_bounds(rows: int, world: int, rank: int) -> Tuple[int, int]:
+    return rows * rank // world, rows * (rank + 1) // world
+
+
+def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
+                        rank: int = 1, world: int = 2,
+                        extra_env: Optional[dict] = None,
+                        ready_timeout_s: float = 180.0,
+                        respawn_of=None):
+    """Spawn one ``--exchange-worker`` peer process against
+    ``parent_addr`` (rank 0) and wait for its READY handshake; returns
+    ``(Popen, peer_address)``. The ONE owner of the spawn/handshake
+    protocol — tests and benchmarks both go through it, so a change to
+    the CLI flags or the READY line cannot drift between them. The
+    child inherits this environment minus any armed fault-injection
+    config (pass it back via ``extra_env`` to storm the peer on
+    purpose), with retry armed. ``respawn_of`` is the Popen of a DEAD
+    predecessor being replaced: the harness verifies it exited and
+    emits the ``exchange.peer_respawn`` event itself — the artifact
+    the premerge chaos gate asserts on, so it must come from the
+    machinery that observed the death, never from a test's own
+    assertion."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("SRJT_FAULTINJ_CONFIG", None)
+    env["SRJT_RETRY_ENABLED"] = "1"
+    if extra_env:
+        env.update(extra_env)
+    runner = (
+        "from spark_rapids_jni_tpu.parallel.shuffle import _main; "
+        "import sys; sys.exit(_main())"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", runner,
+         "--exchange-worker", "--rank", str(rank), "--world", str(world),
+         "--rows", str(rows), "--seed", str(seed),
+         "--peers", f"0={parent_addr}"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True,
+    )
+    import select
+
+    # select() on the RAW fd + os.read into our own line buffer so
+    # ready_timeout_s is actually enforced: a child that wedges before
+    # printing (jax init hang) must not park the parent in a
+    # timeout-less readline, an EOF while the child lives means READY
+    # can never arrive (fail fast, never busy-spin on empty reads),
+    # and a READY line that lands in the same pipe chunk as an earlier
+    # stdout line must still be seen — selecting on the buffered text
+    # stream would never report it readable again (the data already
+    # left the pipe) and a healthy peer would be killed at timeout
+    fd = proc.stdout.fileno()
+    buf = b""
+    t_end = time.monotonic() + ready_timeout_s
+    while True:
+        nl = buf.find(b"\n")
+        if nl >= 0:
+            line, buf = buf[:nl], buf[nl + 1:]
+            text = line.decode("utf-8", "replace")
+            if text.startswith("SRJT_EXCHANGE_READY"):
+                if respawn_of is not None and respawn_of.poll() is not None:
+                    from ..utils import metrics
+
+                    metrics.event(
+                        "exchange.peer_respawn", rank=rank,
+                        prev_rc=respawn_of.returncode,
+                    )
+                return proc, text.strip().split("addr=")[1]
+            continue
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            break
+        readable, _, _ = select.select([fd], [], [], min(remaining, 0.5))
+        if not readable:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"exchange peer exited during startup rc={proc.returncode}"
+                )
+            continue
+        chunk = os.read(fd, 65536)
+        if not chunk:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"exchange peer exited during startup rc={proc.returncode}"
+                )
+            proc.kill()
+            proc.wait()
+            raise RuntimeError(
+                "exchange peer closed stdout before reporting ready"
+            )
+        buf += chunk
+    proc.kill()
+    proc.wait()
+    raise RuntimeError(
+        f"exchange peer never reported ready within {ready_timeout_s:g}s"
+    )
+
+
+def _exchange_worker_main(args) -> int:
+    """Peer-rank process: build the deterministic shard, exchange hash
+    partitions with rank 0, aggregate, publish the result table (epoch
+    ``args.epoch + 1``, part = this rank), then park until stdin
+    closes. Prints ``SRJT_EXCHANGE_READY addr=<host:port>`` once the
+    server is up — the line the parent polls for. The worker IS the
+    cross-process posture, so it defaults ``SRJT_EXCHANGE_MODE`` to
+    ``tcp`` and refuses an explicit ``mesh`` (an operator forcing the
+    in-process mode on a cross-process peer is a config error, not
+    something to ignore)."""
+    import sys
+
+    from ..ops.copying import slice_table
+    from ..utils import retry
+
+    os.environ.setdefault("SRJT_EXCHANGE_MODE", "tcp")
+    if exchange_mode() != "tcp":
+        print(
+            "exchange worker: SRJT_EXCHANGE_MODE must be 'tcp' for a "
+            "cross-process peer (got 'mesh')",
+            file=sys.stderr,
+        )
+        return 2
+
+    peers = {}
+    for spec in (args.peers or "").split(","):
+        if not spec:
+            continue
+        r, _, addr = spec.partition("=")
+        peers[int(r)] = addr
+    ex = TcpExchange(args.rank, bind=args.bind)
+    print(f"SRJT_EXCHANGE_READY addr={ex.address}", flush=True)
+    table = _demo_table(args.rows, args.seed)
+    lo, hi = _shard_bounds(args.rows, args.world, args.rank)
+    shard = slice_table(table, lo, hi)
+    with retry.enabled(max_attempts=40, base_delay_ms=25, max_delay_ms=250):
+        local = ex.exchange_table(shard, ["k"], peers, epoch=args.epoch)
+        result = _local_groupby_sum(local)
+        ex.publish(args.epoch + 1, {args.rank: result})
+        # park: serve fetches until the supervisor closes our stdin
+        sys.stdin.read()
+    ex.close()
+    return 0
+
+
+def _main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="TCP exchange worker harness")
+    ap.add_argument("--exchange-worker", action="store_true", required=True)
+    ap.add_argument("--rank", type=int, default=1)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--bind", default="127.0.0.1:0")
+    ap.add_argument("--peers", default="", help="rank=host:port,...")
+    return _exchange_worker_main(ap.parse_args())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

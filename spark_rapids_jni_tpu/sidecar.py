@@ -168,6 +168,25 @@ ARENA_FLAG = 0x80000000  # high bit of op/status: payload at arena[0:len]
 CRC_FLAG = 0x40000000  # op/status bit: a u32 CRC trailer follows the header
 _FLAG_MASK = ARENA_FLAG | CRC_FLAG
 
+# slab-arena data plane (ISSUE 6): a SET_ARENA payload of >= 16 bytes
+# carries a u64 mode word after the size; mode bit 0 marks the arena a
+# SLAB of per-request regions (sidecar_pool.ArenaSlab). On a slab-mode
+# connection an ARENA_FLAG request's stream payload is a REGION
+# DESCRIPTOR naming where the real payload lives — the worker validates
+# it against the 32-byte region header the client wrote into the slab
+# (magic + generation + request id + capacity + payload length), so a
+# stale or clobbered region surfaces as a retryable desync, never as
+# somebody else's bytes. Responses land back inside the same region
+# (header-only frame) when they fit, else stream. Legacy 8-byte
+# SET_ARENA payloads (the native C++ client) keep the single-buffer
+# offset-0 protocol byte for byte.
+ARENA_MODE_LEGACY = 0
+ARENA_MODE_SLAB = 1
+REGION_MAGIC = 0x524A5253  # b"SRJR" little-endian
+REGION_HDR = struct.Struct("<IIQQQ")  # magic, generation, request_id, capacity, payload_len
+REGION_HDR_LEN = REGION_HDR.size  # 32
+REGION_DESC = struct.Struct("<QQI")  # offset, request_id, generation
+
 STATUS_OK = 0
 STATUS_ERROR = 1
 STATUS_CAST_ERROR = 2
@@ -199,8 +218,21 @@ def _recv_exact(conn: socket.socket, n: int, fds: list = None) -> bytes:
     return bytes(buf)
 
 
+# wire table format negotiation (ISSUE 6): the worker answers each
+# request in the table layout the REQUEST used. ``_read_table`` records
+# the sniffed format here (one slot per connection thread — each
+# connection is handled on its own thread and ops are synchronous), and
+# ``_write_table`` consults it, so the native C++ client's legacy
+# walker layout round-trips byte for byte while framed clients get the
+# versioned columnar frame codec (columnar/frames.py) back.
+_REQ_FMT = threading.local()
+
+
 def _read_table(payload: bytes, pos: int = 0):
-    """Deserialize from ``payload[pos:]``: u32 ncols; per col: i32
+    """Deserialize a table from ``payload[pos:]``. Sniffs the versioned
+    columnar frame magic (columnar/frames.py) first — framed payloads
+    decode through the shared codec (per-column CRC verified); anything
+    else is the legacy walker layout: u32 ncols; per col: i32
     type_id, i32 scale, u64 n, u8 has_validity, [n] u8 validity, then
     either (u64 data_len, bytes) for fixed width or (i32[n+1] offsets,
     u64 chars_len, bytes) for STRING and LIST (byte child). The offset
@@ -209,9 +241,13 @@ def _read_table(payload: bytes, pos: int = 0):
     import jax.numpy as jnp
     import numpy as np
 
-    from .columnar import Column, Table
+    from .columnar import Column, Table, frames
     from .columnar.dtype import DType, TypeId
 
+    if frames.is_frame(payload, pos):
+        _REQ_FMT.framed = True
+        return frames.decode_table(payload, where="sidecar.table_frame", offset=pos)
+    _REQ_FMT.framed = False
     (ncols,) = struct.unpack_from("<I", payload, pos)
     pos += 4
     cols = []
@@ -280,15 +316,23 @@ def _op_groupby_sum(payload: bytes) -> bytes:
     return np.asarray(sums, np.float32).tobytes() + np.asarray(counts, np.int64).tobytes()
 
 
-def _write_table(table) -> bytes:
-    """Serialize a Table in the _read_table format (the symmetric wire
-    form: the C++ client parses responses with the same walker it
-    serializes requests with). LIST<INT8|UINT8> columns reuse the
-    STRING framing (offsets + byte child)."""
+def _write_table(table, framed: bool = None) -> bytes:
+    """Serialize a Table for the wire. ``framed=None`` (the worker's
+    posture) echoes the format the current request's ``_read_table``
+    sniffed, so the C++ client parses responses with the same legacy
+    walker it serializes requests with, and framed clients decode the
+    shared codec. LIST<INT8|UINT8> columns reuse the STRING framing
+    (offsets + byte child) in the legacy form."""
     import numpy as np
 
     from .columnar.dtype import TypeId
 
+    if framed is None:
+        framed = getattr(_REQ_FMT, "framed", False)
+    if framed:
+        from .columnar import frames
+
+        return frames.encode_table(table)
     out = [struct.pack("<I", len(table.columns))]
     for col in table.columns:
         d = col.dtype
@@ -434,6 +478,10 @@ def _op_stats(backend: str) -> bytes:
 
 
 def _dispatch(op: int, payload: bytes, backend: str) -> bytes:
+    # fresh wire-format slot per dispatch: host-fallback callers reuse
+    # threads, and a stale `framed` sniff from an earlier request would
+    # make an op that never reads a table echo the wrong table layout
+    _REQ_FMT.framed = False
     if op == OP_PING:
         return backend.encode()
     if op == OP_STATS:
@@ -467,6 +515,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
 
     reg = metrics.registry()  # worker-side counters: always-on
     arena = None  # mmap over the client's memfd
+    arena_mode = ARENA_MODE_LEGACY  # SET_ARENA mode word (slab vs legacy)
     # memory-governor bookkeeping (always-on, like the request counters):
     # the mmap'd arena is host memory no budget would otherwise see —
     # it registers as a host-tier PINNED catalog entry, keyed per
@@ -474,18 +523,52 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     arena_key = f"sidecar.arena.conn{id(conn)}"
     fds: list = []
 
-    def reply(status: int, body: bytes, with_crc: bool, crc_body: bytes = None):
+    def reply(status: int, body: bytes, with_crc: bool, crc_body: bytes = None,
+              region=None):
         """One response frame. ``crc_body`` is what the trailer covers
         when it differs from the bytes on the wire — the injected
         ``corrupt`` chaos flips bytes AFTER checksumming, exactly like
-        a transport fault, so the client's CRC check MUST fail."""
+        a transport fault, so the client's CRC check MUST fail.
+        ``region`` is the (offset, capacity, request_id, generation) of
+        a slab-mode region request: a fitting OK response lands back
+        inside that region (header-only frame) after the in-slab header
+        is re-validated against the request's id+generation; slab-mode
+        connections never answer through the arena otherwise — the
+        legacy single-buffer opportunism is exactly what serialized the
+        whole pool on one lock."""
         trailer = b""
         if with_crc and integrity.is_enabled():
             status |= CRC_FLAG
             trailer = integrity.pack_crc(
                 integrity.checksum(body if crc_body is None else crc_body)
             )
-        if status & ~_FLAG_MASK == STATUS_OK and arena is not None and 0 < len(body) <= len(arena):
+        ok = (status & ~_FLAG_MASK) == STATUS_OK
+        if ok and region is not None and 0 < len(body) <= region[1]:
+            # re-validate the in-slab header IMMEDIATELY before writing:
+            # a slow-but-alive worker whose client already timed out and
+            # failed over would otherwise clobber the region under the
+            # retry attempt (the client bumps the generation on every
+            # rewrite, so a stale attempt sees a mismatch here). The
+            # check and the write are not atomic — a write straddling
+            # the retry's rewrite can still tear the pages — but both
+            # sides checksum IN-HAND bytes (never an mmap re-read), so
+            # a tear fails CRC verification and heals retryably. On
+            # mismatch fall through to the stream answer — this socket
+            # is the only place this attempt's client could still be
+            # listening, and the slab stays untouched.
+            off = region[0]
+            magic, hgen, hrid, _cap, _plen = REGION_HDR.unpack_from(arena, off)
+            if magic == REGION_MAGIC and hrid == region[2] and hgen == region[3]:
+                start = off + REGION_HDR_LEN
+                arena[start : start + len(body)] = body
+                conn.sendall(
+                    struct.pack("<IQ", status | ARENA_FLAG, len(body)) + trailer
+                )
+                return
+        if (
+            ok and arena is not None and arena_mode == ARENA_MODE_LEGACY
+            and 0 < len(body) <= len(arena)
+        ):
             arena[: len(body)] = body
             conn.sendall(struct.pack("<IQ", status | ARENA_FLAG, len(body)) + trailer)
         else:
@@ -508,7 +591,45 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             req_crc = (
                 integrity.unpack_crc(_recv_exact(conn, 4, fds)) if with_crc else None
             )
-            if in_arena:
+            region = None  # (offset, capacity) of a slab-mode region request
+            if in_arena and arena_mode == ARENA_MODE_SLAB:
+                # slab mode: the stream payload is a region DESCRIPTOR;
+                # the real payload sits behind the region header the
+                # client wrote into the shared slab. Every mismatch —
+                # stale generation, foreign request id, bad geometry —
+                # answers retryably so the client rewrites the region
+                # (or replays SET_ARENA) and re-sends.
+                desc = _recv_exact(conn, plen, fds) if plen else b""
+                err = None
+                if len(desc) != REGION_DESC.size:
+                    err = f"bad region descriptor length {len(desc)}"
+                elif arena is None:
+                    err = "no uploaded arena (re-send SET_ARENA)"
+                else:
+                    off, rid, gen = REGION_DESC.unpack(desc)
+                    if off + REGION_HDR_LEN > len(arena):
+                        err = f"region offset {off} out of bounds"
+                    else:
+                        magic, hgen, hrid, cap, pl = REGION_HDR.unpack_from(arena, off)
+                        if magic != REGION_MAGIC or hrid != rid or hgen != gen:
+                            err = (
+                                f"region header desync at {off} "
+                                f"(rid {hrid} != {rid} or gen {hgen} != {gen})"
+                            )
+                        elif pl > cap or off + REGION_HDR_LEN + cap > len(arena):
+                            err = f"region geometry invalid (len {pl} cap {cap})"
+                        else:
+                            region = (off, cap, rid, gen)
+                            start = off + REGION_HDR_LEN
+                            payload = bytes(arena[start : start + pl])
+                if err is not None:
+                    reply(
+                        STATUS_ERROR,
+                        f"RetryableError: arena region: {err}".encode(),
+                        with_crc,
+                    )
+                    continue
+            elif in_arena:
                 if arena is None or plen > len(arena):
                     # retryable by prefix: a redialed connection lost its
                     # per-connection arena — the client replays SET_ARENA
@@ -523,6 +644,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                 payload = bytes(arena[:plen])
             else:
                 payload = _recv_exact(conn, plen, fds) if plen else b""
+            _REQ_FMT.framed = False  # set by _read_table when it sniffs a frame
             if req_crc is not None and integrity.is_enabled():
                 reg.counter("sidecar.integrity.frames_checked").inc()
                 try:
@@ -549,6 +671,14 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                     faultinj.maybe_inject(f"sidecar.worker.{op_name(op)}")
                 if op == OP_SET_ARENA:
                     (size,) = struct.unpack_from("<Q", payload, 0)
+                    # >= 16-byte payloads carry the arena MODE word
+                    # (bit 0 = slab of per-request regions); the native
+                    # client's 8-byte payload keeps the legacy protocol
+                    mode = (
+                        struct.unpack_from("<Q", payload, 8)[0]
+                        if len(payload) >= 16
+                        else ARENA_MODE_LEGACY
+                    )
                     if not fds:
                         raise ValueError("SET_ARENA without an fd")
                     fd = fds.pop(0)
@@ -567,6 +697,11 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                         arena = None
                         memgov.catalog().unregister(arena_key)
                     arena = mmap.mmap(fd, size)
+                    arena_mode = (
+                        ARENA_MODE_SLAB
+                        if (mode & ARENA_MODE_SLAB)
+                        else ARENA_MODE_LEGACY
+                    )
                     os.close(fd)
                     memgov.catalog().register_host_bytes(
                         arena_key, size, pinned=True, kind="arena"
@@ -593,7 +728,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                     wire_resp = faultinj.maybe_corrupt(
                         f"sidecar.worker.{op_name(op)}", resp
                     )
-                reply(STATUS_OK, wire_resp, with_crc, crc_body=resp)
+                reply(STATUS_OK, wire_resp, with_crc, crc_body=resp, region=region)
             except Exception as e:  # report, keep serving
                 from .ops.cast_string import CastError
 
@@ -748,7 +883,8 @@ class SupervisedClient:
             buf.extend(chunk)
         return bytes(buf)
 
-    def _raw_request(self, op: int, payload: bytes, arena_len: int = None):
+    def _raw_request(self, op: int, payload: bytes, arena_len: int = None,
+                     region=None):
         """One request/response exchange on the live socket, bounded by
         one per-request deadline end to end — under an active deadline
         scope that is ``min(deadline_s, remaining budget)``, so a hung
@@ -759,9 +895,14 @@ class SupervisedClient:
         deadline, never a raw socket timeout).
 
         With ``arena_len`` the request payload is RESIDENT at
-        ``arena_mm[0:arena_len]`` (the shared-memory data plane): only
-        the header — and the CRC trailer, computed over the ARENA bytes
-        — crosses the socket, under ``wire_op | ARENA_FLAG``."""
+        ``arena_mm[0:arena_len]`` (the legacy single-buffer data
+        plane): only the header — and the CRC trailer, computed over
+        the ARENA bytes — crosses the socket, under
+        ``wire_op | ARENA_FLAG``. With ``region`` (an
+        ``sidecar_pool.ArenaRegion``, the slab data plane) the payload
+        is resident inside the leased region and only the 20-byte
+        region descriptor crosses the socket — N such requests ride N
+        workers concurrently, nothing shared but the allocator."""
         from .utils import deadline as deadline_mod, integrity
         from .utils.errors import DataCorruption, RetryableError
 
@@ -777,12 +918,37 @@ class SupervisedClient:
         # worker echoes the flag back with a trailer this side verifies.
         use_crc = integrity.is_enabled()
         wire_op = (op | CRC_FLAG) if use_crc else op
-        if arena_len is None:
+        if region is not None:
+            wire_op |= ARENA_FLAG
+            # checksum the IN-HAND request bytes, never an mmap re-read:
+            # a slow stale worker's slab write straddling the caller's
+            # rewrite can tear the shared pages, and a CRC computed over
+            # a re-read would bless the torn bytes — computed over the
+            # snapshot, any tear fails the worker-side verify and heals
+            # as retryable DataCorruption
+            body = region.snapshot_bytes()
+            payload = REGION_DESC.pack(
+                region.offset, region.request_id, region.generation
+            )
+            plen = len(payload)
+        elif arena_len is None:
             body, plen = payload, len(payload)
         else:
-            if self.arena_mm is None or arena_len > len(self.arena_mm):
+            if self.arena_mm is None:
                 raise ValueError(
                     "arena_len given but no client-side arena is mapped"
+                )
+            if arena_len > len(self.arena_mm):
+                # enforcement of the PR 5 hardening note (ISSUE 6): an
+                # oversized arena request must engage retry-with-split,
+                # never truncate — RESOURCE_EXHAUSTED is the class the
+                # split machinery keys on, and the message carries the
+                # needed size
+                raise RetryableError(
+                    f"sidecar: RESOURCE_EXHAUSTED: arena request needs "
+                    f"{arena_len} bytes but the mapped arena holds "
+                    f"{len(self.arena_mm)} — split the batch or lease a "
+                    "larger region"
                 )
             wire_op |= ARENA_FLAG
             body, plen, payload = bytes(self.arena_mm[:arena_len]), arena_len, b""
@@ -805,11 +971,18 @@ class SupervisedClient:
                 # the worker answered through the shared arena: only the
                 # header (and CRC trailer) crossed the socket — a client
                 # without the mapping cannot honor the frame (desync)
-                if self.arena_mm is None or rlen > len(self.arena_mm):
+                if region is not None:
+                    if rlen > region.capacity:
+                        raise ConnectionError(
+                            "region-flagged response exceeds the leased region"
+                        )
+                    resp = region.read(rlen)
+                elif self.arena_mm is None or rlen > len(self.arena_mm):
                     raise ConnectionError(
                         "arena-flagged response without a client-side arena"
                     )
-                resp = bytes(self.arena_mm[:rlen])
+                else:
+                    resp = bytes(self.arena_mm[:rlen])
             else:
                 resp = self._recv_deadline(rlen, deadline) if rlen else b""
         except socket.timeout as e:
@@ -853,15 +1026,17 @@ class SupervisedClient:
             raise RetryableError("sidecar: PING failed (worker unhealthy)")
         return resp.decode()
 
-    def request(self, op: int, payload: bytes, arena_len: int = None) -> bytes:
+    def request(self, op: int, payload: bytes, arena_len: int = None,
+                region=None) -> bytes:
         """Supervised exchange: reconnect when needed, heartbeat stale
         connections, classify worker-side errors into the
         fatal/retryable taxonomy. With metrics armed, every exchange
         records a latency histogram (``sidecar.request_us``) and
         failures count under ``sidecar.request_failures``.
-        ``arena_len`` routes the request through the shared-memory data
-        plane (see ``_raw_request``) under the SAME deadline clamp,
-        CRC protocol, and taxonomy as a stream frame."""
+        ``arena_len`` routes the request through the legacy
+        single-buffer data plane and ``region`` through a leased slab
+        region (see ``_raw_request``) — both under the SAME deadline
+        clamp, CRC protocol, and taxonomy as a stream frame."""
         from .utils import metrics
         from .utils.errors import (
             DataCorruption,
@@ -885,7 +1060,7 @@ class SupervisedClient:
         armed = metrics.is_enabled()
         t0 = time.perf_counter() if armed else 0.0
         try:
-            status, resp = self._raw_request(op, payload, arena_len)
+            status, resp = self._raw_request(op, payload, arena_len, region)
         except Exception:
             metrics.counter("sidecar.request_failures").inc()
             raise

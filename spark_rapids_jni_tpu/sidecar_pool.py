@@ -1,46 +1,58 @@
-"""Crash-tolerant sidecar worker POOL with state re-hydration (ISSUE 5).
+"""Crash-tolerant sidecar worker POOL over a SLAB-ARENA data plane.
 
 The single-worker sidecar (sidecar.py) concentrates all device state in
-one long-lived child: before this module, a worker crash meant
-reconnect-once -> circuit breaker -> permanent degrade-to-host for the
-rest of the process — the SET_ARENA data plane and the device fast path
-were simply gone. Theseus (PAPERS.md) treats worker failure as a
-first-class event a query engine must survive, not observe. This module
-is that layer:
+one long-lived child; PR 5 (ISSUE 5) made that survivable with a
+supervised pool of N workers — failover, background respawn, arena
+re-hydration, pool-scoped breaker, CRC end to end. But its shared
+arena was ONE buffer guarded by ONE lock: once an arena existed, every
+pool request serialized on it, so ``SRJT_SIDECAR_POOL_SIZE=N`` bought
+fault tolerance and zero throughput. This round (ISSUE 6) generalizes
+the memfd arena into a **slab of per-request regions**:
 
-- **Supervised pool of N workers** (``SRJT_SIDECAR_POOL_SIZE``,
-  default 1 = today's footprint): each worker is its own spawned
-  process + socket + ``SupervisedClient``, requests route round-robin
-  over the LIVE set.
-- **Failover**: a request that dies with its worker (kill -9, chaos
-  ``crash`` fault, transport reset) marks the worker dead, counts ONE
-  ``sidecar.pool.failovers``, and re-raises retryably — the existing
-  retry orchestrator (utils/retry.py) re-runs the op, routing lands on
-  a live worker, and the query never notices beyond latency.
-- **Respawn + state re-hydration**: a background thread respawns the
-  dead worker and REPLAYS its device state — the pool keeps the arena
-  memfd (one shared memfd, every worker maps the same pages) and the
-  client-side memgov catalog holds its host-tier accounting entry
-  (``sidecar.pool.arena``), so a replacement worker gets OP_SET_ARENA
-  re-uploaded before it takes traffic (``sidecar.pool.rehydrations``).
-- **Pool-scoped breaker**: the process-global circuit breaker
-  (sidecar.breaker()) now guards the POOL, not one worker — it records
-  a failure only when an op fails with ZERO live workers; one crashed
-  worker among living peers is a failover, not a trip.
-- **Integrity end to end**: every frame the pool moves rides the CRC
-  trailer protocol (utils/integrity.py), arena payloads included — a
-  corrupted response is ``DataCorruption`` (retryable, the orchestrator
-  re-fetches), never a wrong answer.
+- **ArenaSlab**: one memfd of ``SRJT_ARENA_SLAB_BYTES`` (power of two;
+  every worker maps the same pages) carved by a buddy free-list
+  allocator into power-of-two regions. Each in-flight request LEASES a
+  region, writes its payload behind a 32-byte region header (magic +
+  generation + request id + capacity + payload length), and the worker
+  answers back into the same region — N workers carry N arena-resident
+  ops concurrently, nothing shared but the allocator's short critical
+  section.
+- **Region header = re-hydration unit**: the header travels in the
+  slab pages themselves, so a respawned worker that re-maps the memfd
+  (SET_ARENA replay, exactly as PR 5 replayed the single buffer) sees
+  every live region; the pool re-writes the request bytes (and bumps
+  the generation) before every retry attempt, so a dead worker's
+  partial response can never be what the failover re-sends — and a
+  stale generation is a retryable desync at the worker, never
+  somebody else's bytes.
+- **Exhaustion is retryable-with-split**: a lease that cannot fit (or
+  a write larger than its region) raises ``RetryableError`` carrying a
+  ``RESOURCE_EXHAUSTED`` marker and the needed size, so the retry
+  orchestrator's split path engages instead of a silent truncated
+  write (the PR 5 hardening note, now enforced).
+- **Leak discipline**: ``shutdown()`` (and ``set_arena()`` replacing a
+  slab) releases and munmaps every region — force-released leases are
+  counted (``sidecar.pool.region_leaks``) — and every open slab is
+  registered so the test harness can assert none outlive a session
+  (tests/conftest.py).
+
+Everything PR 5 built rides along unchanged: supervised routing over
+the LIVE set, one ``sidecar.pool.failovers`` per death-with-living-
+peers, background respawn + SET_ARENA re-hydration, the pool-scoped
+breaker (a failure is recorded only with ZERO live workers), host-
+engine floor, and CRC trailers on every frame — region payloads
+included.
 
 Observability (registry-direct, durable-counter contract):
-``sidecar.pool.size`` / ``sidecar.pool.live`` gauges, per-worker
-``sidecar.pool.worker.w<id>.alive`` state gauges,
+``sidecar.pool.size`` / ``sidecar.pool.live`` /
+``sidecar.pool.slab_bytes`` / ``sidecar.pool.slab_regions`` gauges,
+per-worker ``sidecar.pool.worker.w<id>.alive`` state gauges,
 ``sidecar.pool.failovers`` / ``sidecar.pool.worker_deaths`` /
 ``sidecar.pool.respawns`` / ``sidecar.pool.rehydrations`` /
-``sidecar.pool.host_fallbacks`` counters — all in
-``runtime.stats_report()`` (``pool`` section), and
-``worker_stats()`` merges every live worker's STATS snapshot keyed per
-worker id (``sidecar.worker.w<id>.*`` gauges).
+``sidecar.pool.host_fallbacks`` / ``sidecar.pool.region_leases`` /
+``sidecar.pool.region_leaks`` counters — all in
+``runtime.stats_report()`` (``pool`` section), and ``worker_stats()``
+merges every live worker's STATS snapshot keyed per worker id.
 
 Environment:
 
@@ -49,6 +61,9 @@ Environment:
                                 worker is left dead (default 3)
     SRJT_POOL_RESPAWN_DELAY_S   pause between failed spawn attempts
                                 (default 0.5)
+    SRJT_ARENA_SLAB_BYTES       slab size (rounded up to a power of
+                                two; default 64 MiB — virtual until
+                                touched, memfd-backed)
 """
 
 from __future__ import annotations
@@ -58,11 +73,15 @@ import os
 import struct
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from . import sidecar
 from .sidecar import (
+    ARENA_MODE_SLAB,
     OP_SET_ARENA,
+    REGION_HDR,
+    REGION_HDR_LEN,
+    REGION_MAGIC,
     STATUS_OK,
     _FLAG_MASK,
     SupervisedClient,
@@ -71,12 +90,19 @@ from .sidecar import (
 )
 
 __all__ = [
+    "ArenaRegion",
+    "ArenaSlab",
     "SidecarPool",
     "connect_pool",
     "current_pool",
     "shutdown_pool",
     "stats_section",
+    "open_slab_count",
+    "arena_leak_report",
 ]
+
+_DEFAULT_SLAB_BYTES = 64 << 20
+_MIN_REGION_BYTES = 4096  # smallest buddy block (header included)
 
 
 def _env_int(name: str, default: int, minimum: int = 1) -> int:
@@ -91,6 +117,302 @@ def _env_int(name: str, default: int, minimum: int = 1) -> int:
         warnings.warn(f"sidecar_pool: ignoring malformed {name}={raw!r}", stacklevel=2)
         return default
     return max(v, minimum)
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# the slab-arena allocator (the per-request data plane)
+# ---------------------------------------------------------------------------
+
+
+class ArenaRegion:
+    """One leased region of the slab: a power-of-two block whose first
+    32 bytes are the region header (sidecar.REGION_HDR) and the rest is
+    payload space. ``write()`` bumps the generation and rewrites header
+    + payload in one go — the unit a retry attempt replays. Use as a
+    context manager or ``release()`` explicitly; the slab counts every
+    un-released lease at teardown as a leak."""
+
+    __slots__ = (
+        "slab", "offset", "capacity", "request_id", "generation",
+        "payload_len", "_released", "_snapshot",
+    )
+
+    def __init__(self, slab: "ArenaSlab", offset: int, capacity: int,
+                 request_id: int):
+        self.slab = slab
+        self.offset = offset
+        self.capacity = capacity
+        self.request_id = request_id
+        self.generation = 0
+        self.payload_len = 0
+        self._released = False
+        self._snapshot: Optional[bytes] = None
+        self._write_header()
+
+    def _write_header(self) -> None:
+        self.slab._mm[self.offset : self.offset + REGION_HDR_LEN] = REGION_HDR.pack(
+            REGION_MAGIC, self.generation, self.request_id,
+            self.capacity, self.payload_len,
+        )
+
+    def write(self, data: bytes) -> None:
+        """Place ``data`` in the region and stamp a fresh generation.
+        Oversized payloads raise retryably with the needed size so
+        retry-with-split engages, never a truncated write."""
+        n = len(data)
+        if n > self.capacity:
+            from .utils.errors import RetryableError
+
+            raise RetryableError(
+                f"sidecar pool: RESOURCE_EXHAUSTED: region of "
+                f"{self.capacity} bytes cannot hold a {n}-byte request "
+                f"(need {n}) — split the batch or lease a larger region"
+            )
+        if self._released:
+            raise ValueError("write to a released arena region")
+        self.generation = (self.generation + 1) & 0xFFFFFFFF
+        self.payload_len = n
+        self._snapshot = bytes(data)
+        start = self.offset + REGION_HDR_LEN
+        self._write_header()
+        self.slab._mm[start : start + n] = data
+
+    def payload_bytes(self) -> bytes:
+        start = self.offset + REGION_HDR_LEN
+        return bytes(self.slab._mm[start : start + self.payload_len])
+
+    def snapshot_bytes(self) -> bytes:
+        """The request bytes as HANDED TO ``write()`` — never an mmap
+        re-read. Request CRCs and retry replays must draw from here: a
+        slow stale worker's slab write straddling a rewrite can tear
+        the shared pages, and a checksum computed over a re-read would
+        bless the torn bytes instead of catching them."""
+        if self._snapshot is None:
+            return self.payload_bytes()
+        return self._snapshot
+
+    def read(self, n: int) -> bytes:
+        if n > self.capacity:
+            raise ValueError(f"read of {n} bytes exceeds region capacity")
+        start = self.offset + REGION_HDR_LEN
+        return bytes(self.slab._mm[start : start + n])
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            # scribble the in-slab header magic BEFORE the block goes
+            # back to the free list: the worker re-validates the header
+            # immediately before answering through the slab, and a
+            # freed block coalesced into a larger re-lease keeps its
+            # interior bytes — a stale-but-intact header there would
+            # let a slow worker (whose client already gave up) pass
+            # validation and clobber the new lease's payload
+            try:
+                REGION_HDR.pack_into(
+                    self.slab._mm, self.offset,
+                    0, self.generation, self.request_id, self.capacity, 0,
+                )
+            except (ValueError, IndexError):
+                pass  # slab already closed/munmapped
+            self._snapshot = None
+            self.slab._release(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class ArenaSlab:
+    """memfd-backed slab carved by a buddy free-list into power-of-two
+    regions. The allocator is the ONLY shared state on the slab data
+    plane — leases are O(log size) under one short lock, and buddy
+    coalescing on release keeps large leases possible after bursts of
+    small ones."""
+
+    _OPEN: Dict[int, "ArenaSlab"] = {}
+    _OPEN_LOCK = threading.Lock()
+
+    def __init__(self, size_bytes: Optional[int] = None):
+        if size_bytes is None:
+            size_bytes = _env_int(
+                "SRJT_ARENA_SLAB_BYTES", _DEFAULT_SLAB_BYTES, minimum=_MIN_REGION_BYTES
+            )
+        size = _pow2_ceil(max(int(size_bytes), _MIN_REGION_BYTES))
+        self.size = size
+        self.fd = os.memfd_create("srjt-pool-slab")
+        os.ftruncate(self.fd, size)
+        self._mm = mmap.mmap(self.fd, size)
+        self._lock = threading.Lock()
+        self._max_k = size.bit_length() - 1
+        self._min_k = _MIN_REGION_BYTES.bit_length() - 1
+        self._free: Dict[int, set] = {k: set() for k in range(self._min_k, self._max_k + 1)}
+        self._free[self._max_k].add(0)
+        self._leased: Dict[int, int] = {}  # offset -> block log2
+        self._next_rid = 1
+        self._closed = False
+        with ArenaSlab._OPEN_LOCK:
+            ArenaSlab._OPEN[id(self)] = self
+        self._set_gauges()
+
+    # -- accounting ----------------------------------------------------------
+
+    def _reg(self):
+        from .utils import metrics
+
+        return metrics.registry()
+
+    def _set_gauges(self) -> None:
+        # the gauges are process-global: aggregate over every OPEN slab
+        # so two live slabs (two pools, or a standalone slab beside a
+        # pool's) don't clobber each other, and closing one slab
+        # doesn't zero out the bytes another still has mapped
+        with ArenaSlab._OPEN_LOCK:
+            slabs = list(ArenaSlab._OPEN.values())
+        reg = self._reg()
+        reg.gauge("sidecar.pool.slab_bytes").set(sum(s.size for s in slabs))
+        reg.gauge("sidecar.pool.slab_regions").set(
+            sum(s.outstanding for s in slabs)
+        )
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._leased)
+
+    def leased_bytes(self) -> int:
+        with self._lock:
+            return sum(1 << k for k in self._leased.values())
+
+    # -- lease / release -----------------------------------------------------
+
+    def lease(self, nbytes: int) -> ArenaRegion:
+        """Lease a region able to hold an ``nbytes`` payload (plus the
+        32-byte header), rounded up to the block's power-of-two size
+        class. Exhaustion — or a payload larger than the whole slab —
+        raises retryably with a RESOURCE_EXHAUSTED marker so the retry
+        orchestrator's split path engages."""
+        from .utils.errors import RetryableError
+
+        need = int(nbytes) + REGION_HDR_LEN
+        k = max(need.bit_length() - 1, self._min_k)
+        if (1 << k) < need:
+            k += 1
+        with self._lock:
+            if self._closed:
+                raise ValueError("lease on a closed arena slab")
+            if k > self._max_k:
+                raise RetryableError(
+                    f"sidecar pool: RESOURCE_EXHAUSTED: a {nbytes}-byte "
+                    f"request (need {need}) exceeds the {self.size}-byte "
+                    "arena slab — split the batch or raise "
+                    "SRJT_ARENA_SLAB_BYTES"
+                )
+            off = self._alloc_locked(k)
+            if off is None:
+                raise RetryableError(
+                    f"sidecar pool: RESOURCE_EXHAUSTED: arena slab "
+                    f"exhausted ({nbytes} bytes requested, "
+                    f"{len(self._leased)} regions leased) — release "
+                    "regions, split the batch, or raise "
+                    "SRJT_ARENA_SLAB_BYTES"
+                )
+            self._leased[off] = k
+            rid = self._next_rid
+            self._next_rid += 1
+        reg = self._reg()
+        reg.counter("sidecar.pool.region_leases").inc()
+        # delta update, NOT _set_gauges(): re-aggregating every open
+        # slab (global lock + per-slab locks) on the per-op hot path
+        # would re-serialize exactly the traffic the slab exists to
+        # parallelize; full recomputes happen only at slab open/close
+        reg.gauge("sidecar.pool.slab_regions").inc()
+        return ArenaRegion(self, off, (1 << k) - REGION_HDR_LEN, rid)
+
+    def _alloc_locked(self, k: int) -> Optional[int]:
+        j = k
+        while j <= self._max_k and not self._free[j]:
+            j += 1
+        if j > self._max_k:
+            return None
+        off = self._free[j].pop()
+        while j > k:  # buddy split down to the requested class
+            j -= 1
+            self._free[j].add(off + (1 << j))
+        return off
+
+    def _release(self, region: ArenaRegion) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            k = self._leased.pop(region.offset, None)
+            if k is None:
+                return
+            off = region.offset
+            while k < self._max_k:  # buddy coalescing
+                buddy = off ^ (1 << k)
+                if buddy not in self._free[k]:
+                    break
+                self._free[k].discard(buddy)
+                off = min(off, buddy)
+                k += 1
+            self._free[k].add(off)
+        reg = self._reg()
+        reg.counter("sidecar.pool.region_releases").inc()
+        reg.gauge("sidecar.pool.slab_regions").inc(-1)  # hot path: delta, see lease()
+
+    # -- teardown ------------------------------------------------------------
+
+    def close(self) -> int:
+        """munmap + close the memfd. Returns the number of regions that
+        were still leased (force-released, counted
+        ``sidecar.pool.region_leaks``) — zero in a leak-free run, the
+        invariant tests/conftest.py asserts."""
+        with self._lock:
+            if self._closed:
+                return 0
+            self._closed = True
+            leaks = len(self._leased)
+            self._leased.clear()
+        if leaks:
+            self._reg().counter("sidecar.pool.region_leaks").inc(leaks)
+            from .utils import metrics
+
+            metrics.event("sidecar.pool.region_leak", count=leaks)
+        self._mm.close()
+        os.close(self.fd)
+        with ArenaSlab._OPEN_LOCK:
+            ArenaSlab._OPEN.pop(id(self), None)
+        self._set_gauges()
+        return leaks
+
+
+def open_slab_count() -> int:
+    """Open (un-closed) slabs in this process — the leak tripwire the
+    test harness checks at session end."""
+    with ArenaSlab._OPEN_LOCK:
+        return len(ArenaSlab._OPEN)
+
+
+def arena_leak_report() -> List[str]:
+    """Human-readable description of every open slab (empty when the
+    teardown discipline held)."""
+    with ArenaSlab._OPEN_LOCK:
+        slabs = list(ArenaSlab._OPEN.values())
+    return [
+        f"slab of {s.size} bytes with {s.outstanding} leased regions"
+        for s in slabs
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the supervised pool
+# ---------------------------------------------------------------------------
 
 
 class _Worker:
@@ -122,11 +444,14 @@ class _Worker:
 
 class SidecarPool:
     """Supervised pool of sidecar workers with health-checked routing,
-    automatic respawn, arena re-hydration, and pool-scoped breaker
+    automatic respawn, slab re-hydration, and pool-scoped breaker
     accounting. ``call()`` is the public entry — same contract as
     ``SupervisedClient.call`` (results keep flowing: device path first,
     retry across workers, host engine as the floor), with worker death
-    downgraded from "permanent degrade" to "one failover"."""
+    downgraded from "permanent degrade" to "one failover". The arena
+    data plane is ``lease()`` + ``call(op, region=...)`` (or the
+    one-shot ``call_arena``): per-request regions, so concurrent
+    arena-resident ops on distinct workers genuinely overlap."""
 
     def __init__(
         self,
@@ -136,6 +461,7 @@ class SidecarPool:
         env: Optional[dict] = None,
         startup_timeout_s: float = 60.0,
         spawn_fn=spawn_worker,
+        slab_bytes: Optional[int] = None,
     ):
         if size is None:
             size = _env_int("SRJT_SIDECAR_POOL_SIZE", 1)
@@ -153,18 +479,15 @@ class SidecarPool:
         self._respawn_delay_s = env_float(
             os.environ, "SRJT_POOL_RESPAWN_DELAY_S", 0.5
         )
+        self._slab_bytes = slab_bytes
         self._lock = threading.RLock()
-        # one shared arena => one in-flight arena op: the request bytes
-        # at arena[0:len] and the response that replaces them are a
-        # critical section across workers
-        self._arena_io_lock = threading.Lock()
         self._rr = 0
         self._closed = False
-        # client-side arena replay state: ONE memfd shared by every
-        # worker (they all map the same pages), surviving any of them
-        self._arena_fd: Optional[int] = None
-        self._arena_size = 0
-        self._arena_mm: Optional[mmap.mmap] = None
+        # the slab-arena data plane: ONE memfd shared by every worker
+        # (they all map the same pages), surviving any of them; regions
+        # are leased per request, so the only pool-wide arena state is
+        # the allocator
+        self._slab: Optional[ArenaSlab] = None
         self._workers = [_Worker(i) for i in range(self.size)]
         try:
             for w in self._workers:
@@ -204,14 +527,15 @@ class SidecarPool:
         w.alive = True
 
     def shutdown(self) -> None:
-        """Terminate every worker and release the arena. Idempotent.
-        Joins in-flight respawn threads FIRST (bounded by one spawn
-        attempt): a daemon respawner killed at interpreter exit while
-        inside spawn_fn orphans its half-born worker — the child would
-        outlive the pool, holding the chip and (if stdio is a pipe) the
-        parent's readers. Once ``_closed`` is set the respawner reaps
-        whatever it spawned and returns, so after the join every live
-        proc is in a slot where the sweep below can reach it."""
+        """Terminate every worker and release the slab (every region
+        munmapped; leaked leases counted). Idempotent. Joins in-flight
+        respawn threads FIRST (bounded by one spawn attempt): a daemon
+        respawner killed at interpreter exit while inside spawn_fn
+        orphans its half-born worker — the child would outlive the
+        pool, holding the chip and (if stdio is a pipe) the parent's
+        readers. Once ``_closed`` is set the respawner reaps whatever
+        it spawned and returns, so after the join every live proc is in
+        a slot where the sweep below can reach it."""
         with self._lock:
             self._closed = True
             workers = list(self._workers)
@@ -237,16 +561,22 @@ class SidecarPool:
                 except OSError:
                     pass
             w.alive = False
-        if self._arena_mm is not None:
-            self._arena_mm.close()
-            self._arena_mm = None
-        if self._arena_fd is not None:
-            os.close(self._arena_fd)
-            self._arena_fd = None
-            from . import memgov
-
-            memgov.catalog().unregister("sidecar.pool.arena")
+        self._close_slab()
         self._set_gauges()
+
+    def _close_slab(self) -> None:
+        # detach AND unregister in one critical section: unregistering
+        # after dropping the lock races a concurrent ensure_slab()
+        # registering its fresh slab — that registration would be the
+        # one deleted, leaving live pinned pages invisible to memgov
+        with self._lock:
+            slab, self._slab = self._slab, None
+            if slab is not None:
+                from . import memgov
+
+                memgov.catalog().unregister("sidecar.pool.arena")
+        if slab is not None:
+            slab.close()
 
     def __enter__(self):
         return self
@@ -341,11 +671,13 @@ class SidecarPool:
                     heartbeat_s=self._heartbeat_s,
                 )
                 w.spawns += 1
-                has_arena = self._arena_fd is not None
+                has_arena = self._slab is not None
             # state re-hydration OUTSIDE the pool lock (a wedged
             # replacement answering SET_ARENA slowly must not stall
             # routing to the survivors); nobody routes to this slot
-            # until alive flips below, so its socket is private here
+            # until alive flips below, so its socket is private here.
+            # The slab memfd is the SAME pages every other worker maps,
+            # region headers included — the slab map IS the state.
             try:
                 if has_arena:
                     self._send_arena(w)
@@ -383,17 +715,21 @@ class SidecarPool:
         self,
         op: int,
         payload: bytes,
-        arena_len: Optional[int],
-        arena_req: Optional[bytes] = None,
+        region: Optional[ArenaRegion],
+        region_req: Optional[bytes] = None,
     ):
         """One routed exchange — the unit the retry orchestrator
         re-runs. Worker death re-raises retryably AFTER marking the
         slot dead, so the next attempt routes around the corpse: that
-        re-route IS the failover. Arena requests REWRITE the request
-        bytes (``arena_req``, snapshotted by ``call``) into the shared
-        mapping first: the protocol answers at arena offset 0, so a
-        prior attempt's (possibly partial) response must never be what
-        the retry re-sends."""
+        re-route IS the failover. Region requests REWRITE the request
+        bytes (``region_req``, snapshotted by ``call``) into the leased
+        region first, under a fresh generation: the worker answers into
+        the same region, so a prior attempt's (possibly partial)
+        response must never be what the retry re-sends — and a worker
+        still holding the old generation gets a retryable desync, not
+        stale bytes. Only the target worker's ``io_lock`` serializes:
+        two region ops on two workers genuinely overlap (the whole
+        point of the slab)."""
         from .utils.errors import DataCorruption, RetryableError
 
         w = self._pick()
@@ -403,26 +739,15 @@ class SidecarPool:
                 f"(size={self.size}; respawn in progress or exhausted)"
             )
         try:
-            if arena_len is None and self._arena_mm is None:
-                # io_lock: one frame at a time on the slot's single
-                # supervised connection (concurrent calls may route here)
-                with w.io_lock:
-                    return w.client.request(op, payload)
-            # one shared arena => one in-flight op POOL-wide once it
-            # exists: every worker maps the same pages and the protocol
-            # opportunistically answers ANY fitting response through
-            # them, so even a stream op on one worker would clobber an
-            # arena op in flight on another — correctness over
-            # concurrency here (arena-less pools keep per-slot routing)
-            with self._arena_io_lock, w.io_lock:
-                if arena_len is None:
+            with w.io_lock:
+                if region is None:
                     return w.client.request(op, payload)
                 # worker-side arena state is per-CONNECTION: replay
                 # SET_ARENA if the client reconnected since the last
                 # upload (timeout redial, desync close, respawn)
                 self._ensure_arena(w)
-                self._arena_mm[:arena_len] = arena_req
-                return w.client.request(op, b"", arena_len=arena_len)
+                region.write(region_req)
+                return w.client.request(op, b"", region=region)
         except DataCorruption:
             # a corrupted FRAME is not a dead WORKER: the transport
             # round-tripped, the payload rotted. Retry re-sends; the
@@ -454,7 +779,8 @@ class SidecarPool:
             )
         )
 
-    def call(self, op: int, payload: bytes = b"", arena_len: Optional[int] = None) -> bytes:
+    def call(self, op: int, payload: bytes = b"",
+             region: Optional[ArenaRegion] = None) -> bytes:
         """Run ``op`` on the pool under the retry orchestrator: routed
         to a live worker, failed over on worker death, degraded to the
         in-process host engine only when the device path truly cannot
@@ -463,35 +789,33 @@ class SidecarPool:
         WHOLE pool dark — one crashed worker among living peers is a
         failover, invisible to the breaker.
 
-        Arena contract: write the request into the shared mapping and
-        pass ``arena_len=``; the arena is SCRATCH (responses land at
-        offset 0), so rewrite before every call. Within one call the
-        pool snapshots the request up front and replays it into the
-        arena before every retry attempt — a dead worker's partial
-        response can never be what the failover re-sends."""
+        Region contract: ``lease()`` a region, ``region.write()`` the
+        request, pass ``region=``; the response replaces the region's
+        payload. Within one call the pool snapshots the request up
+        front and replays it (fresh generation) before every retry
+        attempt — a dead worker's partial response can never be what
+        the failover re-sends."""
         from .utils import deadline as deadline_mod, metrics, retry
         from .utils.errors import DeadlineExceeded, DeviceError
 
         deadline_mod.check(f"sidecar_pool_op_{op}")
-        arena_req = None
-        if arena_len is not None:
-            if self._arena_mm is None:
-                raise ValueError(
-                    "arena_len given but no arena is set (set_arena first)"
-                )
-            # snapshot the request NOW: every attempt (and the host
-            # fallback) replays these bytes — the shared arena itself is
+        region_req = None
+        if region is not None:
+            # snapshot the request NOW, from the bytes the caller handed
+            # write() — NOT an mmap re-read, which a stale worker's
+            # straddling slab write could tear: every attempt (and the
+            # host fallback) replays these bytes; the region itself is
             # scratch the previous attempt's response may have clobbered
-            arena_req = bytes(self._arena_mm[:arena_len])
+            region_req = region.snapshot_bytes()
         br = sidecar.breaker()
         if not br.allow():
             self._host_fallback_count(op, "breaker_open")
             return sidecar._dispatch(
-                op, payload if arena_req is None else arena_req, "host-fallback"
+                op, payload if region_req is None else region_req, "host-fallback"
             )
         try:
             resp = retry.call_with_retry(
-                self._attempt, op, payload, arena_len, arena_req,
+                self._attempt, op, payload, region, region_req,
                 op_name=f"sidecar_pool_op_{op}",
             )
         except DeadlineExceeded:
@@ -511,7 +835,7 @@ class SidecarPool:
                 br.record_failure(cause=type(e).__name__)
             self._host_fallback_count(op, type(e).__name__)
             return sidecar._dispatch(
-                op, payload if arena_req is None else arena_req, "host-fallback"
+                op, payload if region_req is None else region_req, "host-fallback"
             )
         except Exception:
             br.record_success()  # semantic error: transport healthy
@@ -522,6 +846,18 @@ class SidecarPool:
         br.record_success()
         return resp
 
+    def call_arena(self, op: int, payload: bytes) -> bytes:
+        """One-shot arena-resident exchange: lease a region, place the
+        payload, run ``call``, release. The composable path is
+        ``lease()`` + ``region.write()`` + ``call(op, region=...)`` for
+        callers that reuse a region across requests."""
+        region = self.lease(len(payload))
+        try:
+            region.write(payload)
+            return self.call(op, region=region)
+        finally:
+            region.release()
+
     def _host_fallback_count(self, op: int, cause: str) -> None:
         from .utils import metrics
 
@@ -531,28 +867,47 @@ class SidecarPool:
 
     # -- the shared-memory data plane ----------------------------------------
 
-    def set_arena(self, size: int) -> mmap.mmap:
-        """Create the pool's shared arena (one memfd) and upload it to
-        every live worker. Returns the client-side mapping — write a
-        payload into it and pass ``arena_len=`` to ``call``. The memfd
-        outlives any single worker: respawns re-upload it
-        (re-hydration), so a kill -9 never strands the data plane.
-        Registered host-tier in the memgov catalog
-        (``sidecar.pool.arena``) like every other arena consumer."""
+    def lease(self, nbytes: int) -> ArenaRegion:
+        """Lease a per-request region able to hold ``nbytes``; creates
+        the slab (and uploads it to every live worker) on first use.
+        Exhaustion raises retryably (RESOURCE_EXHAUSTED) so the split
+        machinery engages."""
+        # lease off the slab ensure_slab RETURNED — re-reading
+        # self._slab here races a concurrent set_arena()/shutdown()
+        # nulling it (a closed slab raises cleanly; None would not)
+        return self.ensure_slab(min_bytes=0).lease(nbytes)
+
+    def ensure_slab(self, min_bytes: int = 0) -> ArenaSlab:
+        """Create the pool's slab arena if none exists — sized
+        ``max(SRJT_ARENA_SLAB_BYTES, min_bytes + header)`` AT CREATION
+        only — and upload the memfd to every live worker in slab mode.
+        An already-created slab is returned as-is regardless of
+        ``min_bytes`` (growing it would mean a re-upload to every
+        worker mid-traffic; an oversized lease instead raises
+        RESOURCE_EXHAUSTED so retry-with-split engages). Returns the
+        slab. The memfd outlives any single worker: respawns re-upload
+        it (re-hydration), so a kill -9 never strands the data plane."""
         from . import memgov
 
         with self._lock:
-            if self._arena_fd is not None:
-                self._arena_mm.close()
-                os.close(self._arena_fd)
-                memgov.catalog().unregister("sidecar.pool.arena")
-            fd = os.memfd_create("srjt-pool-arena")
-            os.ftruncate(fd, size)
-            self._arena_fd = fd
-            self._arena_size = int(size)
-            self._arena_mm = mmap.mmap(fd, size)
+            if self._slab is not None:
+                return self._slab
+            if self._closed:
+                # a lease after shutdown would mint a slab nobody ever
+                # closes (the conftest leak tripwire would catch it at
+                # session end; refuse up front instead)
+                raise ValueError("ensure_slab on a shut-down pool")
+            want = self._slab_bytes
+            if want is None:
+                want = _env_int(
+                    "SRJT_ARENA_SLAB_BYTES", _DEFAULT_SLAB_BYTES,
+                    minimum=_MIN_REGION_BYTES,
+                )
+            want = max(int(want), int(min_bytes) + REGION_HDR_LEN)
+            slab = ArenaSlab(want)
+            self._slab = slab
             memgov.catalog().register_host_bytes(
-                "sidecar.pool.arena", size, pinned=True, kind="arena"
+                "sidecar.pool.arena", slab.size, pinned=True, kind="arena"
             )
             live = [w for w in self._workers if w.alive]
         # the upload round-trips run OUTSIDE the pool lock (a slow
@@ -563,29 +918,60 @@ class SidecarPool:
                     self._send_arena(w)
             except Exception as e:
                 self._on_worker_failure(w, e)
-        return self._arena_mm
+        return slab
+
+    def set_arena(self, size: int) -> ArenaSlab:
+        """Create — or REPLACE — the pool's slab arena at ``size``
+        bytes (rounded up to a power of two) and upload it to every
+        live worker. Replacing releases and munmaps the old slab first;
+        a replace with regions still leased is a caller bug and raises
+        (the old pages are about to vanish under those leases)."""
+        # outstanding-check and slab detach must be ONE critical
+        # section: dropping the lock between them lets a concurrent
+        # lease() slip in and get its region munmapped out from under
+        # it (counted as a region leak it never caused)
+        with self._lock:
+            slab = self._slab
+            if slab is not None and slab.outstanding:
+                raise ValueError(
+                    "set_arena: cannot replace a slab with "
+                    f"{slab.outstanding} regions still leased"
+                )
+            self._slab = None
+            self._slab_bytes = int(size)
+            if slab is not None:
+                # unregister INSIDE the critical section, like
+                # _close_slab — outside it, a concurrent ensure_slab's
+                # fresh registration would be the one deleted
+                from . import memgov
+
+                memgov.catalog().unregister("sidecar.pool.arena")
+        if slab is not None:
+            slab.close()
+        return self.ensure_slab()
 
     def _send_arena(self, w: _Worker) -> None:
-        """OP_SET_ARENA with the pool memfd over SCM_RIGHTS on the
+        """OP_SET_ARENA with the slab memfd over SCM_RIGHTS on the
         worker's supervised socket (legacy framing: the fd transfer is
-        control plane, 8 payload bytes — nothing for a CRC to protect
-        that the OK/err status doesn't already say). Records WHICH
-        socket carried the upload (worker-side arena state is
-        per-connection) and hands the client the mapping so it can read
-        arena-flagged responses."""
+        control plane — 16 payload bytes, size + slab mode word).
+        Records WHICH socket carried the upload (worker-side arena
+        state is per-connection)."""
         import array
         import socket as socket_mod
 
         c = w.client
         if c._sock is None:
             c.connect()
-        hdr = struct.pack("<IQ", OP_SET_ARENA, 8) + struct.pack("<Q", self._arena_size)
+        slab = self._slab
+        hdr = struct.pack("<IQ", OP_SET_ARENA, 16) + struct.pack(
+            "<QQ", slab.size, ARENA_MODE_SLAB
+        )
         c._sock.sendmsg(
             [hdr],
             [(
                 socket_mod.SOL_SOCKET,
                 socket_mod.SCM_RIGHTS,
-                array.array("i", [self._arena_fd]).tobytes(),
+                array.array("i", [slab.fd]).tobytes(),
             )],
         )
         status, rlen = struct.unpack("<IQ", sidecar._recv_exact(c._sock, 12))
@@ -597,14 +983,13 @@ class SidecarPool:
                 f"sidecar pool: SET_ARENA failed on w{w.wid}: "
                 f"{body.decode('utf-8', 'replace')}"
             )
-        c.arena_mm = self._arena_mm
         w.arena_conn = c._sock
 
     def _ensure_arena(self, w: _Worker) -> None:
         """Replay SET_ARENA when the supervised connection is not the
         one that carried the last upload — a timeout redial, a desync
         close, or a fresh client all silently dropped the worker-side
-        mapping, and an arena op on such a connection would error (or
+        mapping, and a region op on such a connection would error (or
         worse, a stale client would trust stale pages)."""
         c = w.client
         if c._sock is not None and c._sock is w.arena_conn:
@@ -621,6 +1006,7 @@ class SidecarPool:
         """JSON-clean pool state for runtime.stats_report()."""
         reg = self._reg()
         with self._lock:
+            slab = self._slab
             return {
                 "size": self.size,
                 "live": self.live_count(),
@@ -637,7 +1023,10 @@ class SidecarPool:
                 "respawns": reg.value("sidecar.pool.respawns"),
                 "rehydrations": reg.value("sidecar.pool.rehydrations"),
                 "host_fallbacks": reg.value("sidecar.pool.host_fallbacks"),
-                "arena_bytes": self._arena_size if self._arena_fd is not None else 0,
+                "arena_bytes": 0 if slab is None else slab.size,
+                "slab_regions": 0 if slab is None else slab.outstanding,
+                "region_leases": reg.value("sidecar.pool.region_leases"),
+                "region_leaks": reg.value("sidecar.pool.region_leaks"),
             }
 
     def worker_stats(self, fold: bool = True) -> Dict[str, dict]:
@@ -654,10 +1043,10 @@ class SidecarPool:
             if not w.alive or w.client is None:
                 continue
             try:
-                # same lock discipline as _attempt: once a shared arena
-                # exists the worker may answer THROUGH it, so a STATS
-                # poll must not interleave with an in-flight data op
-                with self._arena_io_lock, w.io_lock:
+                # one frame at a time on the slot's supervised
+                # connection; slab regions are private per request, so
+                # a STATS poll never clobbers an in-flight data op
+                with w.io_lock:
                     stats = w.client.worker_stats(fold=False)
             except RetryableError:
                 continue  # died between the liveness check and the poll

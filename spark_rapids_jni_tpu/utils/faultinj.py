@@ -8,7 +8,10 @@ sit. Feature parity:
 
 - JSON config (reference: FAULT_INJECTOR_CONFIG_PATH, :80, :346-408),
   env var ``SRJT_FAULTINJ_CONFIG`` or programmatic ``configure()``,
-- match by exact op name or ``"*"`` wildcard (:142-152),
+- match by exact op name, a ``"prefix.*"`` rule (longest prefix wins —
+  keys a whole choke-point family, e.g. ``"exchange.*"`` covers
+  ``exchange.serve`` and ``exchange.frame``), or the ``"*"`` wildcard
+  (:142-152),
 - injection types: ``fatal`` (FatalDeviceError — the trap/assert
   analog, :135-140), ``retryable`` (RetryableError), ``exception``
   (plain RuntimeError — the FI_RETURN_VALUE analog), ``delay``
@@ -190,7 +193,20 @@ def _draw_locked(op_name: str, corrupt: bool):
     budget on a ``maybe_inject`` dispatch (its choke point is the
     payload producer), and vice versa."""
     _reload_if_changed()
-    rule = _state.rules.get(op_name) or _state.rules.get("*")
+    rule = _state.rules.get(op_name)
+    if rule is None:
+        # "prefix.*" family rules: longest matching prefix wins, the
+        # bare "*" wildcard is the floor
+        best_len = -1
+        for key, r in _state.rules.items():
+            if (
+                key.endswith(".*")
+                and op_name.startswith(key[:-1])
+                and len(key) > best_len
+            ):
+                rule, best_len = r, len(key)
+        if rule is None:
+            rule = _state.rules.get("*")
     if rule is None:
         return None
     if (rule.kind == "corrupt") != corrupt:

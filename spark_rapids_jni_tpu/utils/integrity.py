@@ -12,10 +12,12 @@ data-at-rest / data-in-flight boundary shares:
   header (the ``CRC_FLAG`` high bit of op/status marks its presence,
   negotiated per frame so the native C++ client — which never sets the
   bit — keeps its existing framing),
-- memgov disk spills (memgov/catalog.py): every spill file is written
-  as a framed container (magic + CRC + length + npz payload) and
-  verified on re-materialization,
-- shuffle exchanges (parallel/shuffle.py): an order-independent
+- the columnar frame codec (columnar/frames.py, ISSUE 6): every frame
+  carries a header CRC plus one CRC per column/leaf payload, all drawn
+  from and verified through this helper — wire tables, memgov disk
+  spills (legacy SRJTSPL1 npz envelopes still verify through their
+  original path), and TCP shuffle exchange partitions share it,
+- in-mesh shuffle exchanges (parallel/shuffle.py): an order-independent
   payload checksum over the bytes entering and leaving the all-to-all
   (row order changes across the exchange, byte MULTISET must not).
 
@@ -197,4 +199,9 @@ def stats_section() -> dict:
         "frames_checked": reg.value("sidecar.integrity.frames_checked"),
         "spills_checked": reg.value("sidecar.integrity.spills_checked"),
         "exchanges_checked": reg.value("sidecar.integrity.exchanges_checked"),
+        # columnar frame codec decodes that ran with verification
+        # (columnar/frames.py — wire tables, spills, TCP exchanges)
+        "frame_decodes_checked": reg.value(
+            "sidecar.integrity.frame_decodes_checked"
+        ),
     }

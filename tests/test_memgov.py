@@ -223,10 +223,44 @@ class TestCatalog:
         assert h.tier == memgov.TIER_DISK
         assert cat.disk_bytes() == h.nbytes and cat.host_bytes() == 0
         files = os.listdir(tmp_path)
-        assert len(files) == 1 and files[0].endswith(".npz")
+        # spill containers are versioned columnar frames as of ISSUE 6
+        assert len(files) == 1 and files[0].endswith(".frm")
+        from spark_rapids_jni_tpu.columnar import frames
+
+        with open(os.path.join(tmp_path, files[0]), "rb") as f:
+            assert frames.is_frame(f.read(len(frames.MAGIC)))
         assert _tree_bytes(h.get()) == want
         assert h.tier == memgov.TIER_DEVICE
         assert os.listdir(tmp_path) == []  # spill file reclaimed
+
+    def test_legacy_spill_containers_still_load(self, tmp_path):
+        """ISSUE 6 migration: spill files written BEFORE the columnar
+        frame layout — the SRJTSPL1 CRC envelope around npz, and plain
+        unframed npz — must still re-materialize bit-exactly through
+        their original read paths."""
+        import io
+
+        from spark_rapids_jni_tpu.memgov.catalog import _SPILL_MAGIC
+        from spark_rapids_jni_tpu.utils import integrity
+
+        for kind in ("envelope", "plain"):
+            cat = memgov.BufferCatalog(spill_dir=str(tmp_path))
+            val = _adversarial_leaves()
+            want = _tree_bytes(val)
+            h = cat.register(f"legacy-{kind}", val)
+            leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(val)]
+            h.spill(to_disk=True)
+            # overwrite the fresh .frm with the pre-ISSUE-6 container
+            buf = io.BytesIO()
+            np.savez(buf, **{f"a{i}": leaf for i, leaf in enumerate(leaves)})
+            blob = buf.getvalue()
+            with open(h._disk_path, "wb") as f:
+                if kind == "envelope":
+                    f.write(_SPILL_MAGIC)
+                    f.write(integrity.pack_crc(integrity.checksum(blob)))
+                    f.write(len(blob).to_bytes(8, "little"))
+                f.write(blob)
+            assert _tree_bytes(h.get()) == want, kind
 
     def test_table_round_trip_bit_exact(self):
         cat = memgov.BufferCatalog()

@@ -620,71 +620,97 @@ class TestPoolFailover:
     def test_arena_rehydration_on_respawn(self, inproc_pool):
         payload = _groupby_payload()
         want = sidecar._dispatch(sidecar.OP_GROUPBY_SUM_F32, payload, "cpu")
-        mm = inproc_pool.set_arena(1 << 20)
-        mm[: len(payload)] = payload
+        inproc_pool.set_arena(1 << 20)
         rehydr0 = _counter("sidecar.pool.rehydrations")
         with retry.enabled(max_attempts=6, base_delay_ms=1):
-            assert inproc_pool.call(
-                sidecar.OP_GROUPBY_SUM_F32, arena_len=len(payload)
+            assert inproc_pool.call_arena(
+                sidecar.OP_GROUPBY_SUM_F32, payload
             ) == want
             victim = inproc_pool._workers[inproc_pool._rr % inproc_pool.size]
             victim.proc.kill()
-            # the arena is scratch (responses land at offset 0): the
-            # caller rewrites its request per call, and the POOL's
-            # per-call snapshot replays it across failover attempts
-            mm[: len(payload)] = payload
-            assert inproc_pool.call(
-                sidecar.OP_GROUPBY_SUM_F32, arena_len=len(payload)
+            # the region is scratch (the response replaces the request
+            # payload): the POOL's per-call snapshot replays the request
+            # bytes under a fresh generation across failover attempts
+            assert inproc_pool.call_arena(
+                sidecar.OP_GROUPBY_SUM_F32, payload
             ) == want
         assert inproc_pool.wait_healthy(20)
         assert _counter("sidecar.pool.rehydrations") == rehydr0 + 1
-        # the respawned worker serves arena traffic (state re-uploaded)
+        # the respawned worker serves region traffic (slab re-uploaded)
         with retry.enabled(max_attempts=6, base_delay_ms=1):
             for _ in range(2):
-                mm[: len(payload)] = payload
-                assert inproc_pool.call(
-                    sidecar.OP_GROUPBY_SUM_F32, arena_len=len(payload)
+                assert inproc_pool.call_arena(
+                    sidecar.OP_GROUPBY_SUM_F32, payload
                 ) == want
 
-    def test_stream_ops_work_after_set_arena(self, inproc_pool):
-        """Once a connection has an arena the worker opportunistically
-        answers THROUGH it even for stream requests (header-only
-        ARENA_FLAG frame) — the client must read those from its mapping
-        instead of blocking on body bytes that never cross the socket."""
+    def test_stream_ops_work_after_slab_arena(self, inproc_pool):
+        """Slab-mode connections never answer STREAM ops through the
+        arena (that opportunism is what serialized the whole pool):
+        stream requests keep streaming after the slab exists, and the
+        responses arrive promptly on the socket."""
         payload = _groupby_payload()
         want = sidecar._dispatch(sidecar.OP_GROUPBY_SUM_F32, payload, "cpu")
-        inproc_pool.set_arena(1 << 20)
+        inproc_pool.ensure_slab()
         t0 = time.monotonic()
         with retry.enabled(max_attempts=4, base_delay_ms=1):
             for _ in range(3):
                 assert inproc_pool.call(sidecar.OP_GROUPBY_SUM_F32, payload) == want
-        assert time.monotonic() - t0 < 5, "stream op stalled on an arena reply"
+        assert time.monotonic() - t0 < 5, "stream op stalled after slab upload"
 
     def test_arena_survives_client_reconnect(self, inproc_pool):
         """Worker-side arena state is per-connection: a client redial
         (timeout, desync close) silently drops it, so the pool must
-        replay SET_ARENA on the fresh connection — an arena op after a
+        replay SET_ARENA on the fresh connection — a region op after a
         reconnect stays on the device path, never a host fallback."""
         payload = _groupby_payload()
         want = sidecar._dispatch(sidecar.OP_GROUPBY_SUM_F32, payload, "cpu")
-        mm = inproc_pool.set_arena(1 << 20)
+        inproc_pool.ensure_slab()
         rehydr0 = _counter("sidecar.pool.rehydrations")
         fallbacks0 = _counter("sidecar.pool.host_fallbacks")
         with retry.enabled(max_attempts=4, base_delay_ms=1):
-            mm[: len(payload)] = payload
-            assert inproc_pool.call(
-                sidecar.OP_GROUPBY_SUM_F32, arena_len=len(payload)
+            assert inproc_pool.call_arena(
+                sidecar.OP_GROUPBY_SUM_F32, payload
             ) == want
             # force redials on every slot WITHOUT killing any worker
             for w in inproc_pool._workers:
                 w.client.close()
-            mm[: len(payload)] = payload
-            assert inproc_pool.call(
-                sidecar.OP_GROUPBY_SUM_F32, arena_len=len(payload)
+            assert inproc_pool.call_arena(
+                sidecar.OP_GROUPBY_SUM_F32, payload
             ) == want
         assert _counter("sidecar.pool.rehydrations") == rehydr0 + 1
         assert _counter("sidecar.pool.host_fallbacks") == fallbacks0
         assert inproc_pool.live_count() == 2  # nobody was declared dead
+
+    def test_oversized_region_write_is_retryable_with_needed_size(
+        self, inproc_pool
+    ):
+        """ISSUE 6 satellite: a request larger than its leased region
+        raises RetryableError carrying the needed size (and the
+        RESOURCE_EXHAUSTED marker retry-with-split keys on) — never a
+        silent truncated write."""
+        region = inproc_pool.lease(64)
+        try:
+            with pytest.raises(RetryableError, match="RESOURCE_EXHAUSTED") as ei:
+                region.write(b"x" * (region.capacity + 1))
+            assert str(region.capacity + 1) in str(ei.value)  # needed size
+            assert retry.is_resource_exhausted(ei.value)  # split engages
+        finally:
+            region.release()
+
+    def test_legacy_arena_len_overflow_is_retryable(self):
+        """The SupervisedClient legacy single-buffer path enforces the
+        same contract: arena_len beyond the mapped arena raises
+        retryably with the needed size instead of ValueError."""
+        import mmap as mmap_mod
+
+        client = sidecar.SupervisedClient("/nonexistent.sock", deadline_s=1)
+        client.arena_mm = mmap_mod.mmap(-1, 4096)
+        try:
+            with pytest.raises(RetryableError, match="RESOURCE_EXHAUSTED"):
+                client._raw_request(sidecar.OP_PING, b"", arena_len=8192)
+        finally:
+            client.arena_mm.close()
+            client.arena_mm = None
 
     def test_shutdown_joins_inflight_respawn_and_reaps(self):
         """shutdown() during an in-flight respawn must JOIN the
