@@ -21,10 +21,21 @@ EOF
 ./native/build/jni_harness ./native/build/libsrjt_jnitest.so \
   /tmp/srjt_jni_harness.parquet 1000
 
+# correctness-tooling tier (ISSUE 7, layer 1): srjt-lint must be clean
+# — undeclared/undocumented SRJT knobs, taxonomy-violating raises,
+# unsuppressed broad excepts, stub-pattern regressions, and blind
+# blocking calls all fail the merge here, before any test runs
+python -m spark_rapids_jni_tpu.analysis.lint
+
 # fast tier: the measured heavy tail (tests/conftest.py _SLOW_TESTS)
 # runs nightly (ci/nightly.sh); this keeps the premerge gate usable on
-# a 1-core box (VERDICT r3 item 9)
-python -m pytest tests/ -q -m "not slow"
+# a 1-core box (VERDICT r3 item 9). SRJT_LOCKDEP=1 (ISSUE 7, layer 2)
+# arms the lock-order instrumentation so every concurrency test in the
+# tier doubles as a deadlock probe; each process (incl. spawned sidecar
+# workers, which inherit the env) drops artifacts/lockdep/
+# lockdep_<pid>.json at exit, merged and gated after the chaos tiers.
+rm -rf artifacts/lockdep
+SRJT_LOCKDEP=1 python -m pytest tests/ -q -m "not slow"
 
 # robustness + observability tier: the chaos suite re-runs the
 # end-to-end distributed pipeline under the storm profile (retryable +
@@ -39,7 +50,7 @@ python -m pytest tests/ -q -m "not slow"
 # artifact next to the BENCH rows.
 mkdir -p artifacts
 rm -f artifacts/chaos_metrics.jsonl
-SRJT_FAULTINJ_CONFIG=ci/chaos_storm.json SRJT_RETRY_ENABLED=1 \
+SRJT_LOCKDEP=1 SRJT_FAULTINJ_CONFIG=ci/chaos_storm.json SRJT_RETRY_ENABLED=1 \
   SRJT_RETRY_MAX_ATTEMPTS=10 SRJT_RETRY_BASE_DELAY_MS=1 \
   SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/chaos_metrics.jsonl \
@@ -61,7 +72,7 @@ EOF
 # gate. Runs the full deadline suite: budget propagation, backoff
 # truncation, breaker open->half-open->closed, spawn reaping, and the
 # storm acceptance test (which honors these env knobs).
-timeout -k 10 600 env SRJT_FAULTINJ_CONFIG=ci/chaos_hang.json \
+timeout -k 10 600 env SRJT_LOCKDEP=1 SRJT_FAULTINJ_CONFIG=ci/chaos_hang.json \
   SRJT_DEADLINE_SEC=3 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 \
@@ -77,7 +88,7 @@ timeout -k 10 600 env SRJT_FAULTINJ_CONFIG=ci/chaos_hang.json \
 # memgov.spill volume is the artifact contract, mirroring the
 # chaos_metrics.jsonl gate above.
 rm -f artifacts/memgov_events.jsonl
-SRJT_DEVICE_MEMORY_BUDGET=400000 SRJT_SPILL_ENABLED=1 \
+SRJT_LOCKDEP=1 SRJT_DEVICE_MEMORY_BUDGET=400000 SRJT_SPILL_ENABLED=1 \
   SRJT_RETRY_ENABLED=0 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/memgov_events.jsonl \
   python -m pytest tests/test_memgov.py -q
@@ -103,7 +114,7 @@ EOF
 # sidecar.integrity.crc_mismatch (corruptions caught) are the artifact
 # contract, with zero test failures above them.
 rm -f artifacts/crash_metrics.jsonl
-timeout -k 10 900 env SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/crash_metrics.jsonl \
   python -m pytest tests/test_sidecar_pool.py -q
@@ -137,7 +148,7 @@ EOF
 # contract. The session-scoped slab-leak assertion in tests/conftest.py
 # rides every pytest invocation in this file.
 rm -f artifacts/data_plane_metrics.jsonl
-timeout -k 10 900 env SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/data_plane_metrics.jsonl \
   python -m pytest tests/test_data_plane.py -q
@@ -150,6 +161,24 @@ assert "integrity.crc_mismatch" in kinds, "no frame corruption caught"
 assert "exchange.peer_respawn" in kinds, "no peer crash/respawn recorded"
 print(f"archived {len(lines)} data-plane events -> "
       "artifacts/data_plane_metrics.jsonl")
+EOF
+
+# lockdep gate (ISSUE 7, layer 2): merge every per-process report the
+# armed tiers above dropped (fast tier + all four chaos tiers, incl.
+# spawned sidecar/exchange workers — the env rides into children) and
+# fail on any lock-order cycle or self-deadlock. The merged graph is
+# archived as artifacts/lockdep_report.json; blocking-while-locked
+# events are reported but advisory (the deadline tier owns that risk).
+python -m spark_rapids_jni_tpu.analysis.lockdep \
+  --merge artifacts/lockdep --out artifacts/lockdep_report.json
+python - <<'EOF'
+import json
+rep = json.load(open("artifacts/lockdep_report.json"))
+assert rep["reports"] > 0, "lockdep armed but no process wrote a report"
+assert not rep["cycles"] and not rep["self_deadlocks"], rep["cycles"]
+assert not rep["site_cycles"], rep["site_cycles"]  # cross-process inversions
+print(f"lockdep: {rep['reports']} reports, {len(rep['locks'])} lock sites, "
+      f"{len(rep['edges'])} edges, 0 cycles -> artifacts/lockdep_report.json")
 EOF
 
 # pool-scaling gate (ISSUE 6 acceptance): arena-resident ops/s at pool

@@ -12,6 +12,13 @@ int64 lanes are required throughout (Spark longs, DECIMAL64, JCUDF row
 offsets), so x64 mode is enabled at import, before any tracing happens.
 """
 
+import os as _os
+
+if _os.environ.get("SRJT_LOCKDEP", "").lower() in ("1", "true", "yes"):  # srjt-lint: allow-environ(bootstrap: lockdep must patch threading before ANY package module creates a lock; importing utils.knobs here would import the whole utils tree first)
+    from .analysis import lockdep as _lockdep
+
+    _lockdep.install()
+
 import jax
 
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,21 @@
+"""Correctness tooling for the concurrent substrate (ISSUE 7).
+
+Two layers, both gated in ci/premerge.sh:
+
+- ``lint.py`` — ``srjt-lint``, an AST static pass (stdlib ``ast``, no
+  new deps) enforcing the repo's hand-enforced invariants: the central
+  knob registry (utils/knobs.py), the error-taxonomy raise/except
+  discipline, the metrics/spill hot-path stub pattern, and deadline
+  cooperation for blocking calls. Run as
+  ``python -m spark_rapids_jni_tpu.analysis.lint``.
+- ``lockdep.py`` — opt-in (``SRJT_LOCKDEP=1``) runtime lock-order
+  instrumentation over ``threading.Lock/RLock/Condition``: per-thread
+  acquisition stacks, the global lock-order graph, cycle (potential
+  deadlock) and blocking-while-locked reporting as a JSON artifact at
+  process exit. Merge/gate the per-process reports with
+  ``python -m spark_rapids_jni_tpu.analysis.lockdep``.
+
+This package must stay import-light (stdlib only at import time): the
+package ``__init__`` installs lockdep BEFORE any other module — and so
+before any package lock exists — when the knob is armed.
+"""

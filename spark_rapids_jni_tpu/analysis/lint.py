@@ -1,0 +1,565 @@
+"""``srjt-lint``: the invariant lint suite for the concurrent substrate
+(ISSUE 7 layer 1; stdlib ``ast`` only, no new dependencies).
+
+PRs 1-6 built five threaded subsystems whose correctness rests on
+conventions a reviewer had to re-check by hand on every change. Each
+rule here machine-checks one of them:
+
+    SRJT001 undeclared-knob      every ``SRJT_*`` string literal in the
+                                 package must be declared in the
+                                 utils/knobs.py registry (or be a knobs
+                                 SENTINEL — a stdout handshake line).
+    SRJT002 direct-environ-read  ``os.environ`` / ``os.getenv`` READS
+                                 of SRJT keys (or of dynamic keys that
+                                 cannot be proven non-SRJT) are only
+                                 legal inside utils/knobs.py — the
+                                 typed accessors are the one front
+                                 door. Non-SRJT literal keys
+                                 (PYTHONPATH, JAX_PLATFORMS) and
+                                 environ WRITES are fine.
+    SRJT003 banned-raise         no ``raise RuntimeError``/bare
+                                 ``raise Exception`` inside the
+                                 governed dirs (ops/, memgov/,
+                                 parallel/, sidecar*.py): failures
+                                 crossing those boundaries must speak
+                                 the utils/errors.py taxonomy.
+    SRJT004 broad-except         every ``except Exception`` /bare
+                                 ``except:`` in the package must
+                                 re-raise, wrap into the taxonomy
+                                 (classify / raise_corruption / a
+                                 taxonomy class), or carry an explicit
+                                 suppression with a reason.
+    SRJT005 stub-discipline      in the stub-pattern modules (metrics /
+                                 tracing / integrity / faultinj /
+                                 memgov gates) no string formatting or
+                                 allocation-ish work may execute before
+                                 the function's enabled-gate check —
+                                 the disabled hot path stays one
+                                 boolean read.
+    SRJT006 blocking-call        ``time.sleep`` / ``socket.settimeout``
+                                 / ``recv`` in the governed concurrent
+                                 modules must live in functions that
+                                 are deadline-aware (reference a
+                                 deadline / remaining / budget /
+                                 timeout) — a blocking call no deadline
+                                 can interrupt is how queries hang
+                                 forever.
+    SRJT007 doc-drift            the knob registry and the
+                                 README/PACKAGING knob tables must
+                                 agree both ways: every declared knob
+                                 documented, every documented token
+                                 declared.
+    SRJT000 bad-suppression      a suppression comment with an empty /
+                                 missing reason is itself a violation.
+
+Suppression syntax (reason REQUIRED), on the flagged line or alone on
+the line directly above it::
+
+    except Exception:  # srjt-lint: allow-broad-except(best-effort reap; spawn cleanup must never mask the startup error)
+    time.sleep(d)      # srjt-lint: allow-blocking(detached respawn thread; owns no query budget)
+    os.environ.get(k)  # srjt-lint: allow-environ(bootstrap read before utils can import)
+    raise RuntimeError(m)  # srjt-lint: allow-raise(semantic wire error; breaker must record success)
+
+Run ``python -m spark_rapids_jni_tpu.analysis.lint`` from the repo
+root (exit 1 on any violation); ``--knob-table`` renders the registry
+as the markdown table the docs embed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Violation", "lint_source", "lint_file", "run", "main"]
+
+_KNOB_RE = re.compile(r"SRJT_[A-Z0-9_]*[A-Z0-9]")
+
+# taxonomy names whose raise (or wrap) satisfies SRJT004; `classify`
+# and `raise_corruption` are the two canonical wrap helpers
+_TAXONOMY = {
+    "DeviceError", "FatalDeviceError", "RetryableError", "DataCorruption",
+    "DeadlineExceeded", "MemoryBudgetExceeded", "classify",
+    "raise_corruption",
+}
+
+# rule scopes, as path fragments relative to the package root
+_RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "sidecar.py",
+                   "sidecar_pool.py")
+_BLOCKING_GOVERNED = ("sidecar.py", "sidecar_pool.py", "parallel/",
+                      "memgov/", "utils/retry.py", "utils/faultinj.py")
+_STUB_MODULES = ("utils/metrics.py", "utils/tracing.py",
+                 "utils/integrity.py", "utils/faultinj.py",
+                 "memgov/__init__.py")
+
+# identifiers marking the enabled-gate (SRJT005) ...
+_GATE_NAMES = {"_enabled", "is_enabled", "enabled", "is_armed"}
+# ... and, for SRJT006, the substrings marking a deadline-aware function
+_DEADLINE_MARKS = ("deadline", "remaining", "budget", "timeout")
+
+_SUPPRESS_RE = re.compile(r"#\s*srjt-lint:\s*allow-([a-z-]+)\s*\((.*)\)\s*$")
+_RULE_SUPPRESSIONS = {
+    "SRJT001": "knob",
+    "SRJT002": "environ",
+    "SRJT003": "raise",
+    "SRJT004": "broad-except",
+    "SRJT005": "stub",
+    "SRJT006": "blocking",
+}
+
+
+class Violation:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _knob_names() -> Tuple[frozenset, frozenset]:
+    from ..utils import knobs
+
+    return knobs.names(), knobs.SENTINELS
+
+
+def _suppressions(src: str) -> Dict[int, Tuple[str, str, int]]:
+    """line -> (kind, reason, comment_line) for every line a suppression
+    comment covers: its own line, and — for a standalone comment — the
+    next line."""
+    out: Dict[int, Tuple[str, str, int]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, reason = m.group(1), m.group(2).strip()
+        out[i] = (kind, reason, i)
+        if text.lstrip().startswith("#"):  # standalone: covers the next line
+            out[i + 1] = (kind, reason, i)
+    return out
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src: str,
+                 knob_names: frozenset, sentinels: frozenset):
+        self.path = path
+        self.rel = rel  # package-relative path ("utils/retry.py")
+        self.src = src
+        self.knob_names = knob_names
+        self.sentinels = sentinels
+        self.suppress = _suppressions(src)
+        self.used_suppressions: set = set()
+        self.violations: List[Violation] = []
+        self.is_knobs = rel == "utils/knobs.py"
+        self.is_analysis = rel.startswith("analysis/")
+        self._func_stack: List[ast.AST] = []
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _flag(self, node, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        kind = _RULE_SUPPRESSIONS.get(rule)
+        sup = self.suppress.get(line)
+        if sup is not None and kind is not None and sup[0] == kind:
+            _, reason, comment_line = sup
+            self.used_suppressions.add(comment_line)
+            if not reason:
+                self.violations.append(Violation(
+                    self.path, comment_line, "SRJT000",
+                    f"suppression allow-{kind}() needs a reason",
+                ))
+            return
+        self.violations.append(Violation(self.path, line, rule, message))
+
+    def finish(self) -> None:
+        # a suppression nothing matched is stale — reasons rot fast.
+        # analysis/ is exempt from the staleness audit only: its
+        # docstrings carry the syntax examples.
+        for line, (kind, reason, comment_line) in self.suppress.items():
+            if line != comment_line:
+                continue  # only audit each comment once
+            if kind not in _RULE_SUPPRESSIONS.values():
+                self.violations.append(Violation(
+                    self.path, comment_line, "SRJT000",
+                    f"unknown suppression kind allow-{kind}",
+                ))
+            elif comment_line in self.used_suppressions:
+                continue
+            elif not reason:
+                self.violations.append(Violation(
+                    self.path, comment_line, "SRJT000",
+                    f"suppression allow-{kind}() needs a reason",
+                ))
+            elif not self.is_analysis:
+                self.violations.append(Violation(
+                    self.path, comment_line, "SRJT000",
+                    f"stale suppression allow-{kind}: no suppressible "
+                    "violation on this or the next line (the code it "
+                    "excused is gone — delete the comment)",
+                ))
+
+    # -- SRJT001: undeclared knob literals -----------------------------------
+
+    def _check_knob_literal(self, node, value: str) -> None:
+        if self.is_knobs:
+            return
+        for m in _KNOB_RE.finditer(value):
+            tok = m.group(0)
+            if tok in self.knob_names or tok in self.sentinels:
+                continue
+            # "SRJT_RETRY_*" in prose is a family glob over declared
+            # knobs, not an undeclared knob
+            if (value[m.end():m.end() + 2] in ("_*",)
+                    or value[m.end():m.end() + 1] == "*"):
+                if any(k.startswith(tok) for k in self.knob_names):
+                    continue
+            self._flag(node, "SRJT001",
+                       f"undeclared knob {tok}: declare it in "
+                       "utils/knobs.py (name, type, default, doc)")
+
+    def visit_Constant(self, node: ast.Constant):
+        if isinstance(node.value, str) and "SRJT_" in node.value:
+            self._check_knob_literal(node, node.value)
+        self.generic_visit(node)
+
+    # -- SRJT002: direct environ reads ---------------------------------------
+
+    @staticmethod
+    def _is_os_environ(node) -> bool:
+        # "_os" covers the `import os as _os` bootstrap idiom — an
+        # aliased read is still a direct read
+        return (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("os", "_os")) or (
+                    isinstance(node, ast.Name) and node.id == "environ")
+
+    def _environ_read(self, node, key_node) -> None:
+        if self.is_knobs:
+            return
+        if isinstance(key_node, ast.Constant) and isinstance(key_node.value, str):
+            if not key_node.value.startswith("SRJT_"):
+                return  # PYTHONPATH / JAX_PLATFORMS etc: not ours
+            what = f"of {key_node.value}"
+        else:
+            what = "with a dynamic key"
+        self._flag(node, "SRJT002",
+                   f"direct os.environ read {what}: SRJT knobs are read "
+                   "through utils/knobs.py typed accessors only")
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        # os.environ.get(...) / environ.get(...)
+        if (isinstance(f, ast.Attribute) and f.attr == "get"
+                and self._is_os_environ(f.value)):
+            self._environ_read(node, node.args[0] if node.args else None)
+        # os.getenv(...)
+        elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                and isinstance(f.value, ast.Name) and f.value.id == "os"):
+            self._environ_read(node, node.args[0] if node.args else None)
+        elif isinstance(f, ast.Name) and f.id == "getenv":
+            self._environ_read(node, node.args[0] if node.args else None)
+        else:
+            self._check_blocking_call(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load) and self._is_os_environ(node.value):
+            self._environ_read(node, node.slice)
+        self.generic_visit(node)
+
+    # -- SRJT003: banned raises ----------------------------------------------
+
+    def visit_Raise(self, node: ast.Raise):
+        if any(self.rel.startswith(p) or self.rel == p
+               for p in _RAISE_GOVERNED):
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in ("RuntimeError", "Exception"):
+                self._flag(node, "SRJT003",
+                           f"raise {name} in a governed module: use the "
+                           "utils/errors.py taxonomy (FatalDeviceError / "
+                           "RetryableError / DataCorruption / "
+                           "DeadlineExceeded) so retry, breaker, and "
+                           "failover classification stay correct")
+        self.generic_visit(node)
+
+    # -- SRJT004: broad excepts ----------------------------------------------
+
+    @staticmethod
+    def _catches_broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except:
+        names = []
+        for n in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _handler_complies(handler: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                if sub.exc is None:
+                    return True  # bare re-raise
+                f = sub.exc
+                name = None
+                if isinstance(f, ast.Call):
+                    fn = f.func
+                    if isinstance(fn, ast.Name):
+                        name = fn.id
+                    elif isinstance(fn, ast.Attribute):
+                        name = fn.attr
+                elif isinstance(f, ast.Name):
+                    name = f.id
+                if name in _TAXONOMY:
+                    return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._catches_broad(node) and not self._handler_complies(node):
+            self._flag(node, "SRJT004",
+                       "broad except must re-raise, wrap into the error "
+                       "taxonomy, or carry "
+                       "# srjt-lint: allow-broad-except(<reason>)")
+        self.generic_visit(node)
+
+    # -- SRJT005: stub discipline --------------------------------------------
+
+    @staticmethod
+    def _mentions_gate(stmt) -> bool:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and sub.id in _GATE_NAMES:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in _GATE_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _alloc_nodes(stmt):
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.JoinedStr):
+                yield sub, "f-string"
+            elif (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "format"):
+                yield sub, ".format() call"
+            elif (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mod)
+                    and isinstance(sub.left, ast.Constant)
+                    and isinstance(sub.left.value, str)):
+                yield sub, "%-format"
+            elif (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "open"):
+                yield sub, "open() call"
+
+    def _check_stub_function(self, node) -> None:
+        gate_idx = None
+        for i, stmt in enumerate(node.body):
+            if self._mentions_gate(stmt):
+                gate_idx = i
+                break
+        if gate_idx is None:
+            return
+        for stmt in node.body[:gate_idx]:
+            for sub, what in self._alloc_nodes(stmt):
+                self._flag(sub, "SRJT005",
+                           f"{what} before the enabled-gate check: the "
+                           "disabled hot path must stay one boolean "
+                           "read (the metrics-stub pattern)")
+
+    # -- SRJT006: blocking calls ---------------------------------------------
+
+    def _check_blocking_call(self, node: ast.Call) -> None:
+        if not any(self.rel.startswith(p) or self.rel == p
+                   for p in _BLOCKING_GOVERNED):
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        blocking = (
+            (f.attr == "sleep" and isinstance(f.value, ast.Name)
+             and f.value.id == "time")
+            or f.attr in ("settimeout", "recv", "recvmsg")
+        )
+        if not blocking:
+            return
+        fn = self._func_stack[-1] if self._func_stack else None
+        if fn is not None and self._deadline_aware(fn):
+            return
+        self._flag(node, "SRJT006",
+                   f"blocking {f.attr}() outside a deadline-aware "
+                   "function: route it through the deadline/timeout "
+                   "wrappers (utils/deadline.py discipline) or carry "
+                   "# srjt-lint: allow-blocking(<reason>)")
+
+    @staticmethod
+    def _deadline_aware(fn) -> bool:
+        for sub in ast.walk(fn):
+            ident = None
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                # the blocking call itself ("settimeout") must not mark
+                # its own function deadline-aware
+                ident = None if sub.attr == "settimeout" else sub.attr
+            elif isinstance(sub, ast.arg):
+                ident = sub.arg
+            if ident and any(m in ident.lower() for m in _DEADLINE_MARKS):
+                return True
+        return False
+
+    # -- function scoping ----------------------------------------------------
+
+    def _visit_func(self, node):
+        if self.rel in _STUB_MODULES:
+            self._check_stub_function(node)
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def lint_source(src: str, path: str, rel: Optional[str] = None,
+                knob_names: Optional[frozenset] = None,
+                sentinels: Optional[frozenset] = None) -> List[Violation]:
+    """Lint one source blob. ``rel`` is its package-relative path (rule
+    scoping); tests pass fixture snippets with a synthetic ``rel``."""
+    if knob_names is None or sentinels is None:
+        knob_names, sentinels = _knob_names()
+    if rel is None:
+        rel = os.path.basename(path)
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 1, "SRJT999",
+                          f"syntax error: {e.msg}")]
+    linter = _FileLinter(path, rel, src, knob_names, sentinels)
+    linter.visit(tree)
+    linter.finish()
+    return linter.violations
+
+
+def lint_file(path: str, pkg_root: str, knob_names, sentinels):
+    rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path, rel, knob_names, sentinels)
+
+
+def _discover(pkg_root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        # __pycache__ is scanner noise, never source (ISSUE 7 satellite)
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+# -- SRJT007: registry <-> doc-table drift ----------------------------------
+
+
+def check_docs(repo_root: str, knob_names: Optional[frozenset] = None,
+               sentinels: Optional[frozenset] = None) -> List[Violation]:
+    if knob_names is None or sentinels is None:
+        knob_names, sentinels = _knob_names()
+    docs = [p for p in ("README.md", "PACKAGING.md")
+            if os.path.exists(os.path.join(repo_root, p))]
+    out: List[Violation] = []
+    if not docs:
+        return out
+    tabled: set = set()  # knobs appearing in an actual table row
+    for doc in docs:
+        path = os.path.join(repo_root, doc)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                is_row = line.lstrip().startswith("|")
+                for tok in _KNOB_RE.findall(line):
+                    if is_row:
+                        tabled.add(tok)
+                    if tok in knob_names or tok in sentinels:
+                        continue
+                    # prose/diagram allowance only: ASCII diagrams wrap
+                    # long names, so a strict prefix of a declared knob
+                    # is a wrapped reference there — inside a knob
+                    # TABLE row the name must be exact (a truncated
+                    # name in the table IS the drift this rule exists
+                    # to catch)
+                    if not is_row and any(k.startswith(tok)
+                                          for k in knob_names):
+                        continue
+                    out.append(Violation(
+                        path, lineno, "SRJT007",
+                        f"documented knob {tok} is not declared in "
+                        "utils/knobs.py (typo, or a knob that was "
+                        "removed from the code?)"))
+    for name in sorted(knob_names):
+        # a prose mention is not documentation: the knob must sit in a
+        # markdown table row (the operator-facing knob tables)
+        if name not in tabled:
+            out.append(Violation(
+                os.path.join(repo_root, "README.md"), 1, "SRJT007",
+                f"declared knob {name} appears in no README.md/"
+                "PACKAGING.md knob-table row (add it to a knob table; "
+                "--knob-table renders the registry)"))
+    return out
+
+
+def run(pkg_root: Optional[str] = None,
+        with_docs: bool = True) -> List[Violation]:
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    knob_names, sentinels = _knob_names()
+    violations: List[Violation] = []
+    for path in _discover(pkg_root):
+        violations.extend(lint_file(path, pkg_root, knob_names, sentinels))
+    if with_docs:
+        violations.extend(check_docs(os.path.dirname(pkg_root),
+                                     knob_names, sentinels))
+    violations.sort(key=lambda v: (v.path, v.line))
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.analysis.lint",
+        description="srjt-lint: invariant lint suite (ISSUE 7)")
+    ap.add_argument("--root", default=None,
+                    help="package root to lint (default: the installed "
+                    "spark_rapids_jni_tpu directory)")
+    ap.add_argument("--no-docs", action="store_true",
+                    help="skip the README/PACKAGING knob-table drift check")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the registry as a markdown table and exit")
+    args = ap.parse_args(argv)
+    if args.knob_table:
+        from ..utils import knobs
+
+        print(knobs.markdown_table())
+        return 0
+    violations = run(args.root, with_docs=not args.no_docs)
+    for v in violations:
+        print(repr(v))
+    if violations:
+        print(f"srjt-lint: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("srjt-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

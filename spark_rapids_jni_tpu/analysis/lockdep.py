@@ -1,0 +1,638 @@
+"""Runtime lockdep: lock-order cycle detection for the concurrent
+substrate (ISSUE 7 layer 2; the Linux kernel lockdep idea, scoped to
+this package's ~25 locks).
+
+Armed with ``SRJT_LOCKDEP=1``, the package ``__init__`` calls
+``install()`` BEFORE any other package import, so every
+``threading.Lock/RLock/Condition`` created by package (or repo test)
+code afterwards is a tracked shim. Per thread, the shim keeps the stack
+of currently-held tracked locks; every successful-or-attempted
+acquisition of lock B while holding lock A records the directed edge
+A -> B (per lock INSTANCE — two specific locks taken in both orders is
+a real potential deadlock, never a same-class false positive) with one
+sample stack per edge. ``time.sleep`` is wrapped too: sleeping while
+holding any tracked lock is recorded as a blocking-while-locked event
+(the latency-bomb the deadline tier exists to prevent). Sockets guarded
+by a per-connection io_lock are the DESIGN on the sidecar data path, so
+recv is deliberately not instrumented — the lint layer (SRJT006)
+polices blocking calls statically instead.
+
+At process exit each armed process writes
+``<SRJT_LOCKDEP_DIR>/lockdep_<pid>.json`` — lock sites, the order
+graph, cycles (strongly connected components), self-deadlocks
+(re-acquiring a held non-reentrant lock), and blocking events. Armed
+for the full tier-1 + chaos suites in ci/premerge.sh, every existing
+concurrency test doubles as a lockdep probe; the merge gate::
+
+    python -m spark_rapids_jni_tpu.analysis.lockdep \
+        --merge artifacts/lockdep --out artifacts/lockdep_report.json
+
+fails on any cycle or self-deadlock across every report.
+
+Bootstrap constraint: this module reads its env knobs directly —
+importing utils/knobs.py here would drag in the whole utils tree
+before the shim is installed, leaving every utils lock untracked. The
+knob names stay declared in the registry like any other.
+
+Known limits (documented, not bugs): locks created before ``install()``
+(or by code that did ``from threading import Lock`` at import time) are
+untracked; a lock acquired in one thread and released in another leaves
+a stale held entry on the acquirer. Neither shape exists in this
+package.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "install",
+    "uninstall",
+    "is_installed",
+    "isolated_state",
+    "report",
+    "write_report",
+    "flush_report",
+    "find_cycles",
+    "merge_reports",
+    "main",
+]
+
+# originals captured at import, before any patching
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_CONDITION = threading.Condition
+_ORIG_SLEEP = time.sleep
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+_MAX_BLOCKING_EVENTS = 200  # sample cap; the total is counted exactly
+# a blocking acquisition that stalls this long while other locks are
+# held persists the report EARLY: a real deadlock never reaches exit's
+# atexit writer (CI SIGKILLs it), but the stalled report carries the
+# inverted edges the postmortem needs
+_STALL_REPORT_S = 60.0
+
+
+class _State:
+    """One lockdep universe: the order graph + event tallies. Swappable
+    via ``isolated_state()`` so the deliberate-inversion unit test does
+    not poison the session report the CI gate asserts on."""
+
+    def __init__(self):
+        self.mu = _ORIG_LOCK()
+        self.locks: Dict[int, dict] = {}  # key -> {"site", "kind"}
+        self.edges: Dict[Tuple[int, int], dict] = {}
+        self.blocking: List[dict] = []
+        self.blocking_total = 0
+        self.self_deadlocks: List[dict] = []
+
+
+_state = _State()
+_session_state = _state  # the universe the CI gate asserts on
+_tls = threading.local()
+_installed = False
+_seq_lock = _ORIG_LOCK()
+_seq = 0
+
+
+def _next_key() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def _creation_site(depth: int) -> Optional[str]:
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return None
+    fn = f.f_code.co_filename
+    # package files are ALWAYS tracked — including wheel installs where
+    # the package (and so _REPO_ROOT) lives inside site-packages; the
+    # site-packages rejection only filters third-party code picked up
+    # via the repo-root prefix in dev checkouts (tests/, benchmarks/)
+    if not fn.startswith(_PKG_ROOT + os.sep):
+        if not fn.startswith(_REPO_ROOT) or "site-packages" in fn:
+            return None
+    if os.sep + "analysis" + os.sep in fn:
+        return None  # never track our own machinery
+    return f"{os.path.relpath(fn, _REPO_ROOT)}:{f.f_lineno}"
+
+
+def _short_stack() -> str:
+    # drop the two lockdep-internal frames at the tail
+    return "".join(traceback.format_stack(limit=10)[:-2])
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class _TrackedLock:
+    """Shim over one Lock/RLock instance. Implements the full lock
+    protocol plus the private trio (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``) threading.Condition probes
+    for, so a Condition built over a tracked lock keeps the held-stack
+    exact across ``wait()``."""
+
+    __slots__ = ("_inner", "_key", "site", "_reentrant", "_registered")
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._inner = inner
+        self._key = _next_key()
+        self.site = site
+        self._reentrant = reentrant
+        self._registered = False
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _register(self, st: _State) -> None:
+        if not self._registered or self._key not in st.locks:
+            st.locks[self._key] = {
+                "site": self.site,
+                "kind": "RLock" if self._reentrant else "Lock",
+            }
+            self._registered = True
+
+    def _note_edges(self, held: list) -> None:
+        if not held:
+            return
+        st = _state
+        with st.mu:
+            self._register(st)
+            for entry in held:
+                other = entry[0]
+                if other._key == self._key:
+                    continue
+                other._register(st)
+                key = (other._key, self._key)
+                rec = st.edges.get(key)
+                if rec is None:
+                    st.edges[key] = {"count": 1, "stack": _short_stack()}
+                else:
+                    rec["count"] += 1
+
+    # -- the lock protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        held = _held()
+        for entry in held:
+            if entry[0] is self:
+                if self._reentrant:
+                    got = self._inner.acquire(blocking, timeout)
+                    if got:
+                        entry[1] += 1
+                    return got
+                # re-acquiring a held non-reentrant lock: guaranteed
+                # deadlock — record it AND persist the report BEFORE
+                # blocking forever (atexit never runs for a process the
+                # harness has to SIGKILL)
+                st = _state
+                with st.mu:
+                    self._register(st)
+                    st.self_deadlocks.append({
+                        "site": self.site,
+                        "thread": threading.current_thread().name,
+                        "stack": _short_stack(),
+                    })
+                if blocking and timeout == -1:
+                    _persist_early()  # about to block forever
+                return self._inner.acquire(blocking, timeout)
+        # edges record the ATTEMPTED order, before any blocking: a true
+        # deadlock never reaches the post-acquire line
+        self._note_edges(held)
+        if held and blocking and timeout == -1:
+            # a wedged acquisition while other locks are held is the
+            # deadlock shape: give it _STALL_REPORT_S, then persist the
+            # report (both inverted edges are already recorded) and
+            # keep waiting so the harness timeout stays the arbiter
+            got = self._inner.acquire(True, _STALL_REPORT_S)
+            if not got:
+                _persist_early()
+                got = self._inner.acquire(True, -1)
+        else:
+            got = self._inner.acquire(blocking, timeout)
+        if got:
+            held.append([self, 1])
+        return got
+
+    def release(self):
+        self._inner.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                held[i][1] -= 1
+                if held[i][1] == 0:
+                    del held[i]
+                return
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<lockdep {self.site} over {self._inner!r}>"
+
+    # -- threading.Condition integration -------------------------------------
+
+    def _release_save(self):
+        if self._reentrant:
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                del held[i]
+                break
+        return state
+
+    def _acquire_restore(self, state):
+        if self._reentrant:
+            self._inner._acquire_restore(state)
+            depth = state[0] if isinstance(state, tuple) else 1
+        else:
+            self._inner.acquire()
+            depth = 1
+        held = _held()
+        self._note_edges(held)
+        held.append([self, depth])
+
+    def _is_owned(self):
+        if self._reentrant:
+            return self._inner._is_owned()
+        # plain-Lock heuristic, same as threading.Condition's fallback
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+# -- the patched factories ---------------------------------------------------
+
+
+def _make_lock():
+    inner = _ORIG_LOCK()
+    site = _creation_site(2)
+    return inner if site is None else _TrackedLock(inner, site, False)
+
+
+def _make_rlock():
+    inner = _ORIG_RLOCK()
+    site = _creation_site(2)
+    return inner if site is None else _TrackedLock(inner, site, True)
+
+
+def _make_condition(lock=None):
+    if lock is None:
+        site = _creation_site(2)
+        if site is not None:
+            lock = _TrackedLock(_ORIG_RLOCK(), site, True)
+    return _ORIG_CONDITION(lock) if lock is not None else _ORIG_CONDITION()
+
+
+def _tracked_sleep(secs):
+    held = getattr(_tls, "held", None)
+    if held:
+        st = _state
+        with st.mu:
+            st.blocking_total += 1
+            if len(st.blocking) < _MAX_BLOCKING_EVENTS:
+                st.blocking.append({
+                    "syscall": "sleep",
+                    "seconds": float(secs),
+                    "thread": threading.current_thread().name,
+                    "locks_held": [e[0].site for e in held],
+                    "stack": _short_stack(),
+                })
+    _ORIG_SLEEP(secs)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock/Condition + time.sleep and register
+    the exit-time report writer. Idempotent. Must run before the
+    modules whose locks it should see are imported — the package
+    ``__init__`` does this when SRJT_LOCKDEP=1."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    threading.Condition = _make_condition
+    time.sleep = _tracked_sleep
+    atexit.register(_atexit_report)
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    threading.Condition = _ORIG_CONDITION
+    time.sleep = _ORIG_SLEEP
+    _installed = False
+
+
+def is_installed() -> bool:
+    return _installed
+
+
+@contextlib.contextmanager
+def isolated_state():
+    """Swap in a throwaway graph for the dynamic extent of the block
+    (the deliberate-inversion unit test's tool: its cycle must never
+    reach the session report the CI gate asserts on)."""
+    global _state
+    prev = _state
+    _state = _State()
+    try:
+        yield _state
+    finally:
+        _state = prev
+
+
+# -- reporting ---------------------------------------------------------------
+
+
+def find_cycles(edges) -> List[List[int]]:
+    """Strongly connected components with >1 node (or a self-edge) in
+    the key graph — each is a set of locks with circular ordering, i.e.
+    a potential deadlock. Iterative Tarjan: lock graphs are small but
+    stacks under chaos tests need not be."""
+    graph: Dict[int, List[int]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: Dict[int, int] = {}
+    low: Dict[int, int] = {}
+    on_stack: Dict[int, bool] = {}
+    stack: List[int] = []
+    counter = [0]
+    sccs: List[List[int]] = []
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack[nxt] = True
+                    work.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+                elif on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    v = stack.pop()
+                    on_stack[v] = False
+                    comp.append(v)
+                    if v == node:
+                        break
+                if len(comp) > 1 or (node, node) in edges:
+                    sccs.append(sorted(comp))
+    return sccs
+
+
+def report(state: Optional[_State] = None) -> dict:
+    st = _state if state is None else state
+    with st.mu:
+        locks = {str(k): dict(v) for k, v in st.locks.items()}
+        edge_items = [
+            (a, b, dict(rec)) for (a, b), rec in st.edges.items()
+        ]
+        blocking = list(st.blocking)
+        blocking_total = st.blocking_total
+        self_deadlocks = list(st.self_deadlocks)
+    site = lambda k: locks.get(str(k), {}).get("site", f"key{k}")  # noqa: E731
+    cycles = [
+        {"locks": [site(k) for k in comp], "keys": comp}
+        for comp in find_cycles({(a, b) for a, b, _ in edge_items})
+    ]
+    return {
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "locks": locks,
+        "edges": [
+            {"from": site(a), "to": site(b),
+             "from_key": a, "to_key": b, **rec}
+            for a, b, rec in edge_items
+        ],
+        "cycles": cycles,
+        "self_deadlocks": self_deadlocks,
+        "blocking_events": blocking,
+        "blocking_total": blocking_total,
+    }
+
+
+def _report_dir() -> str:
+    # direct env read by design: see the bootstrap note in the module
+    # docstring (both names ARE declared in utils/knobs.py)
+    return os.environ.get("SRJT_LOCKDEP_DIR") or "artifacts/lockdep"  # srjt-lint: allow-environ(bootstrap: utils/knobs must not be imported from the lockdep layer)
+
+
+_report_name: Optional[str] = None
+
+
+def _default_report_path() -> str:
+    # one name per process, random-suffixed: Linux recycles pids, and a
+    # later CI tier's process must never overwrite an earlier tier's
+    # report (a lost cycle = a false pass at the merge gate). An early
+    # persist and the atexit write share the name — the later write is
+    # a superset of the earlier, never a duplicate report in the merge.
+    global _report_name
+    if _report_name is None:
+        _report_name = f"lockdep_{os.getpid()}_{os.urandom(4).hex()}.json"
+    d = _report_dir()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, _report_name)
+
+
+def write_report(path: Optional[str] = None) -> str:
+    if path is None:
+        path = _default_report_path()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=1, default=str)
+    return path
+
+
+def flush_report() -> None:
+    """Persist the session report NOW, best-effort — for processes
+    that exit via ``os._exit`` (the sidecar worker's shutdown verb),
+    which skips atexit. No-op when disarmed or inside an
+    ``isolated_state`` test universe."""
+    _persist_early()
+
+
+def _persist_early() -> None:
+    """Best-effort report write from INSIDE a detected/suspected
+    deadlock, so the artifact exists even when the process never
+    reaches atexit (harness SIGKILL). Only when armed: unit tests that
+    poke _TrackedLock directly must not scribble artifacts."""
+    if not _installed or _state is not _session_state:
+        return  # disarmed, or an isolated_state() test universe
+    try:
+        write_report()
+    except OSError:
+        pass
+
+
+def _atexit_report() -> None:
+    if not _installed:
+        return
+    try:
+        write_report()
+    except OSError:
+        pass  # a read-only CI sandbox degrades the artifact, not exit
+
+
+# -- merge + gate (the premerge CLI) -----------------------------------------
+
+
+def merge_reports(dir_path: str) -> dict:
+    reports = []
+    for fn in sorted(os.listdir(dir_path)):
+        if fn.startswith("lockdep_") and fn.endswith(".json"):
+            with open(os.path.join(dir_path, fn), encoding="utf-8") as f:
+                reports.append(json.load(f))
+    merged_edges: Dict[Tuple[str, str], dict] = {}
+    cycles, self_deadlocks = [], []
+    locks_seen = set()
+    blocking_total = 0
+    for r in reports:
+        for lk in r.get("locks", {}).values():
+            locks_seen.add(lk.get("site"))
+        for e in r.get("edges", []):
+            key = (e["from"], e["to"])
+            rec = merged_edges.setdefault(
+                key, {"from": e["from"], "to": e["to"], "count": 0})
+            rec["count"] += e.get("count", 1)
+        for c in r.get("cycles", []):
+            cycles.append({"pid": r.get("pid"), **c})
+        for sd in r.get("self_deadlocks", []):
+            self_deadlocks.append({"pid": r.get("pid"), **sd})
+        blocking_total += r.get("blocking_total", 0)
+    # cross-process inversion check: per-process cycles are
+    # per-INSTANCE, so an A->B order in tier 1 and B->A in tier 2 shows
+    # up only here, on the merged SITE graph. Same-site self-edges
+    # (two instances from one creation site nested — the per-connection
+    # io_lock pattern) are excluded from the cycle test and surfaced
+    # separately: per-instance tracking already proved them acyclic
+    # within every process that ran them.
+    sites = sorted({s for e in merged_edges for s in e})
+    idx = {s: i for i, s in enumerate(sites)}
+    site_cycles = [
+        {"locks": [sites[k] for k in comp]}
+        for comp in find_cycles(
+            {(idx[a], idx[b]) for a, b in merged_edges if a != b})
+    ]
+    return {
+        "reports": len(reports),
+        "locks": sorted(x for x in locks_seen if x),
+        "edges": sorted(merged_edges.values(),
+                        key=lambda e: (e["from"], e["to"])),
+        "cycles": cycles,
+        "site_cycles": site_cycles,
+        "site_self_edges": sorted(a for a, b in merged_edges if a == b),
+        "self_deadlocks": self_deadlocks,
+        "blocking_total": blocking_total,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.analysis.lockdep",
+        description="merge per-process lockdep reports and gate on "
+        "zero lock-order cycles (ISSUE 7)")
+    ap.add_argument("--merge", default=None,
+                    help="directory of lockdep_<pid>.json reports "
+                    "(default: SRJT_LOCKDEP_DIR)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged report here")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="do not fail when no reports were found")
+    args = ap.parse_args(argv)
+    d = args.merge or _report_dir()
+    if not os.path.isdir(d):
+        if args.allow_empty:
+            print(f"lockdep: no report dir {d}")
+            return 0
+        print(f"lockdep: report dir {d} missing — was SRJT_LOCKDEP=1 "
+              "armed?", file=sys.stderr)
+        return 2
+    merged = merge_reports(d)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(merged, f, indent=1)
+    if merged["reports"] == 0 and not args.allow_empty:
+        print("lockdep: zero reports found — was SRJT_LOCKDEP=1 armed?",
+              file=sys.stderr)
+        return 2
+    bad = (merged["cycles"] or merged["self_deadlocks"]
+           or merged["site_cycles"])
+    print(f"lockdep: {merged['reports']} report(s), "
+          f"{len(merged['locks'])} lock site(s), "
+          f"{len(merged['edges'])} edge(s), "
+          f"{len(merged['cycles'])} cycle(s), "
+          f"{len(merged['site_cycles'])} cross-process site cycle(s), "
+          f"{len(merged['self_deadlocks'])} self-deadlock(s), "
+          f"{merged['blocking_total']} blocking-while-locked event(s)")
+    for c in merged["cycles"]:
+        print(f"  CYCLE (pid {c.get('pid')}): " + " -> ".join(c["locks"]),
+              file=sys.stderr)
+    for c in merged["site_cycles"]:
+        print("  SITE CYCLE (cross-process): " + " -> ".join(c["locks"]),
+              file=sys.stderr)
+    for sd in merged["self_deadlocks"]:
+        print(f"  SELF-DEADLOCK (pid {sd.get('pid')}): {sd.get('site')}",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,7 +148,7 @@ def _lz4_hadoop(data: bytes, uncompressed_size: int) -> Optional[bytes]:
         pos += csize
         try:
             out = _lz4_raw_block(block, usize)
-        except Exception:
+        except Exception:  # srjt-lint: allow-broad-except(codec sniffing: None = framing did not validate, the caller tries the next framing)
             return None
         if len(out) != usize:
             return None
@@ -180,7 +180,7 @@ def _lzo_hadoop(data: bytes, uncompressed_size: int) -> Optional[bytes]:
         pos += csize
         try:
             out = runtime.lzo1x_decompress(block, usize)
-        except Exception:
+        except Exception:  # srjt-lint: allow-broad-except(codec sniffing: None = framing did not validate, the caller tries the next framing)
             return None
         if len(out) != usize:
             return None

@@ -77,10 +77,10 @@ Environment:
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 from typing import Optional
 
+from ..utils import knobs
 from .admission import Admission, AdmissionController
 from .catalog import (
     TIER_DEVICE,
@@ -114,13 +114,13 @@ __all__ = [
 
 
 def _env_enabled() -> bool:
-    raw = os.environ.get("SRJT_SPILL_ENABLED")
-    if raw is not None and raw != "":
-        return raw.lower() in ("1", "true", "yes")
     # no explicit arming: govern exactly when an operator declared a
     # budget — a declared budget with no enforcement is the seed bug
     # this subsystem exists to close
-    return bool(os.environ.get("SRJT_DEVICE_MEMORY_BUDGET"))
+    return knobs.get_bool(
+        "SRJT_SPILL_ENABLED",
+        default=knobs.is_set("SRJT_DEVICE_MEMORY_BUDGET"),
+    )
 
 
 _enabled = _env_enabled()
@@ -215,9 +215,7 @@ def in_admission() -> bool:
 
 
 def _headroom() -> float:
-    from ..utils.retry import env_float
-
-    return env_float(os.environ, "SRJT_MEMGOV_HEADROOM", 2.0, positive=True)
+    return knobs.get_float("SRJT_MEMGOV_HEADROOM")
 
 
 def estimate_call_bytes(args=(), kwargs=None) -> int:

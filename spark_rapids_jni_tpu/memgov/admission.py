@@ -39,7 +39,6 @@ into admission, so the pair cannot deadlock.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -98,7 +97,7 @@ class AdmissionController:
         max_wait_s: Optional[float] = None,
         clock=time.monotonic,
     ):
-        from ..utils.retry import env_float
+        from ..utils import knobs
 
         self._capacity_fn = capacity_fn or device_memory_budget
         if catalog is None:
@@ -107,11 +106,10 @@ class AdmissionController:
             catalog = BufferCatalog()
         self._catalog = catalog
         if max_concurrent is None:
-            raw = os.environ.get("SRJT_ADMISSION_MAX_CONCURRENT")
-            max_concurrent = int(raw) if raw else 0
+            max_concurrent = knobs.get_int("SRJT_ADMISSION_MAX_CONCURRENT")
         self._max_concurrent = int(max_concurrent)
         self._max_wait_s = (
-            env_float(os.environ, "SRJT_ADMISSION_MAX_WAIT_SEC", 30.0, positive=True)
+            knobs.get_float("SRJT_ADMISSION_MAX_WAIT_SEC")
             if max_wait_s is None
             else float(max_wait_s)
         )

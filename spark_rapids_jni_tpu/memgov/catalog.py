@@ -179,8 +179,9 @@ class BufferCatalog:
         self._clock = clock
         self._spill_dir = spill_dir  # resolved lazily on first disk spill
         if host_budget is None:
-            raw = os.environ.get("SRJT_HOST_MEMORY_BUDGET")
-            host_budget = int(raw) if raw else 0
+            from ..utils import knobs
+
+            host_budget = knobs.get_int("SRJT_HOST_MEMORY_BUDGET")
         self._host_budget = int(host_budget)  # 0 == unlimited
 
     # -- registration --------------------------------------------------------
@@ -314,7 +315,9 @@ class BufferCatalog:
 
     def _resolve_spill_dir(self) -> str:
         if self._spill_dir is None:
-            self._spill_dir = os.environ.get("SRJT_SPILL_DIR") or os.path.join(
+            from ..utils import knobs
+
+            self._spill_dir = knobs.get_str("SRJT_SPILL_DIR") or os.path.join(
                 tempfile.gettempdir(), f"srjt-spill-{os.getpid()}"
             )
         os.makedirs(self._spill_dir, exist_ok=True)

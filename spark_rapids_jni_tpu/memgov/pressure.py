@@ -23,16 +23,15 @@ per-spill counters/histograms live with the catalog.
 
 from __future__ import annotations
 
-import os
 import sys
+
+from ..utils import knobs
 
 __all__ = ["relieve"]
 
 
 def _drop_smcache_armed() -> bool:
-    return os.environ.get("SRJT_MEMGOV_DROP_SMCACHE", "").lower() in (
-        "1", "true", "yes",
-    )
+    return knobs.get_bool("SRJT_MEMGOV_DROP_SMCACHE")
 
 
 def relieve(need_bytes: int, catalog, name: str = "op") -> int:

@@ -561,9 +561,10 @@ def _to_rows_strings_padded(
             # a runtime failure past this handler and the fallback would
             # never engage
             return jax.block_until_ready(out)
-        except Exception as e:  # noqa: BLE001 — any fused failure must
-            # engage the staged fallback (round-3: wide axes crashed the
-            # XLA:TPU compiler; trace-time failures can surface as
+        except Exception as e:  # noqa: BLE001  # srjt-lint: allow-broad-except(any fused-program failure engages the staged fallback; see the latch note below)
+            # any fused failure must engage the staged fallback
+            # (round-3: wide axes crashed the XLA:TPU compiler;
+            # trace-time failures can surface as
             # TypeError/NotImplementedError on other backends)
             import logging
 

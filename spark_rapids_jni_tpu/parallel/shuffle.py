@@ -409,13 +409,10 @@ def exchange_mode() -> str:
     transport — the ``--exchange-worker`` harness and benchmarks; the
     in-library mesh collectives always use the collective and do not
     read this knob."""
-    mode = os.environ.get("SRJT_EXCHANGE_MODE", "mesh").lower()
-    if mode not in ("mesh", "tcp"):
-        import warnings
+    from ..utils import knobs
 
-        warnings.warn(f"shuffle: unknown SRJT_EXCHANGE_MODE={mode!r}; using mesh")
-        return "mesh"
-    return mode
+    # the typed accessor warns and keeps "mesh" on an unknown value
+    return knobs.get_str("SRJT_EXCHANGE_MODE")
 
 
 _EXC_BREAKER = None
@@ -482,22 +479,15 @@ class TcpExchange:
                  deadline_s: Optional[float] = None,
                  publish_wait_s: float = 10.0,
                  retain_epochs: Optional[int] = None):
-        from ..utils.retry import env_float
+        from ..utils import knobs
 
         self.rank = int(rank)
         if deadline_s is None:
-            deadline_s = env_float(
-                os.environ, "SRJT_EXCHANGE_TIMEOUT_SEC", 30.0, positive=True
-            )
+            deadline_s = knobs.get_float("SRJT_EXCHANGE_TIMEOUT_SEC")
         self.deadline_s = float(deadline_s)
         self.publish_wait_s = float(publish_wait_s)
         if retain_epochs is None:
-            try:
-                retain_epochs = int(
-                    os.environ.get("SRJT_EXCHANGE_RETAIN_EPOCHS", "4")
-                )
-            except ValueError:
-                retain_epochs = 4
+            retain_epochs = knobs.get_int("SRJT_EXCHANGE_RETAIN_EPOCHS")
         # publish() evicts everything older than the newest
         # `retain_epochs` distinct epochs: a long-lived runtime doing
         # one exchange round per query stage must not accumulate every
@@ -764,7 +754,7 @@ class TcpExchange:
         def _pull(r: int, addr: str, ctx) -> None:
             try:
                 fetched[r] = ctx.run(self.fetch, addr, epoch, self.rank)
-            except BaseException as e:
+            except BaseException as e:  # srjt-lint: allow-broad-except(thread-exit funnel: the joiner re-raises errs[0] after joining every fetch thread)
                 errs.append(e)
 
         pulls = [
@@ -886,6 +876,8 @@ def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
     import subprocess
     import sys
 
+    from ..utils.errors import FatalDeviceError
+
     env = dict(os.environ)
     env.pop("SRJT_FAULTINJ_CONFIG", None)
     env["SRJT_RETRY_ENABLED"] = "1"
@@ -937,25 +929,25 @@ def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
         readable, _, _ = select.select([fd], [], [], min(remaining, 0.5))
         if not readable:
             if proc.poll() is not None:
-                raise RuntimeError(
+                raise FatalDeviceError(
                     f"exchange peer exited during startup rc={proc.returncode}"
                 )
             continue
         chunk = os.read(fd, 65536)
         if not chunk:
             if proc.poll() is not None:
-                raise RuntimeError(
+                raise FatalDeviceError(
                     f"exchange peer exited during startup rc={proc.returncode}"
                 )
             proc.kill()
             proc.wait()
-            raise RuntimeError(
+            raise FatalDeviceError(
                 "exchange peer closed stdout before reporting ready"
             )
         buf += chunk
     proc.kill()
     proc.wait()
-    raise RuntimeError(
+    raise FatalDeviceError(
         f"exchange peer never reported ready within {ready_timeout_s:g}s"
     )
 

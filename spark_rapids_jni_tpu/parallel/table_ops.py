@@ -49,6 +49,7 @@ from ..columnar.dtype import TypeId
 from ..ops import bitutils
 from ..ops.hashing import murmur3_raw
 from ..utils.dispatch import op_boundary
+from ..utils.errors import FatalDeviceError
 from .distributed import _hash_dest_multi
 from .join_distributed import shard_join_pairs
 from .shuffle import _bucketize
@@ -485,7 +486,10 @@ def _groupby_split_retry(
     _note_split()
     n = table.num_rows
     if n < 2:
-        raise RuntimeError("cannot split a single-row batch further")
+        # halving cannot go below one row: retrying is unproductive,
+        # so this must NOT be retryable (taxonomy: fatal ends the
+        # split recursion instead of burning the attempt budget)
+        raise FatalDeviceError("cannot split a single-row batch further")
     # mean is not merge-associative: compute sum + count in the partials
     inner_aggs: List[Tuple[str, str, str]] = []
     for vname, how, oname in aggs:

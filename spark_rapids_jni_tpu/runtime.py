@@ -17,6 +17,7 @@ import threading
 from typing import List, Optional
 
 from .io.parquet_footer import StructElement, flatten_schema
+from .utils import knobs
 
 __all__ = [
     "native_available",
@@ -64,7 +65,7 @@ def _candidate_paths() -> List[str]:
     here = os.path.dirname(os.path.abspath(__file__))
     repo = os.path.dirname(here)
     cands = []
-    env = os.environ.get("SRJT_NATIVE_LIB")
+    env = knobs.get_str("SRJT_NATIVE_LIB")
     if env:
         cands.append(env)
     cands.append(os.path.join(here, "libsrjt.so"))  # packaged next to the module

@@ -101,22 +101,15 @@ __all__ = [
     "arena_leak_report",
 ]
 
-_DEFAULT_SLAB_BYTES = 64 << 20
 _MIN_REGION_BYTES = 4096  # smallest buddy block (header included)
 
 
-def _env_int(name: str, default: int, minimum: int = 1) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        import warnings
+def _env_int(name: str, default: int = ...) -> int:
+    # typed registry accessor (utils/knobs.py): malformed values warn
+    # and keep the declared default, and the per-knob minimum clamps
+    from .utils import knobs
 
-        warnings.warn(f"sidecar_pool: ignoring malformed {name}={raw!r}", stacklevel=2)
-        return default
-    return max(v, minimum)
+    return knobs.get_int(name, default=default)
 
 
 def _pow2_ceil(n: int) -> int:
@@ -240,9 +233,8 @@ class ArenaSlab:
 
     def __init__(self, size_bytes: Optional[int] = None):
         if size_bytes is None:
-            size_bytes = _env_int(
-                "SRJT_ARENA_SLAB_BYTES", _DEFAULT_SLAB_BYTES, minimum=_MIN_REGION_BYTES
-            )
+            # default + minimum clamp both live in the registry row
+            size_bytes = _env_int("SRJT_ARENA_SLAB_BYTES")
         size = _pow2_ceil(max(int(size_bytes), _MIN_REGION_BYTES))
         self.size = size
         self.fd = os.memfd_create("srjt-pool-slab")
@@ -464,7 +456,7 @@ class SidecarPool:
         slab_bytes: Optional[int] = None,
     ):
         if size is None:
-            size = _env_int("SRJT_SIDECAR_POOL_SIZE", 1)
+            size = _env_int("SRJT_SIDECAR_POOL_SIZE")
         if size < 1:
             raise ValueError(f"pool size must be >= 1, got {size}")
         self.size = int(size)
@@ -473,12 +465,10 @@ class SidecarPool:
         self._env = dict(env) if env else None
         self._startup_timeout_s = float(startup_timeout_s)
         self._spawn_fn = spawn_fn
-        self._respawn_max = _env_int("SRJT_POOL_RESPAWN_MAX", 3)
-        from .utils.retry import env_float
+        self._respawn_max = _env_int("SRJT_POOL_RESPAWN_MAX")
+        from .utils import knobs
 
-        self._respawn_delay_s = env_float(
-            os.environ, "SRJT_POOL_RESPAWN_DELAY_S", 0.5
-        )
+        self._respawn_delay_s = knobs.get_float("SRJT_POOL_RESPAWN_DELAY_S")
         self._slab_bytes = slab_bytes
         self._lock = threading.RLock()
         self._rr = 0
@@ -553,7 +543,7 @@ class SidecarPool:
             if w.proc is not None:
                 try:
                     w.proc.wait(timeout=10)
-                except Exception:
+                except Exception:  # srjt-lint: allow-broad-except(best-effort shutdown: a worker that will not die in 10s gets SIGKILLed; teardown must reap every slot regardless)
                     w.proc.kill()
             if w.sock_path:
                 try:
@@ -653,11 +643,14 @@ class SidecarPool:
                 proc, sock = self._spawn_fn(
                     startup_timeout_s=self._startup_timeout_s, env=self._env
                 )
-            except BaseException as e:
+            except BaseException as e:  # srjt-lint: allow-broad-except(detached respawn supervisor: ANY spawn failure — incl. interpreter-teardown errors — is one counted attempt; escaping would kill the supervisor thread and strand the slot forever)
                 metrics.event(
                     "sidecar.pool.respawn_failed",
                     wid=w.wid, attempt=attempt, err=str(e)[:200],
                 )
+                # detached respawn supervisor thread: owns no query
+                # budget; bounded by SRJT_POOL_RESPAWN_MAX attempts and
+                # joined by shutdown
                 time.sleep(self._respawn_delay_s)
                 continue
             with self._lock:
@@ -683,7 +676,7 @@ class SidecarPool:
                     self._send_arena(w)
                     self._reg().counter("sidecar.pool.rehydrations").inc()
                     metrics.event("sidecar.pool.rehydrate", wid=w.wid)
-            except BaseException as e:
+            except BaseException as e:  # srjt-lint: allow-broad-except(respawn re-hydration: a half-born worker that cannot take the arena is reaped and the attempt counted; escaping would strand the slot with a live unreachable child)
                 metrics.event(
                     "sidecar.pool.respawn_failed",
                     wid=w.wid, attempt=attempt, err=str(e)[:200],
@@ -888,6 +881,7 @@ class SidecarPool:
         slab. The memfd outlives any single worker: respawns re-upload
         it (re-hydration), so a kill -9 never strands the data plane."""
         from . import memgov
+        from .utils.errors import DeadlineExceeded
 
         with self._lock:
             if self._slab is not None:
@@ -899,10 +893,7 @@ class SidecarPool:
                 raise ValueError("ensure_slab on a shut-down pool")
             want = self._slab_bytes
             if want is None:
-                want = _env_int(
-                    "SRJT_ARENA_SLAB_BYTES", _DEFAULT_SLAB_BYTES,
-                    minimum=_MIN_REGION_BYTES,
-                )
+                want = _env_int("SRJT_ARENA_SLAB_BYTES")
             want = max(int(want), int(min_bytes) + REGION_HDR_LEN)
             slab = ArenaSlab(want)
             self._slab = slab
@@ -916,7 +907,12 @@ class SidecarPool:
             try:
                 with w.io_lock:
                     self._send_arena(w)
-            except Exception as e:
+            except DeadlineExceeded:
+                # the QUERY's budget died mid-upload: the worker is
+                # healthy — eating this (as the pre-ISSUE-7 code did)
+                # killed a live worker and lost the deadline signal
+                raise
+            except Exception as e:  # srjt-lint: allow-broad-except(an upload failure marks THIS worker dead and routing continues on its peers; the slab itself stays valid for the survivors)
                 self._on_worker_failure(w, e)
         return slab
 

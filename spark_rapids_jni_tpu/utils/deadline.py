@@ -56,13 +56,12 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import math
-import os
 import threading
 import time
 from typing import Optional
 
+from . import knobs
 from .errors import DeadlineExceeded
-from .retry import env_float
 
 __all__ = [
     "CancelToken",
@@ -244,12 +243,9 @@ def scope(
 
 
 def _parse_env_budget() -> Optional[float]:
-    if not os.environ.get("SRJT_DEADLINE_SEC"):
-        return None
-    # shared validated parser (utils/retry.py): malformed / <= 0 warns
-    # and keeps the default — here "no ambient budget", the seed posture
-    v = env_float(os.environ, "SRJT_DEADLINE_SEC", 0.0, positive=True)
-    return v if v > 0 else None
+    # typed registry accessor (utils/knobs.py): malformed / <= 0 warns
+    # and keeps the default — None, "no ambient budget", the seed posture
+    return knobs.get_float("SRJT_DEADLINE_SEC")
 
 
 _default_budget: Optional[float] = _parse_env_budget()
@@ -335,17 +331,16 @@ class CircuitBreaker:
         self.name = name
         self._lock = threading.Lock()
         self._clock = clock
-        # env values ride env_float's warn-and-default posture; a
+        # env values ride the knobs warn-and-default posture; a
         # fractional threshold (0 < v < 1) additionally clamps to 1 so
         # int() truncation can never produce a lazily-crashing 0
         self._threshold = (
-            max(1, int(env_float(os.environ, "SRJT_BREAKER_THRESHOLD", 5,
-                                 positive=True)))
+            max(1, int(knobs.get_float("SRJT_BREAKER_THRESHOLD")))
             if threshold is None
             else int(threshold)
         )
         self._cooldown_s = (
-            env_float(os.environ, "SRJT_BREAKER_COOLDOWN_SEC", 30.0, positive=True)
+            knobs.get_float("SRJT_BREAKER_COOLDOWN_SEC")
             if cooldown_s is None
             else float(cooldown_s)
         )

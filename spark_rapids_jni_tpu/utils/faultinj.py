@@ -265,7 +265,7 @@ def maybe_inject(op_name: str) -> None:
     if kind == "delay":
         # latency, not failure: sleeps OUTSIDE the injector lock so a
         # delay storm cannot serialize every other dispatch behind it
-        time.sleep(delay_ms / 1000.0)
+        time.sleep(delay_ms / 1000.0)  # srjt-lint: allow-blocking(the injected delay IS the chaos payload; deadline scopes observe it as op latency)
         return
     if kind == "hang":
         _hang(op_name, delay_ms)  # outside the lock, like delay
@@ -330,11 +330,13 @@ def maybe_corrupt(op_name: str, data: bytes) -> bytes:
 # env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH.
 # A bad/missing config degrades the injector, never the host process
 # (the reference's injector has the same stance).
-_env_cfg = os.environ.get("SRJT_FAULTINJ_CONFIG")
+from . import knobs as _knobs
+
+_env_cfg = _knobs.get_str("SRJT_FAULTINJ_CONFIG")
 if _env_cfg:
     try:
         configure_from_file(_env_cfg)
-    except Exception as e:  # any malformed config: degrade, never crash
+    except Exception as e:  # srjt-lint: allow-broad-except(malformed chaos config degrades the injector, never the host process — the reference injector's stance)
         import warnings
 
         warnings.warn(f"faultinj: ignoring SRJT_FAULTINJ_CONFIG ({e})", stacklevel=1)

@@ -50,10 +50,10 @@ Environment:
 from __future__ import annotations
 
 import contextlib
-import os
 import struct
 import zlib
 
+from . import knobs
 from .errors import DataCorruption
 
 __all__ = [
@@ -110,11 +110,7 @@ def unpack_crc(raw: bytes, offset: int = 0) -> int:
 # enable gate (one boolean read on every guarded path)
 # ---------------------------------------------------------------------------
 
-_enabled = os.environ.get("SRJT_INTEGRITY_CHECKS", "").lower() not in (
-    "0",
-    "false",
-    "no",
-)
+_enabled = knobs.get_bool("SRJT_INTEGRITY_CHECKS")
 
 
 def enable() -> None:

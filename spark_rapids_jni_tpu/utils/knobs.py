@@ -1,0 +1,381 @@
+"""Central SRJT_* knob registry + typed environment accessors (ISSUE 7).
+
+Before this module every subsystem read ``os.environ`` directly with
+its own ad-hoc parser (``env_float`` in retry, ``_env_int`` in the
+pool, ``_env_seconds`` in the sidecar, bare ``int(raw)`` in memgov),
+and the README/PACKAGING knob tables drifted from the code — 40 knobs
+in code, 34 documented. This registry is the single source of truth:
+
+- every knob is DECLARED here once — name, type, default, validation,
+  one-line doc — and read through the typed ``get_*`` accessors,
+- ``srjt-lint`` (analysis/lint.py) fails the build on any SRJT_* string
+  literal that is not declared here, on any direct ``os.environ`` read
+  of an SRJT key outside this file, and on any drift between this
+  registry and the README/PACKAGING knob tables,
+- ``python -m spark_rapids_jni_tpu.analysis.lint --knob-table`` renders
+  the registry as the markdown table the docs embed.
+
+Parsing posture (inherited from the original ``env_float``): malformed
+values WARN and fall back to the declared default — a bad knob degrades
+the feature, never crashes an import or a query. ``positive=True``
+knobs additionally reject values <= 0 (a zero socket deadline would
+make sockets non-blocking, not timeout-free — the C++ client applies
+the same v > 0 rule).
+
+This module is deliberately dependency-free (stdlib only, no locks, no
+package imports): it must be importable by the package ``__init__``
+BEFORE the lockdep shim (analysis/lockdep.py) decides whether to
+instrument ``threading``, and by every utils module without cycles.
+
+Accessors read the environment LIVE on every call (the test hook and
+operator-override contract); modules that latch a value at import time
+(metrics/retry arming) do so explicitly at their own import site.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional
+
+__all__ = [
+    "Knob",
+    "declare",
+    "knob",
+    "all_knobs",
+    "names",
+    "is_declared",
+    "is_set",
+    "get_raw",
+    "get_str",
+    "get_bool",
+    "get_int",
+    "get_float",
+    "env_float",
+    "markdown_table",
+    "SENTINELS",
+]
+
+_TRUE = ("1", "true", "yes")
+_FALSE = ("0", "false", "no")
+
+# NOT env knobs: stdout/wire handshake sentinel lines that share the
+# SRJT_ prefix (spawn harnesses poll for them). Declared so srjt-lint
+# can tell a sentinel literal from an undeclared knob.
+SENTINELS = frozenset({"SRJT_SIDECAR_READY", "SRJT_EXCHANGE_READY"})
+
+
+class Knob:
+    """One declared knob: the registry row and its validation spec."""
+
+    __slots__ = ("name", "type", "default", "doc", "positive", "minimum",
+                 "choices", "scope")
+
+    def __init__(self, name, type, default, doc, positive=False,
+                 minimum=None, choices=None, scope="python"):
+        self.name = name
+        self.type = type  # "bool" | "int" | "float" | "str"
+        self.default = default
+        self.doc = doc
+        self.positive = positive  # floats/ints: value must be > 0
+        self.minimum = minimum  # ints: clamp floor (pool sizes etc.)
+        self.choices = choices  # strs: allowed values (warn + default)
+        # "python" | "native" | "harness": where the knob is consumed —
+        # native knobs are read by the C++ client, harness knobs by
+        # bench/test drivers; all are documented from this one registry
+        self.scope = scope
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def declare(name: str, type: str, default, doc: str, **kw) -> Knob:
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    if not name.startswith("SRJT_"):
+        raise ValueError(f"knob {name} must carry the SRJT_ prefix")
+    k = Knob(name, type, default, doc, **kw)
+    _REGISTRY[name] = k
+    return k
+
+
+def knob(name: str) -> Knob:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared knob {name!r}: declare it in utils/knobs.py "
+            "(srjt-lint enforces this)"
+        ) from None
+
+
+def all_knobs() -> Iterable[Knob]:
+    return [_REGISTRY[n] for n in sorted(_REGISTRY)]
+
+
+def names() -> frozenset:
+    return frozenset(_REGISTRY)
+
+
+def is_declared(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def _warn(msg: str) -> None:
+    import warnings
+
+    warnings.warn(f"knobs: {msg}", stacklevel=3)
+
+
+def get_raw(name: str, env=None) -> Optional[str]:
+    """The raw environment string for a declared knob, or None when
+    unset. The untyped escape hatch — prefer the typed accessors."""
+    knob(name)  # undeclared reads fail loudly, even through the API
+    return (os.environ if env is None else env).get(name)
+
+
+def is_set(name: str, env=None) -> bool:
+    """True when the knob is present AND non-empty in the environment."""
+    return bool(get_raw(name, env))
+
+
+def get_str(name: str, env=None, default=...) -> Optional[str]:
+    k = knob(name)
+    if default is ...:
+        default = k.default
+    raw = get_raw(name, env)
+    if raw is None or raw == "":
+        return default
+    if k.choices and raw.lower() not in k.choices:
+        _warn(f"unknown {name}={raw!r}; using {default!r}")
+        return default
+    return raw.lower() if k.choices else raw
+
+
+def get_bool(name: str, env=None, default=...) -> bool:
+    """Tri-state text -> bool: explicit true/false spellings win, any
+    other spelling WARNS and keeps the default (same degradation
+    contract as the numeric accessors), unset/empty keeps it silently —
+    so a default-on knob (SRJT_INTEGRITY_CHECKS) only disarms on an
+    explicit "0", and a default-off one (SRJT_METRICS_ENABLED) only
+    arms on an explicit "1"."""
+    k = knob(name)
+    if default is ...:
+        default = k.default
+    raw = get_raw(name, env)
+    if raw is None or raw == "":
+        return bool(default)
+    low = raw.lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    _warn(f"ignoring malformed {name}={raw!r}; using {bool(default)!r}")
+    return bool(default)
+
+
+def get_int(name: str, env=None, default=...) -> Optional[int]:
+    k = knob(name)
+    if default is ...:
+        default = k.default
+    raw = get_raw(name, env)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        _warn(f"ignoring malformed {name}={raw!r}; using {default!r}")
+        return default
+    if k.positive and v <= 0:
+        _warn(f"{name}={raw!r} must be > 0; keeping default {default!r}")
+        return default
+    if k.minimum is not None:
+        v = max(v, k.minimum)
+    return v
+
+
+def get_float(name: str, env=None, default=...) -> Optional[float]:
+    k = knob(name)
+    if default is ...:
+        default = k.default
+    return env_float(
+        os.environ if env is None else env, name, default,
+        positive=k.positive,
+    )
+
+
+def env_float(env, key: str, default, positive: bool = False):
+    """Parse a float env knob, warning and falling back to ``default``
+    on malformed input — and, with ``positive=True``, on values <= 0.
+    The historical shared parser (born in utils/retry.py); the typed
+    ``get_float`` accessor above is the declared-knob front door, this
+    remains for callers carrying an injected env mapping."""
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        _warn(f"ignoring malformed {key}={raw!r}")
+        return default
+    if positive and v <= 0:
+        _warn(f"{key}={raw!r} must be > 0; keeping default {default}")
+        return default
+    return v
+
+
+def markdown_table(scope: Optional[str] = None) -> str:
+    """Render the registry as the markdown knob table the docs embed
+    (``python -m spark_rapids_jni_tpu.analysis.lint --knob-table``)."""
+    rows = ["| knob | type | default | description |",
+            "|---|---|---|---|"]
+    for k in all_knobs():
+        if scope is not None and k.scope != scope:
+            continue
+        d = "—" if k.default is None else repr(k.default).strip("'\"")
+        rows.append(f"| `{k.name}` | {k.type} | `{d}` | {k.doc} |")
+    return "\n".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# THE registry: every SRJT_* knob in the tree, grouped by subsystem.
+# srjt-lint fails on any SRJT literal in code that is missing here and
+# on any entry here missing from the README/PACKAGING knob tables.
+# ---------------------------------------------------------------------------
+
+# retry orchestrator (utils/retry.py, PR 1)
+declare("SRJT_RETRY_ENABLED", "bool", False,
+        "arm op-boundary retry (bounded backoff + retry-with-split)")
+declare("SRJT_RETRY_MAX_ATTEMPTS", "int", 4,
+        "total attempts incl. the first", positive=True)
+declare("SRJT_RETRY_BASE_DELAY_MS", "float", 25.0, "first backoff delay")
+declare("SRJT_RETRY_MAX_DELAY_MS", "float", 1000.0, "backoff ceiling")
+declare("SRJT_RETRY_JITTER", "float", 0.25,
+        "multiplicative jitter fraction in [0,1)")
+declare("SRJT_RETRY_SPLIT_DEPTH", "int", 3,
+        "max halvings in retry_with_split")
+declare("SRJT_RETRY_SEED", "int", None,
+        "jitter RNG seed (deterministic chaos runs)")
+
+# deadlines + circuit breaker (utils/deadline.py, PR 3)
+declare("SRJT_DEADLINE_SEC", "float", None,
+        "ambient per-query wall-clock budget in seconds (unset: "
+        "unbounded, the seed contract)", positive=True)
+declare("SRJT_BREAKER_THRESHOLD", "int", 5,
+        "consecutive sidecar supervision failures before the breaker "
+        "opens", positive=True)
+declare("SRJT_BREAKER_COOLDOWN_SEC", "float", 30.0,
+        "breaker open -> half-open probe delay", positive=True)
+
+# metrics + tracing (utils/metrics.py / utils/tracing.py, PR 2)
+declare("SRJT_METRICS_ENABLED", "bool", False,
+        "arm hot-path instrumentation (per-op wall time, shuffle "
+        "bytes, retry/backoff counters per error class)")
+declare("SRJT_METRICS_LOG", "str", None,
+        "append one JSON object per runtime event to this path "
+        "(line-atomic, shareable across worker + client)")
+declare("SRJT_TRACE_ENABLED", "bool", False,
+        "arm jax named-scope/TraceAnnotation ranges on every op "
+        "boundary (the NVTX-range analog; visible in XProf)")
+
+# integrity + fault injection (utils/integrity.py / utils/faultinj.py)
+declare("SRJT_INTEGRITY_CHECKS", "bool", True,
+        "0 disables every CRC check (frames ship legacy framing, "
+        "spills skip verify, exchanges skip the checksum)")
+declare("SRJT_FAULTINJ_CONFIG", "str", None,
+        "JSON chaos profile path (hot-reloaded on mtime change); a "
+        "malformed config degrades the injector, never the process")
+declare("SRJT_CHAOS_EXIT_ON_OP", "int", None,
+        "sidecar worker chaos: die (exit 42) after consuming a request "
+        "for this op code, before any response")
+
+# sidecar supervision (sidecar.py, PRs 1/3/5)
+declare("SRJT_SIDECAR_TIMEOUT_SEC", "float", 600.0,
+        "per-request sidecar socket deadline (both clients; truncated "
+        "to the remaining budget under a deadline scope)",
+        positive=True)
+declare("SRJT_SIDECAR_DEADLINE_S", "float", None,
+        "float override of SRJT_SIDECAR_TIMEOUT_SEC for the Python "
+        "client (wins when both are set)", positive=True)
+declare("SRJT_SIDECAR_HEARTBEAT_S", "float", 30.0,
+        "idle-connection PING probe interval", positive=True)
+declare("SRJT_SIDECAR_STATS_TIMEOUT_SEC", "float", 5.0,
+        "STATS-verb probe deadline (throwaway connection, never the "
+        "heavy-op budget)", positive=True)
+declare("SRJT_SIDECAR_HEARTBEAT_TIMEOUT_SEC", "float", 5.0,
+        "native C++ client: heartbeat() PING deadline (NOT the "
+        "heavy-op SRJT_SIDECAR_TIMEOUT_SEC)", scope="native",
+        positive=True)
+declare("SRJT_PYTHON", "str", None,
+        "native C++ client: python executable used to fork the sidecar "
+        "worker", scope="native")
+
+# worker pool + slab arena (sidecar_pool.py, PRs 5/6)
+declare("SRJT_SIDECAR_POOL_SIZE", "int", 1,
+        "workers in the supervised pool (1 = single-worker footprint)",
+        minimum=1)
+declare("SRJT_POOL_RESPAWN_MAX", "int", 3,
+        "spawn attempts per worker death before the slot stays dead",
+        minimum=1)
+declare("SRJT_POOL_RESPAWN_DELAY_S", "float", 0.5,
+        "pause between failed respawn attempts")
+declare("SRJT_ARENA_SLAB_BYTES", "int", 64 << 20,
+        "slab arena size, rounded up to a power of two (memfd-backed, "
+        "virtual until touched)", minimum=4096)
+
+# cross-process exchange (parallel/shuffle.py, PR 6)
+declare("SRJT_EXCHANGE_MODE", "str", "mesh",
+        "mesh (in-process collective) or tcp (cross-process frames); "
+        "the --exchange-worker harness defaults to tcp and refuses "
+        "mesh", choices=("mesh", "tcp"))
+declare("SRJT_EXCHANGE_TIMEOUT_SEC", "float", 30.0,
+        "per-fetch deadline on the TCP exchange (always clamped by an "
+        "active query deadline)", positive=True)
+declare("SRJT_EXCHANGE_RETAIN_EPOCHS", "int", 4,
+        "published exchange rounds kept servable; older epochs are "
+        "evicted on publish", minimum=1)
+
+# memory governor (memgov/, PR 4)
+declare("SRJT_DEVICE_MEMORY_BUDGET", "int", None,
+        "device byte budget (read LIVE; unset: memoized backend probe "
+        "minus live bytes_in_use)")
+declare("SRJT_HOST_MEMORY_BUDGET", "int", 0,
+        "host-tier bytes before host->disk demotion (0 = unlimited)")
+declare("SRJT_SPILL_ENABLED", "bool", None,
+        "1/0 arms/disarms the governor explicitly; unset: armed iff a "
+        "device budget is declared")
+declare("SRJT_SPILL_DIR", "str", None,
+        "disk-tier directory (unset: per-process dir under the system "
+        "tempdir)")
+declare("SRJT_ADMISSION_MAX_CONCURRENT", "int", 0,
+        "cap on concurrently admitted ops (0 = bytes only)")
+declare("SRJT_ADMISSION_MAX_WAIT_SEC", "float", 30.0,
+        "admission queue wait before the retryable "
+        "MemoryBudgetExceeded", positive=True)
+declare("SRJT_MEMGOV_HEADROOM", "float", 2.0,
+        "input-bytes -> footprint multiplier for the default estimate",
+        positive=True)
+declare("SRJT_MEMGOV_DROP_SMCACHE", "bool", False,
+        "1 lets pressure drop compiled shard_map executables as a "
+        "last resort")
+
+# runtime / harness
+declare("SRJT_NATIVE_LIB", "str", None,
+        "explicit libsrjt.so path (before the packaged / dev-build "
+        "candidates)")
+declare("SRJT_TEST_TPU", "bool", False,
+        "run the hermetic test suite against real TPU devices instead "
+        "of the virtual 8-device CPU mesh", scope="harness")
+declare("SRJT_RESULTS", "str", None,
+        "bench drivers append BENCH/JSONL result rows to this path",
+        scope="harness")
+
+# correctness tooling (analysis/, ISSUE 7)
+declare("SRJT_LOCKDEP", "bool", False,
+        "arm the runtime lock-order instrumentation "
+        "(analysis/lockdep.py): records per-thread acquisition stacks, "
+        "reports lock-order cycles and blocking-while-locked events at "
+        "process exit")
+declare("SRJT_LOCKDEP_DIR", "str", "artifacts/lockdep",
+        "directory lockdep writes its per-process JSON reports into "
+        "(merged/gated by python -m "
+        "spark_rapids_jni_tpu.analysis.lockdep)")

@@ -22,8 +22,7 @@ subtraction) and the footprint estimators.
 
 from __future__ import annotations
 
-import os
-
+from . import knobs
 from .errors import RetryableError
 
 __all__ = [
@@ -91,7 +90,7 @@ def _resolve_backend_budget() -> int:
             return int(stats["bytes_limit"] * 0.5)
         if dev.platform == "tpu":
             return 8 << 30  # half of v5e's 16 GB HBM
-    except Exception:
+    except Exception:  # srjt-lint: allow-broad-except(backend probe is best-effort; any failure falls to the conservative platform default)
         pass
     return 4 << 30  # conservative CPU-tier default
 
@@ -108,9 +107,12 @@ def device_memory_budget() -> int:
     splitting, never to a zero budget). The budget is per-op headroom,
     not the raw chip size: XLA temps routinely need a small multiple of
     the declared buffers."""
-    env = os.environ.get("SRJT_DEVICE_MEMORY_BUDGET")
-    if env:
-        return int(env)
+    # `is not None`, not truthiness: an explicit 0 is a real operator
+    # contract (arm the governor, force everything over-budget), never
+    # "unset" (the declared default is None)
+    env = knobs.get_int("SRJT_DEVICE_MEMORY_BUDGET")
+    if env is not None:
+        return env
     global _RESOLVED
     if _RESOLVED is None:
         _RESOLVED = _resolve_backend_budget()
@@ -118,7 +120,7 @@ def device_memory_budget() -> int:
     if _STATS_DEV is not None:
         try:
             in_use = int(_STATS_DEV.memory_stats().get("bytes_in_use") or 0)
-        except Exception:
+        except Exception:  # srjt-lint: allow-broad-except(live bytes_in_use probe is advisory; a failed stats call must not sink the budget query)
             in_use = 0
         if in_use:
             budget = max(budget - in_use, _MIN_BUDGET)

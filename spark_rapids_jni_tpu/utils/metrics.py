@@ -48,10 +48,11 @@ from __future__ import annotations
 
 import contextlib
 import json
-import os
 import threading
 import time
 from typing import Dict, Optional
+
+from . import knobs
 
 __all__ = [
     "Counter",
@@ -314,7 +315,7 @@ def registry() -> Registry:
 # enable gate + gated convenience accessors
 # ---------------------------------------------------------------------------
 
-_enabled = os.environ.get("SRJT_METRICS_ENABLED", "").lower() in ("1", "true", "yes")
+_enabled = knobs.get_bool("SRJT_METRICS_ENABLED")
 
 
 def enable() -> None:
@@ -419,7 +420,7 @@ def timer(name: str):
 # ---------------------------------------------------------------------------
 
 _log_lock = threading.Lock()
-_log_path: Optional[str] = os.environ.get("SRJT_METRICS_LOG") or None
+_log_path: Optional[str] = knobs.get_str("SRJT_METRICS_LOG") or None
 _log_file = None
 
 

@@ -66,15 +66,17 @@ and the existing test surface keep their semantics.
 from __future__ import annotations
 
 import contextlib
-import os
 import random
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from . import knobs
 from .errors import DeadlineExceeded, FatalDeviceError, RetryableError
+from .knobs import env_float  # noqa: F401  historical home; re-exported
 
 __all__ = [
+    "env_float",
     "RetryPolicy",
     "call_with_retry",
     "retry_with_split",
@@ -88,33 +90,6 @@ __all__ = [
     "stats",
     "reset_stats",
 ]
-
-
-def env_float(env, key: str, default: float, positive: bool = False) -> float:
-    """Parse a float env knob, warning and falling back to ``default``
-    on malformed input — and, with ``positive=True``, on values <= 0
-    (matching the C++ client's v > 0 validation: a zero deadline would
-    make sockets non-blocking, not timeout-free). Shared by the retry
-    and sidecar-supervision tiers."""
-    raw = env.get(key)
-    if raw is None or raw == "":
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        import warnings
-
-        warnings.warn(f"retry: ignoring malformed {key}={raw!r}", stacklevel=2)
-        return default
-    if positive and v <= 0:
-        import warnings
-
-        warnings.warn(
-            f"retry: {key}={raw!r} must be > 0; keeping default {default}",
-            stacklevel=2,
-        )
-        return default
-    return v
 
 
 class RetryPolicy:
@@ -159,15 +134,14 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls, env=None) -> "RetryPolicy":
-        env = os.environ if env is None else env
-        seed_raw = env.get("SRJT_RETRY_SEED")
+        seed = knobs.get_int("SRJT_RETRY_SEED", env=env)
         return cls(
-            max_attempts=int(env_float(env, "SRJT_RETRY_MAX_ATTEMPTS", 4, positive=True)),
-            base_delay_ms=env_float(env, "SRJT_RETRY_BASE_DELAY_MS", 25.0),
-            max_delay_ms=env_float(env, "SRJT_RETRY_MAX_DELAY_MS", 1000.0),
-            jitter=env_float(env, "SRJT_RETRY_JITTER", 0.25),
-            split_depth=int(env_float(env, "SRJT_RETRY_SPLIT_DEPTH", 3)),
-            seed=int(seed_raw) if seed_raw else None,
+            max_attempts=int(knobs.get_float("SRJT_RETRY_MAX_ATTEMPTS", env=env)),
+            base_delay_ms=knobs.get_float("SRJT_RETRY_BASE_DELAY_MS", env=env),
+            max_delay_ms=knobs.get_float("SRJT_RETRY_MAX_DELAY_MS", env=env),
+            jitter=knobs.get_float("SRJT_RETRY_JITTER", env=env),
+            split_depth=int(knobs.get_float("SRJT_RETRY_SPLIT_DEPTH", env=env)),
+            seed=seed,
         )
 
     def backoff_ms(self, attempt: int) -> float:
@@ -251,7 +225,7 @@ except ValueError as _e:  # out-of-range knobs degrade, never crash import
 
     warnings.warn(f"retry: invalid SRJT_RETRY_* configuration ({_e}); using defaults")
     _policy = RetryPolicy()
-_enabled = os.environ.get("SRJT_RETRY_ENABLED", "").lower() in ("1", "true", "yes")
+_enabled = knobs.get_bool("SRJT_RETRY_ENABLED")
 _lock = threading.Lock()
 
 # per-thread nesting guard: only the OUTERMOST armed op_boundary owns

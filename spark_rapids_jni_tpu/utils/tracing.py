@@ -12,14 +12,15 @@ the nsight-systems replacement.
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 
 import jax
 
+from . import knobs
+
 __all__ = ["set_enabled", "is_enabled", "func_range", "profile_to"]
 
-_enabled = os.environ.get("SRJT_TRACE_ENABLED", "0") == "1"
+_enabled = knobs.get_bool("SRJT_TRACE_ENABLED")
 _lock = threading.Lock()
 
 

@@ -1,0 +1,609 @@
+"""Tests for the correctness tooling tier (ISSUE 7):
+
+- srjt-lint rule fixtures: one seeded-violation snippet per rule
+  asserting the rule FIRES, and one suppressed/compliant variant
+  asserting it doesn't (the suppression contract is part of the tool).
+- the knob registry: typed accessors, malformed-input degradation, the
+  undeclared-read failure mode, doc-table rendering.
+- runtime lockdep: a deliberate two-lock inversion proving the cycle
+  is reported, self-deadlock + blocking-while-locked detection, the
+  Condition integration, and the merge/gate CLI.
+- the integration gate: the REAL repo lints clean (so a violation a PR
+  introduces fails here before it fails premerge).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from spark_rapids_jni_tpu.analysis import lint, lockdep
+from spark_rapids_jni_tpu.utils import knobs
+
+# a hermetic registry view for snippet tests: rule scoping must not
+# drift when real knobs are added/removed
+KNOBS = frozenset({"SRJT_RETRY_ENABLED", "SRJT_DEADLINE_SEC"})
+SENTINELS = frozenset({"SRJT_SIDECAR_READY"})
+
+
+def run_lint(src, rel, rules=None):
+    vs = lint.lint_source(src, path=f"<fixture:{rel}>", rel=rel,
+                          knob_names=KNOBS, sentinels=SENTINELS)
+    if rules is None:
+        return vs
+    return [v for v in vs if v.rule in rules]
+
+
+# ---------------------------------------------------------------------------
+# SRJT001: undeclared knob literals
+# ---------------------------------------------------------------------------
+
+
+def test_undeclared_knob_literal_fires():
+    vs = run_lint('x = os.environ\nk = "SRJT_BOGUS_KNOB"\n', "utils/x.py",
+                  {"SRJT001"})
+    assert len(vs) == 1 and "SRJT_BOGUS_KNOB" in vs[0].message
+
+
+def test_declared_knob_and_sentinel_pass():
+    src = 'a = "SRJT_RETRY_ENABLED"\nb = "SRJT_SIDECAR_READY"\n'
+    assert run_lint(src, "utils/x.py", {"SRJT001"}) == []
+
+
+def test_family_glob_in_prose_passes():
+    # "SRJT_RETRY_*" names a declared family, not an undeclared knob
+    assert run_lint('doc = "set SRJT_RETRY_* to tune"\n', "utils/x.py",
+                    {"SRJT001"}) == []
+
+
+def test_knob_suppression_works():
+    src = 'k = "SRJT_BOGUS"  # srjt-lint: allow-knob(doc example)\n'
+    assert run_lint(src, "utils/x.py", {"SRJT001"}) == []
+
+
+def test_knobs_module_itself_is_exempt():
+    assert run_lint('declare("SRJT_NEW_ONE", "int", 1, "d")\n',
+                    "utils/knobs.py", {"SRJT001"}) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT002: direct environ reads
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("src", [
+    'import os\nv = os.environ.get("SRJT_RETRY_ENABLED")\n',
+    'import os\nv = os.environ["SRJT_RETRY_ENABLED"]\n',
+    'import os\nv = os.getenv("SRJT_RETRY_ENABLED")\n',
+    'import os\nk = "SRJT_" + name\nv = os.environ.get(k)\n',  # dynamic key
+])
+def test_direct_environ_read_fires(src):
+    assert len(run_lint(src, "memgov/x.py", {"SRJT002"})) == 1
+
+
+def test_non_srjt_reads_and_writes_pass():
+    src = ('import os\n'
+           'v = os.environ.get("JAX_PLATFORMS")\n'
+           'os.environ["SRJT_RETRY_ENABLED"] = "1"\n')
+    assert run_lint(src, "memgov/x.py", {"SRJT002"}) == []
+
+
+def test_environ_suppression_works():
+    src = ('import os\n'
+           'v = os.environ.get("SRJT_RETRY_ENABLED")  '
+           '# srjt-lint: allow-environ(bootstrap read)\n')
+    assert run_lint(src, "x.py", {"SRJT002"}) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT003: banned raises in governed modules
+# ---------------------------------------------------------------------------
+
+
+def test_raise_runtimeerror_in_governed_module_fires():
+    src = 'def f():\n    raise RuntimeError("boom")\n'
+    for rel in ("ops/x.py", "memgov/x.py", "parallel/x.py", "sidecar.py"):
+        assert len(run_lint(src, rel, {"SRJT003"})) == 1, rel
+
+
+def test_raise_outside_governed_scope_passes():
+    src = 'def f():\n    raise RuntimeError("boom")\n'
+    assert run_lint(src, "io/x.py", {"SRJT003"}) == []
+
+
+def test_taxonomy_raise_passes():
+    src = 'def f():\n    raise RetryableError("transient")\n'
+    assert run_lint(src, "ops/x.py", {"SRJT003"}) == []
+
+
+def test_raise_suppression_works():
+    src = ('def f():\n'
+           '    raise RuntimeError("wire")  '
+           '# srjt-lint: allow-raise(semantic wire error)\n')
+    assert run_lint(src, "ops/x.py", {"SRJT003"}) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT004: broad excepts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("handler", [
+    "except Exception:\n        pass",
+    "except:\n        pass",
+    "except (ValueError, Exception):\n        pass",
+    "except BaseException:\n        pass",
+])
+def test_swallowing_broad_except_fires(handler):
+    src = f"def f():\n    try:\n        g()\n    {handler}\n"
+    assert len(run_lint(src, "utils/x.py", {"SRJT004"})) == 1
+
+
+@pytest.mark.parametrize("handler", [
+    "except Exception:\n        raise",                       # re-raise
+    "except Exception as e:\n        raise classify(e)",      # wrap
+    "except Exception as e:\n        raise DataCorruption(str(e))",
+    "except ValueError:\n        pass",                       # narrow is fine
+])
+def test_compliant_broad_except_passes(handler):
+    src = f"def f():\n    try:\n        g()\n    {handler}\n"
+    assert run_lint(src, "utils/x.py", {"SRJT004"}) == []
+
+
+def test_broad_except_suppression_inline_and_above():
+    inline = ("def f():\n    try:\n        g()\n"
+              "    except Exception:  "
+              "# srjt-lint: allow-broad-except(best effort)\n        pass\n")
+    above = ("def f():\n    try:\n        g()\n"
+             "    # srjt-lint: allow-broad-except(best effort)\n"
+             "    except Exception:\n        pass\n")
+    assert run_lint(inline, "utils/x.py", {"SRJT004"}) == []
+    assert run_lint(above, "utils/x.py", {"SRJT004"}) == []
+
+
+def test_suppression_without_reason_is_its_own_violation():
+    src = ("def f():\n    try:\n        g()\n"
+           "    except Exception:  # srjt-lint: allow-broad-except()\n"
+           "        pass\n")
+    vs = run_lint(src, "utils/x.py")
+    assert [v.rule for v in vs] == ["SRJT000"]
+    assert "needs a reason" in vs[0].message
+
+
+def test_unknown_suppression_kind_is_flagged():
+    src = "x = 1  # srjt-lint: allow-wat(huh)\n"
+    vs = run_lint(src, "utils/x.py")
+    assert [v.rule for v in vs] == ["SRJT000"]
+
+
+def test_stale_suppression_is_flagged():
+    # a reasoned suppression on a line where the rule never fires is
+    # rot: the code it excused is gone
+    src = "x = 1  # srjt-lint: allow-blocking(was a sleep once)\n"
+    vs = run_lint(src, "sidecar.py")
+    assert [v.rule for v in vs] == ["SRJT000"]
+    assert "stale" in vs[0].message
+
+
+def test_aliased_environ_read_fires():
+    # `import os as _os` does not launder a direct read
+    src = 'import os as _os\nv = _os.environ.get("SRJT_RETRY_ENABLED")\n'
+    assert len(run_lint(src, "x.py", {"SRJT002"})) == 1
+
+
+# ---------------------------------------------------------------------------
+# SRJT005: hot-path stub discipline
+# ---------------------------------------------------------------------------
+
+
+def test_work_before_gate_fires():
+    src = ('def counter(name):\n'
+           '    label = f"metric:{name}"\n'
+           '    if not _enabled:\n'
+           '        return _STUB\n'
+           '    return _real(label)\n')
+    vs = run_lint(src, "utils/metrics.py", {"SRJT005"})
+    assert len(vs) == 1 and "f-string" in vs[0].message
+
+
+def test_work_after_gate_passes():
+    src = ('def counter(name):\n'
+           '    if not _enabled:\n'
+           '        return _STUB\n'
+           '    return _real(f"metric:{name}")\n')
+    assert run_lint(src, "utils/metrics.py", {"SRJT005"}) == []
+
+
+def test_stub_rule_only_governs_stub_modules():
+    src = ('def f(name):\n'
+           '    label = f"x:{name}"\n'
+           '    if not _enabled:\n'
+           '        return None\n'
+           '    return label\n')
+    assert run_lint(src, "ops/x.py", {"SRJT005"}) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT006: blocking calls must be deadline-aware
+# ---------------------------------------------------------------------------
+
+
+def test_blind_sleep_in_governed_module_fires():
+    src = 'import time\ndef f():\n    time.sleep(1)\n'
+    assert len(run_lint(src, "sidecar.py", {"SRJT006"})) == 1
+
+
+def test_deadline_aware_function_passes():
+    src = ('import time\n'
+           'def f(deadline):\n'
+           '    time.sleep(min(1, deadline.remaining()))\n')
+    assert run_lint(src, "sidecar.py", {"SRJT006"}) == []
+
+
+def test_blocking_rule_scoped_to_governed_modules():
+    src = 'import time\ndef f():\n    time.sleep(1)\n'
+    assert run_lint(src, "models/x.py", {"SRJT006"}) == []
+
+
+def test_blocking_suppression_works():
+    src = ('import time\n'
+           'def f():\n'
+           '    time.sleep(1)  # srjt-lint: allow-blocking(no budget)\n')
+    assert run_lint(src, "sidecar.py", {"SRJT006"}) == []
+
+
+def test_settimeout_and_recv_governed():
+    src = ('def f(sock):\n'
+           '    sock.settimeout(5)\n'
+           '    return sock.recv(4)\n')
+    assert len(run_lint(src, "parallel/x.py", {"SRJT006"})) == 2
+
+
+# ---------------------------------------------------------------------------
+# SRJT007: registry <-> doc-table drift
+# ---------------------------------------------------------------------------
+
+
+def test_doc_drift_both_directions(tmp_path):
+    (tmp_path / "README.md").write_text(
+        "| `SRJT_RETRY_ENABLED` | arm retry |\n"
+        "| `SRJT_GHOST_KNOB` | documented but gone |\n")
+    vs = lint.check_docs(str(tmp_path), knob_names=KNOBS,
+                         sentinels=SENTINELS)
+    rules = sorted((v.rule, v.message.split()[2]) for v in vs)
+    # SRJT_GHOST_KNOB documented-but-undeclared + SRJT_DEADLINE_SEC
+    # declared-but-undocumented
+    assert ("SRJT007", "SRJT_GHOST_KNOB") in rules
+    assert any("SRJT_DEADLINE_SEC" in v.message for v in vs)
+    assert all(v.rule == "SRJT007" for v in vs)
+
+
+def test_prose_mention_is_not_documentation(tmp_path):
+    # SRJT_DEADLINE_SEC only in prose, never in a table row: the
+    # "documented" direction requires a knob-table row
+    (tmp_path / "README.md").write_text(
+        "| `SRJT_RETRY_ENABLED` | arm retry |\n"
+        "Set SRJT_DEADLINE_SEC for budgets.\n")
+    vs = lint.check_docs(str(tmp_path), knob_names=KNOBS,
+                         sentinels=SENTINELS)
+    assert len(vs) == 1 and "SRJT_DEADLINE_SEC" in vs[0].message
+    assert "knob-table row" in vs[0].message
+
+
+def test_truncated_name_in_table_row_is_drift(tmp_path):
+    # prefix allowance is for wrapped ASCII diagrams in prose only; a
+    # truncated name inside a table row is exactly the drift to catch
+    (tmp_path / "README.md").write_text(
+        "| `SRJT_RETRY` | truncated row |\n"
+        "  diagram: SRJT_RETRY (wrapped)\n"
+        "| `SRJT_RETRY_ENABLED` | ok |\n"
+        "| `SRJT_DEADLINE_SEC` | ok |\n")
+    vs = lint.check_docs(str(tmp_path), knob_names=KNOBS,
+                         sentinels=SENTINELS)
+    assert len(vs) == 1 and vs[0].line == 1
+
+
+def test_syntax_error_reports_not_crashes():
+    vs = run_lint("def f(:\n", "utils/x.py")
+    assert [v.rule for v in vs] == ["SRJT999"]
+
+
+# ---------------------------------------------------------------------------
+# the integration gate: the real repo is clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    vs = lint.run()
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+def test_knob_table_cli_renders(capsys):
+    assert lint.main(["--knob-table"]) == 0
+    out = capsys.readouterr().out
+    assert "| `SRJT_RETRY_ENABLED` | bool |" in out
+
+
+# ---------------------------------------------------------------------------
+# the knob registry
+# ---------------------------------------------------------------------------
+
+
+def test_undeclared_knob_read_fails_loudly():
+    with pytest.raises(KeyError, match="undeclared knob"):
+        knobs.get_raw("SRJT_NOT_A_KNOB")
+
+
+def test_typed_accessors_and_defaults(monkeypatch):
+    monkeypatch.delenv("SRJT_RETRY_MAX_ATTEMPTS", raising=False)
+    assert knobs.get_int("SRJT_RETRY_MAX_ATTEMPTS") == 4
+    monkeypatch.setenv("SRJT_RETRY_MAX_ATTEMPTS", "7")
+    assert knobs.get_int("SRJT_RETRY_MAX_ATTEMPTS") == 7
+
+
+def test_malformed_value_warns_and_degrades(monkeypatch):
+    monkeypatch.setenv("SRJT_RETRY_MAX_ATTEMPTS", "banana")
+    with pytest.warns(UserWarning, match="malformed"):
+        assert knobs.get_int("SRJT_RETRY_MAX_ATTEMPTS") == 4
+
+
+def test_positive_knob_rejects_nonpositive(monkeypatch):
+    monkeypatch.setenv("SRJT_SIDECAR_TIMEOUT_SEC", "-3")
+    with pytest.warns(UserWarning, match="must be > 0"):
+        assert knobs.get_float("SRJT_SIDECAR_TIMEOUT_SEC") == 600.0
+
+
+def test_bool_tristate(monkeypatch):
+    # default-on knob only disarms on an explicit false spelling
+    for raw, expect in (("0", False), ("false", False), ("no", False),
+                        ("1", True), ("", True)):
+        monkeypatch.setenv("SRJT_INTEGRITY_CHECKS", raw)
+        assert knobs.get_bool("SRJT_INTEGRITY_CHECKS") is expect, raw
+    # unrecognized spellings warn and keep the default (never a silent
+    # arm/disarm surprise)
+    monkeypatch.setenv("SRJT_INTEGRITY_CHECKS", "weird")
+    with pytest.warns(UserWarning, match="malformed"):
+        assert knobs.get_bool("SRJT_INTEGRITY_CHECKS") is True
+
+
+def test_minimum_clamp(monkeypatch):
+    monkeypatch.setenv("SRJT_SIDECAR_POOL_SIZE", "0")
+    assert knobs.get_int("SRJT_SIDECAR_POOL_SIZE") == 1
+
+
+def test_choices_knob(monkeypatch):
+    monkeypatch.setenv("SRJT_EXCHANGE_MODE", "TCP")
+    assert knobs.get_str("SRJT_EXCHANGE_MODE") == "tcp"
+    monkeypatch.setenv("SRJT_EXCHANGE_MODE", "carrier-pigeon")
+    with pytest.warns(UserWarning, match="unknown"):
+        assert knobs.get_str("SRJT_EXCHANGE_MODE") == "mesh"
+
+
+def test_explicit_zero_budget_is_respected(monkeypatch):
+    # "0" is a real operator contract (force everything over-budget),
+    # not "unset": the int accessor must not be truth-tested away
+    from spark_rapids_jni_tpu.utils import memory
+
+    monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", "0")
+    assert memory.device_memory_budget() == 0
+
+
+def test_double_declare_fails():
+    with pytest.raises(ValueError, match="declared twice"):
+        knobs.declare("SRJT_RETRY_ENABLED", "bool", False, "dup")
+
+
+def test_markdown_table_covers_registry():
+    table = knobs.markdown_table()
+    for k in knobs.all_knobs():
+        assert f"`{k.name}`" in table
+
+
+# ---------------------------------------------------------------------------
+# runtime lockdep
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def armed_lockdep():
+    """Arm the shim for one test without disturbing a session that was
+    already armed via SRJT_LOCKDEP=1 (premerge runs exactly that)."""
+    was = lockdep.is_installed()
+    lockdep.install()
+    with lockdep.isolated_state() as st:
+        yield st
+    if not was:
+        lockdep.uninstall()
+
+
+def test_two_lock_inversion_reports_cycle(armed_lockdep):
+    a, b = threading.Lock(), threading.Lock()
+    assert type(a).__name__ == "_TrackedLock", "factory not patched"
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # the deliberate inversion: B -> A after A -> B
+            pass
+    rep = lockdep.report(armed_lockdep)
+    assert len(rep["cycles"]) == 1
+    locks = rep["cycles"][0]["locks"]
+    assert len(locks) == 2 and all("test_analysis.py" in s for s in locks)
+    # both directed edges exist and carry a sample stack
+    assert {(e["from_key"], e["to_key"]) for e in rep["edges"]} == {
+        (rep["cycles"][0]["keys"][0], rep["cycles"][0]["keys"][1]),
+        (rep["cycles"][0]["keys"][1], rep["cycles"][0]["keys"][0]),
+    }
+    assert all(e["stack"] for e in rep["edges"])
+
+
+def test_consistent_order_reports_no_cycle(armed_lockdep):
+    a, b = threading.Lock(), threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lockdep.report(armed_lockdep)
+    assert rep["cycles"] == [] and len(rep["edges"]) == 1
+    assert rep["edges"][0]["count"] == 3
+
+
+def test_self_deadlock_detected(armed_lockdep):
+    lk = threading.Lock()
+    with lk:
+        # second acquisition of a held non-reentrant lock: recorded,
+        # then attempted non-blocking so the test cannot hang
+        assert lk.acquire(blocking=False) is False
+    rep = lockdep.report(armed_lockdep)
+    assert len(rep["self_deadlocks"]) == 1
+
+
+def test_rlock_reentry_is_not_a_self_deadlock(armed_lockdep):
+    lk = threading.RLock()
+    with lk:
+        with lk:
+            pass
+    rep = lockdep.report(armed_lockdep)
+    assert rep["self_deadlocks"] == [] and rep["cycles"] == []
+
+
+def test_sleep_while_locked_recorded(armed_lockdep):
+    import time
+
+    lk = threading.Lock()
+    time.sleep(0)  # unlocked: not an event
+    with lk:
+        time.sleep(0)
+    rep = lockdep.report(armed_lockdep)
+    assert rep["blocking_total"] == 1
+    assert rep["blocking_events"][0]["locks_held"]
+
+
+def test_condition_wait_keeps_held_stack_exact(armed_lockdep):
+    cond = threading.Condition(threading.Lock())
+    outer = threading.Lock()
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            # post-wait the lock is re-held: a nested acquire must
+            # record the cond -> outer edge from a correct held stack
+            with outer:
+                hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # let the waiter block, then notify under the condition: if wait()
+    # leaked a stale held entry this acquisition would self-report
+    import time
+
+    time.sleep(0.05)
+    with cond:
+        cond.notify()
+    t.join(10)
+    assert hits == [1]
+    rep = lockdep.report(armed_lockdep)
+    assert rep["cycles"] == [] and rep["self_deadlocks"] == []
+
+
+def test_threads_have_independent_held_stacks(armed_lockdep):
+    a, b = threading.Lock(), threading.Lock()
+    barrier = threading.Barrier(2, timeout=10)
+
+    def hold(lock):
+        with lock:
+            barrier.wait()  # both locks held, in different threads
+            barrier.wait()
+
+    t1 = threading.Thread(target=hold, args=(a,))
+    t2 = threading.Thread(target=hold, args=(b,))
+    t1.start(), t2.start()
+    t1.join(10), t2.join(10)
+    # concurrent holders in separate threads are NOT an ordering edge
+    assert lockdep.report(armed_lockdep)["edges"] == []
+
+
+def test_find_cycles_unit():
+    assert lockdep.find_cycles({(1, 2), (2, 3)}) == []
+    assert lockdep.find_cycles({(1, 2), (2, 1), (3, 4)}) == [[1, 2]]
+    assert lockdep.find_cycles({(5, 5)}) == [[5]]
+    assert lockdep.find_cycles({(1, 2), (2, 3), (3, 1)}) == [[1, 2, 3]]
+
+
+def test_write_merge_and_gate_cli(tmp_path, armed_lockdep, capsys):
+    a, b = threading.Lock(), threading.Lock()
+    with a:
+        with b:
+            pass
+    p = lockdep.write_report(str(tmp_path / "lockdep_1.json"))
+    rep = json.loads(open(p).read())
+    assert rep["edges"] and rep["cycles"] == []
+    # a second process's report carrying a cycle must fail the gate
+    (tmp_path / "lockdep_2.json").write_text(json.dumps({
+        "pid": 99, "locks": {}, "edges": [],
+        "cycles": [{"locks": ["x.py:1", "y.py:2"], "keys": [1, 2]}],
+        "self_deadlocks": [], "blocking_events": [], "blocking_total": 2,
+    }))
+    out = str(tmp_path / "merged.json")
+    rc = lockdep.main(["--merge", str(tmp_path), "--out", out])
+    capsys.readouterr()
+    assert rc == 1
+    merged = json.loads(open(out).read())
+    assert merged["reports"] == 2 and len(merged["cycles"]) == 1
+    assert merged["blocking_total"] == 2
+    # clean reports gate green
+    (tmp_path / "lockdep_2.json").unlink()
+    assert lockdep.main(["--merge", str(tmp_path), "--out", out]) == 0
+    capsys.readouterr()
+
+
+def test_flush_report_never_writes_from_isolated_state(
+        armed_lockdep, tmp_path, monkeypatch):
+    # the worker-shutdown flush must not let a test universe scribble
+    # artifacts the CI gate would merge
+    monkeypatch.setenv("SRJT_LOCKDEP_DIR", str(tmp_path / "ld"))
+    lockdep.flush_report()
+    assert not (tmp_path / "ld").exists()
+
+
+def test_cross_process_inversion_fails_merge_gate(tmp_path, capsys):
+    # each process is acyclic per-instance, but tier A took X before Y
+    # and tier B took Y before X: only the merged SITE graph shows it
+    def rep(frm, to):
+        return {"pid": 1, "locks": {}, "cycles": [], "self_deadlocks": [],
+                "blocking_events": [], "blocking_total": 0,
+                "edges": [{"from": frm, "to": to, "from_key": 1,
+                           "to_key": 2, "count": 1}]}
+    (tmp_path / "lockdep_a.json").write_text(json.dumps(rep("x.py:1", "y.py:2")))
+    (tmp_path / "lockdep_b.json").write_text(json.dumps(rep("y.py:2", "x.py:1")))
+    merged = lockdep.merge_reports(str(tmp_path))
+    assert merged["cycles"] == []  # no per-process cycle anywhere...
+    assert len(merged["site_cycles"]) == 1  # ...but the inversion is real
+    assert sorted(merged["site_cycles"][0]["locks"]) == ["x.py:1", "y.py:2"]
+    assert lockdep.main(["--merge", str(tmp_path)]) == 1
+    capsys.readouterr()
+    # same-site self-edges are advisory, never a cycle
+    (tmp_path / "lockdep_b.json").write_text(json.dumps(rep("x.py:1", "x.py:1")))
+    merged = lockdep.merge_reports(str(tmp_path))
+    assert merged["site_cycles"] == []
+    assert merged["site_self_edges"] == ["x.py:1"]
+    assert lockdep.main(["--merge", str(tmp_path)]) == 0
+    capsys.readouterr()
+
+
+def test_gate_fails_on_missing_reports(tmp_path, capsys):
+    assert lockdep.main(["--merge", str(tmp_path / "nope")]) == 2
+    os.makedirs(tmp_path / "empty")
+    assert lockdep.main(["--merge", str(tmp_path / "empty")]) == 2
+    assert lockdep.main(
+        ["--merge", str(tmp_path / "empty"), "--allow-empty"]) == 0
+    capsys.readouterr()
+
+
+def test_disarmed_package_leaves_threading_untouched():
+    # this suite may run armed (premerge) or not; the invariant either
+    # way: patched iff installed
+    patched = threading.Lock is not lockdep._ORIG_LOCK
+    assert patched == lockdep.is_installed()
