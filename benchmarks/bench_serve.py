@@ -1,0 +1,323 @@
+"""Serving benchmark: sustained QPS + tail latency for a mixed TPC
+q1/q6/q98 workload at fixed offered load, with a chaos-under-load tier
+(ISSUE 8).
+
+Two modes, both emitting BENCH rows (JSON lines, the bench.py /
+bench_pool.py discipline; ``SRJT_RESULTS`` appends them to a file):
+
+- **steady** (default): N queries submitted at ``--offered-qps``
+  across ``--tenants`` tenants into a ``serve.Scheduler``; every
+  completed query is verified BIT-IDENTICAL to its sequential oracle
+  before it counts. The row carries sustained QPS and p50/p99/p999
+  end-to-end latency (queue wait included — that is what a caller
+  sees).
+- **chaos** (``--chaos``): the same workload while
+  ``ci/chaos_serve.json`` storms the runtime — `reject` sheds at the
+  serve.admit choke point, retryable + delay + hang faults on the ops
+  the queries cross, and `crash` (kill -9 before answering) inside a
+  REAL sidecar worker pool of ``--pool-size`` that every query also
+  routes one arena op through. Asserts: zero wrong answers, every shed
+  surfaced as retryable ``Overloaded`` (never a timeout), bounded
+  p999 (<= the per-query deadline), ``serve.shed_total > 0``, and
+  ``sidecar.pool.failovers > 0`` (the storm really fired). Exit 1 on
+  any violation — this is the premerge serve tier's gate.
+
+Usage::
+
+    python benchmarks/bench_serve.py                      # steady BENCH row
+    python benchmarks/bench_serve.py --chaos --pool-size 2
+    SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
+        python benchmarks/bench_serve.py --chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import time
+
+os.environ.setdefault("SRJT_METRICS_ENABLED", "1")  # counters feed the rows
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from spark_rapids_jni_tpu import serve
+from spark_rapids_jni_tpu.models import tpcds, tpch
+from spark_rapids_jni_tpu.utils import faultinj, metrics, retry
+from spark_rapids_jni_tpu.utils.errors import (
+    DeadlineExceeded,
+    Overloaded,
+)
+
+_CHAOS_PROFILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ci", "chaos_serve.json",
+)
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+    out_path = os.environ.get("SRJT_RESULTS")
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _counter(name: str) -> int:
+    return metrics.registry().value(name)
+
+
+def _tables_equal(got, want) -> bool:
+    if got.names != want.names or got.num_rows != want.num_rows:
+        return False
+    for n in want.names:
+        if not np.array_equal(
+            np.asarray(got.column(n).data), np.asarray(want.column(n).data)
+        ):
+            return False
+    return True
+
+
+def _groupby_payload(n: int = 400, k: int = 16, seed: int = 3) -> bytes:
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, k, n).astype(np.int64)
+    vals = rng.standard_normal(n).astype(np.float32)
+    return struct.pack("<IQ", k, n) + keys.tobytes() + vals.tobytes()
+
+
+class _Workload:
+    """The mixed q1/q6/q98 query set: oracles computed once
+    sequentially (which also warms every XLA compile cache), then each
+    query re-runs the pipeline and verifies bit-identical before
+    counting as completed."""
+
+    def __init__(self, rows: int, seed: int, pool=None, pool_payload=None,
+                 pool_want=None):
+        self.lineitem = tpch.gen_lineitem(rows, seed=seed)
+        self.store = tpcds.gen_store(max(rows // 2, 1000), seed=seed)
+        t0 = time.perf_counter()
+        self.want_q1 = tpch.q1(self.lineitem)
+        self.want_q6 = tpch.q6(self.lineitem)
+        self.want_q98 = tpcds.q98(self.store)
+        self.oracle_secs = time.perf_counter() - t0
+        self.pool = pool
+        self.pool_payload = pool_payload
+        self.pool_want = pool_want
+        self.wrong: list = []
+        self.end_times: dict = {}
+
+    def _pool_leg(self):
+        """The device-path leg under crash chaos: one arena op through
+        the REAL worker pool, answer checked against the host oracle —
+        a kill -9 mid-request must surface as a healed failover, never
+        a wrong answer."""
+        if self.pool is None:
+            return
+        from spark_rapids_jni_tpu import sidecar
+
+        got = self.pool.call_arena(
+            sidecar.OP_GROUPBY_SUM_F32, self.pool_payload
+        )
+        if got != self.pool_want:
+            self.wrong.append("pool groupby diverged from host oracle")
+
+    def make(self, kind: str, qid: int):
+        def run():
+            if kind == "q1":
+                if not _tables_equal(tpch.q1(self.lineitem), self.want_q1):
+                    self.wrong.append(f"{qid}: q1 diverged")
+            elif kind == "q6":
+                if tpch.q6(self.lineitem) != self.want_q6:
+                    self.wrong.append(f"{qid}: q6 diverged")
+            else:
+                if not _tables_equal(tpcds.q98(self.store), self.want_q98):
+                    self.wrong.append(f"{qid}: q98 diverged")
+            self._pool_leg()
+            self.end_times[qid] = time.perf_counter()
+            return kind
+
+        return run
+
+
+def run_bench(args) -> int:
+    pool = None
+    pool_payload = pool_want = None
+    if args.chaos:
+        faultinj.configure_from_file(args.profile)
+        if not retry.is_enabled():
+            # the chaos tier is meaningless without the recovery loop
+            retry.configure(max_attempts=10, base_delay_ms=2,
+                            max_delay_ms=50, seed=17)
+            retry.enable()
+        if args.pool_size > 0:
+            from spark_rapids_jni_tpu import sidecar, sidecar_pool
+
+            pool_payload = _groupby_payload()
+            pool_want = sidecar._dispatch(
+                sidecar.OP_GROUPBY_SUM_F32, pool_payload, "cpu"
+            )
+            pool = sidecar_pool.SidecarPool(
+                size=args.pool_size, deadline_s=60, heartbeat_s=1e9,
+                startup_timeout_s=args.startup_timeout,
+                env={"SRJT_FAULTINJ_CONFIG": args.profile},
+            )
+            pool.call_arena(sidecar.OP_GROUPBY_SUM_F32, pool_payload)
+
+    wl = _Workload(args.rows, args.seed, pool, pool_payload, pool_want)
+    print(f"# oracles computed sequentially in {wl.oracle_secs:.1f}s "
+          f"(compile-warm)", flush=True)
+
+    sched = serve.Scheduler(
+        max_concurrent=args.max_concurrent,
+        queue_depth=args.queue_depth,
+        name="bench",
+    )
+    mix = ["q1", "q6", "q1", "q6", "q98"]
+    handles = {}
+    submit_times = {}
+    shed: dict = {}
+    bad_shed: list = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(args.queries):
+            t_next = t0 + i / args.offered_qps
+            dt = t_next - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            kind = mix[i % len(mix)]
+            tenant = f"tenant{i % args.tenants}"
+            try:
+                submit_times[i] = time.perf_counter()
+                handles[i] = sched.submit(
+                    wl.make(kind, i),
+                    tenant=tenant,
+                    deadline_s=args.deadline_s,
+                    priority=5 if i % 11 == 0 else 0,
+                )
+            except Overloaded as e:
+                shed[e.cause] = shed.get(e.cause, 0) + 1
+            except Exception as e:  # a shed MUST be Overloaded, period
+                bad_shed.append(f"{i}: {type(e).__name__}: {e}")
+
+        completed = {}
+        failures: dict = {}
+        for i, h in sorted(handles.items()):
+            try:
+                completed[i] = h.result(args.deadline_s + 60)
+            except Overloaded as e:
+                # evicted from the queue by a higher-priority arrival
+                shed[e.cause] = shed.get(e.cause, 0) + 1
+            except DeadlineExceeded:
+                failures["deadline_exceeded"] = (
+                    failures.get("deadline_exceeded", 0) + 1
+                )
+            except Exception as e:
+                failures[type(e).__name__] = (
+                    failures.get(type(e).__name__, 0) + 1
+                )
+                bad_shed.append(f"{i}: {type(e).__name__}: {e}")
+        t_last = max(wl.end_times.values()) if wl.end_times else t0
+    finally:
+        sched.shutdown(drain=False, timeout_s=60)
+        if pool is not None:
+            pool.shutdown()
+        faultinj.disable()
+
+    lat_ms = sorted(
+        (wl.end_times[i] - submit_times[i]) * 1e3 for i in completed
+    )
+    if lat_ms:
+        p50, p99, p999 = np.percentile(lat_ms, [50, 99, 99.9])
+    else:
+        p50 = p99 = p999 = float("nan")
+    span = max(t_last - t0, 1e-9)
+    qps = len(completed) / span
+    shed_total = _counter("serve.shed_total")
+    failovers = _counter("sidecar.pool.failovers")
+    row = {
+        "metric": "serve_mixed_qps",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "offered_qps": args.offered_qps,
+        "queries": args.queries,
+        "completed": len(completed),
+        "shed": sum(shed.values()),
+        "shed_causes": shed,
+        "failures": failures,
+        "wrong_answers": len(wl.wrong),
+        "p50_ms": round(float(p50), 2),
+        "p99_ms": round(float(p99), 2),
+        "p999_ms": round(float(p999), 2),
+        "deadline_s": args.deadline_s,
+        "max_concurrent": args.max_concurrent,
+        "tenants": args.tenants,
+        "rows": args.rows,
+        "chaos": bool(args.chaos),
+        "pool_size": args.pool_size if args.chaos else 0,
+        "failovers": failovers,
+        "shed_total_counter": shed_total,
+        "expired_in_queue": _counter("serve.expired_in_queue"),
+        "bit_identical": not wl.wrong,
+    }
+    _emit(row)
+    if metrics.is_enabled():
+        _emit({"metrics": metrics.stage_report("serve_bench")})
+
+    rc = 0
+    if wl.wrong:
+        print(f"WRONG ANSWERS ({len(wl.wrong)}): {wl.wrong[:5]}",
+              file=sys.stderr)
+        rc = 1
+    if bad_shed:
+        print(f"non-Overloaded admission failures: {bad_shed[:5]}",
+              file=sys.stderr)
+        rc = 1
+    if args.chaos:
+        if shed_total <= 0:
+            print("chaos tier shed nothing (serve.shed_total == 0)",
+                  file=sys.stderr)
+            rc = 1
+        if lat_ms and p999 > args.deadline_s * 1e3:
+            print(f"p999 {p999:.0f} ms exceeds the {args.deadline_s}s "
+                  "deadline: enforcement broke", file=sys.stderr)
+            rc = 1
+        if args.pool_size > 0 and failovers <= 0:
+            print("crash storm produced no pool failover", file=sys.stderr)
+            rc = 1
+        if not completed:
+            print("chaos tier completed zero queries", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=50_000,
+                    help="lineitem rows (store fact is rows/2)")
+    ap.add_argument("--queries", type=int, default=120)
+    ap.add_argument("--offered-qps", type=float, default=30.0,
+                    help="fixed offered load (arrival schedule)")
+    ap.add_argument("--max-concurrent", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=32)
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--deadline-s", type=float, default=30.0,
+                    help="per-query budget, spanning queue wait")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm ci/chaos_serve.json while serving and "
+                    "gate on the chaos invariants")
+    ap.add_argument("--profile", default=_CHAOS_PROFILE,
+                    help="chaos profile path (default ci/chaos_serve.json)")
+    ap.add_argument("--pool-size", type=int, default=2,
+                    help="REAL sidecar workers for the chaos crash leg "
+                    "(0 = no pool)")
+    ap.add_argument("--startup-timeout", type=float, default=180.0)
+    return run_bench(ap.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

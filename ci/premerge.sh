@@ -163,9 +163,57 @@ print(f"archived {len(lines)} data-plane events -> "
       "artifacts/data_plane_metrics.jsonl")
 EOF
 
+# serving tier (ISSUE 8): the full serve suite (incl. the slow
+# chaos-under-load acceptance) env-armed, then bench_serve's chaos
+# gate — a crash+hang+reject storm WHILE serving a mixed q1/q6/q98
+# workload through a REAL worker pool of 2. The bench exits nonzero
+# unless every completed query is bit-identical to its sequential
+# oracle, every shed surfaced as retryable Overloaded (never a
+# timeout), and p999 stays under the per-query deadline; the archived
+# artifacts must additionally PROVE the storm fired — failovers > 0
+# (kill -9 healed by a living peer) and shed_total > 0 are the
+# artifact contract. SRJT_LOCKDEP=1 rides along: the dispatcher's new
+# lock sites feed the merged zero-cycle gate below.
+rm -f artifacts/serve_metrics.jsonl artifacts/bench_serve.jsonl
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
+  python -m pytest tests/test_serve.py -q
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=2 SRJT_RETRY_MAX_DELAY_MS=50 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
+  SRJT_RESULTS=artifacts/bench_serve.jsonl \
+  python benchmarks/bench_serve.py --chaos --rows 5000 --queries 24 \
+  --offered-qps 2 --deadline-s 60 --max-concurrent 3 --pool-size 2
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/bench_serve.jsonl")]
+bench = [r for r in rows if r.get("metric") == "serve_mixed_qps"]
+assert bench, "no serve BENCH row emitted"
+b = bench[-1]
+assert b["wrong_answers"] == 0 and b["bit_identical"], b
+assert b["failovers"] > 0, "crash storm produced no pool failover"
+assert b["shed_total_counter"] > 0, "no shed recorded (serve.shed_total == 0)"
+assert b["completed"] > 0 and b["value"] > 0, "no sustained throughput"
+assert b["p999_ms"] <= b["deadline_s"] * 1000, "p999 exceeds the deadline"
+lines = [json.loads(s) for s in open("artifacts/serve_metrics.jsonl")]
+kinds = {r["event"] for r in lines}
+assert "serve.shed" in kinds, "no shed event archived"
+assert "serve.submit" in kinds and "serve.done" in kinds
+failovers = sum(1 for r in lines
+                if r["event"] == "sidecar.pool.worker_death"
+                and r.get("live", 0) > 0)
+assert failovers > 0, "no failover-with-living-peers in the event log"
+print(f"serve tier: {b['completed']} queries at {b['value']} qps "
+      f"(p50 {b['p50_ms']} / p99 {b['p99_ms']} / p999 {b['p999_ms']} ms), "
+      f"{b['shed_total_counter']} sheds, {b['failovers']} failovers "
+      "-> artifacts/serve_metrics.jsonl")
+EOF
+
 # lockdep gate (ISSUE 7, layer 2): merge every per-process report the
-# armed tiers above dropped (fast tier + all four chaos tiers, incl.
-# spawned sidecar/exchange workers — the env rides into children) and
+# armed tiers above dropped (fast tier + the chaos tiers + the serve
+# tier, incl. spawned sidecar/exchange workers — the env rides into
+# children) and
 # fail on any lock-order cycle or self-deadlock. The merged graph is
 # archived as artifacts/lockdep_report.json; blocking-while-locked
 # events are reported but advisory (the deadline tier owns that risk).

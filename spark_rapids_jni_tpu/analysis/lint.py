@@ -82,15 +82,16 @@ _KNOB_RE = re.compile(r"SRJT_[A-Z0-9_]*[A-Z0-9]")
 # and `raise_corruption` are the two canonical wrap helpers
 _TAXONOMY = {
     "DeviceError", "FatalDeviceError", "RetryableError", "DataCorruption",
-    "DeadlineExceeded", "MemoryBudgetExceeded", "classify",
+    "DeadlineExceeded", "MemoryBudgetExceeded", "Overloaded", "classify",
     "raise_corruption",
 }
 
 # rule scopes, as path fragments relative to the package root
-_RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "sidecar.py",
+_RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "serve/", "sidecar.py",
                    "sidecar_pool.py")
 _BLOCKING_GOVERNED = ("sidecar.py", "sidecar_pool.py", "parallel/",
-                      "memgov/", "utils/retry.py", "utils/faultinj.py")
+                      "memgov/", "serve/", "utils/retry.py",
+                      "utils/faultinj.py")
 _STUB_MODULES = ("utils/metrics.py", "utils/tracing.py",
                  "utils/integrity.py", "utils/faultinj.py",
                  "memgov/__init__.py")

@@ -784,10 +784,15 @@ def stats_report(pretty: bool = False):
     verdicts (frames/spills/exchanges checked, ``crc_mismatch`` — the
     count that separates "corruption caught" from "wrong answer").
 
+    ``serve`` is the concurrent serving runtime (serve/, ISSUE 8:
+    submissions/completions, shed counts per cause, expired-in-queue,
+    and every live scheduler's tenant/queue snapshot — None until a
+    scheduler has ever been created).
+
     Returns a JSON-serializable dict; ``pretty=True`` returns the
     aligned text rendering (utils/metrics.render_report) instead —
     the one-command artifact VERDICT items 5/7/8 ask for."""
-    from . import memgov, sidecar, sidecar_pool
+    from . import memgov, serve, sidecar, sidecar_pool
     from .utils import deadline as deadline_mod
     from .utils import integrity, memory, metrics, retry
 
@@ -799,6 +804,7 @@ def stats_report(pretty: bool = False):
         "memgov": memgov.stats_section(),
         "breaker": sidecar.breaker().snapshot(),
         "pool": sidecar_pool.stats_section(),
+        "serve": serve.stats_section(),
         "integrity": integrity.stats_section(),
         "deadline": {
             "default_budget_s": deadline_mod.default_budget(),

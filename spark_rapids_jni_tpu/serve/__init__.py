@@ -1,0 +1,54 @@
+"""Concurrent multi-query serving runtime (ISSUE 8).
+
+PRs 1-7 built the reliability substrate — retry/split, deadlines +
+breaker, memgov admission, the crash-tolerant sidecar pool, the
+integrity-checked data plane — but execution stayed one synchronous
+query per process, so none of it was ever exercised *under
+contention*. This package is the layer that arbitrates QUERIES the way
+memgov arbitrates bytes (Theseus arbitrates work over its
+data-movement-bounded executors the same way; PAPERS.md):
+
+- **Scheduler** (`scheduler.py`): ``submit(fn_or_pipeline, tenant=,
+  deadline_s=, priority=, memory_bytes=) -> QueryHandle`` executing
+  concurrently across ``SRJT_SERVE_MAX_CONCURRENT`` dispatch slots
+  that run straight into the existing op_boundary -> memgov admission
+  -> sidecar-pool path, with each query's deadline/cancel token
+  installed context-locally (the PR 3 machinery propagates it down
+  every blocking layer).
+- **Per-tenant QoS**: bounded per-tenant FIFO queues feeding a
+  stride-scheduled (weighted-fair) dispatcher — one tenant's storm
+  cannot starve another's trickle, and nothing buffers unboundedly.
+- **Graceful degradation**: queue-full / dead-on-arrival / pressure /
+  dark-pool submissions fast-fail AT ADMISSION with the retryable
+  ``Overloaded`` taxonomy member carrying a ``retry_after_s`` hint —
+  shedding is lowest-priority-first and never mid-flight.
+
+``benchmarks/bench_serve.py`` is the proof harness: sustained QPS +
+p50/p99/p999 for a mixed TPC q1/q6/q98 workload at fixed offered load,
+plus a chaos tier (crash + hang + reject storm while serving) that
+``ci/premerge.sh`` gates on zero wrong answers.
+"""
+
+from .scheduler import (
+    QueryHandle,
+    Scheduler,
+    SHED_CAUSES,
+    leak_report,
+    live_scheduler_count,
+    scheduler,
+    shutdown_scheduler,
+    stats_section,
+    submit,
+)
+
+__all__ = [
+    "QueryHandle",
+    "Scheduler",
+    "SHED_CAUSES",
+    "leak_report",
+    "live_scheduler_count",
+    "scheduler",
+    "shutdown_scheduler",
+    "stats_section",
+    "submit",
+]

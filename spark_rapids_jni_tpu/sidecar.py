@@ -1086,6 +1086,17 @@ class SupervisedClient:
             # (checked before the RetryableError prefix: corruption is
             # its own class so chaos assertions can tell them apart)
             raise DataCorruption(f"sidecar worker: {msg}")
+        if msg.startswith("Overloaded:"):
+            # the WORKER's serving layer shed at admission (ISSUE 8):
+            # the scheduler there is saturated, not broken — same
+            # retryable Overloaded class on this side (checked before
+            # the generic RetryableError prefix so shed accounting can
+            # tell admission pressure from transport faults; the
+            # retry_after_s field does not survive the wire — the
+            # class and cause text do)
+            from .utils.errors import Overloaded
+
+            raise Overloaded(f"sidecar worker: {msg}")
         if msg.startswith("RetryableError:"):
             raise RetryableError(f"sidecar worker: {msg}")
         if msg.startswith("FatalDeviceError:"):

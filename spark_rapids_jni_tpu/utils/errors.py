@@ -20,6 +20,7 @@ __all__ = [
     "RetryableError",
     "DataCorruption",
     "DeadlineExceeded",
+    "Overloaded",
     "classify",
 ]
 
@@ -44,6 +45,32 @@ class DataCorruption(RetryableError):
     retry/split machinery re-fetches or re-computes instead of
     returning wrong rows (Thallus's checksummed-transport posture:
     corruption must surface as an error, never as an answer)."""
+
+
+class Overloaded(RetryableError):
+    """The serving runtime (serve/) refused to ADMIT work: a tenant's
+    bounded queue is full, the overload controller is shedding under
+    queue-age/memgov pressure, the submission's deadline was dead on
+    arrival, the pool is dark and the query cannot run on the host
+    engine, or the scheduler is shutting down. RETRYABLE by design —
+    the system is healthy, just saturated, and backing off IS the
+    productive recovery — and always raised at admission, never
+    mid-flight, so a shed query costs the client nothing but the
+    submit call. ``retry_after_s`` is the scheduler's backoff hint
+    (never a promise); ``cause`` names the shed reason
+    (``queue_full`` / ``pressure`` / ``doa_deadline`` / ``breaker`` /
+    ``shutting_down`` / ``injected``). Distinct from DeadlineExceeded
+    (the QUERY ran out of time) and MemoryBudgetExceeded (one op's
+    footprint cannot fit): Overloaded is about aggregate offered load,
+    and a shed must never masquerade as a timeout."""
+
+    def __init__(self, message: str = "overloaded",
+                 retry_after_s=None, cause: str = "overload"):
+        super().__init__(message)
+        self.retry_after_s = (
+            None if retry_after_s is None else float(retry_after_s)
+        )
+        self.cause = str(cause)
 
 
 class DeadlineExceeded(DeviceError):
@@ -88,6 +115,11 @@ _RETRYABLE_MARKERS = (
     # crossing a process boundary (sidecar wire taxonomy) must stay
     # retryable — re-fetching is exactly the productive recovery
     "CRC mismatch",
+    # serving runtime (serve/): a stringified Overloaded crossing a
+    # process boundary stays retryable — the client backs off and
+    # resubmits (the retry_after_s field does not survive stringification;
+    # the sidecar wire prefix path preserves the class itself)
+    "Overloaded",
 )
 
 

@@ -36,7 +36,12 @@ sit. Feature parity:
   corruption the integrity layer (utils/integrity.py) must catch;
   inert under ``maybe_inject``, it fires only through
   ``maybe_corrupt(op, data)``, the hook the sidecar worker crosses on
-  every response),
+  every response), ``reject`` (raises the retryable ``Overloaded``
+  taxonomy member — key the rule ``"serve.admit"``, the choke point
+  the serving scheduler (serve/) crosses on every submission, and the
+  chaos tier exercises the shed path deterministically without real
+  overload; ``delayMs`` doubles as the injected ``retry_after_s`` hint
+  in milliseconds),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -73,7 +78,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from .errors import FatalDeviceError, RetryableError
+from .errors import FatalDeviceError, Overloaded, RetryableError
 
 __all__ = [
     "configure",
@@ -124,7 +129,7 @@ def _parse(cfg: dict) -> None:
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
         if kind not in ("fatal", "retryable", "exception", "delay", "hang",
-                        "spill_fail", "crash", "corrupt"):
+                        "spill_fail", "crash", "corrupt", "reject"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -256,6 +261,18 @@ def maybe_inject(op_name: str) -> None:
         raise FatalDeviceError(f"injected fatal fault in {op_name}")
     if kind == "retryable":
         raise RetryableError(f"injected retryable fault in {op_name}")
+    if kind == "reject":
+        # the serving scheduler's admission chaos (serve/ calls
+        # maybe_inject("serve.admit") on every submission): the shed
+        # path fires deterministically — the scheduler counts it under
+        # serve.shed_total like any organic shed, and the client sees
+        # the same retryable Overloaded contract as a real storm.
+        # delayMs carries the retry_after_s hint (in ms).
+        raise Overloaded(
+            f"injected admission reject in {op_name}",
+            retry_after_s=delay_ms / 1000.0,
+            cause="injected",
+        )
     if kind == "spill_fail":
         # the memory governor's demotion chaos (memgov/catalog.py calls
         # maybe_inject("memgov.spill") around every spill): same

@@ -358,6 +358,25 @@ declare("SRJT_MEMGOV_DROP_SMCACHE", "bool", False,
         "1 lets pressure drop compiled shard_map executables as a "
         "last resort")
 
+# concurrent serving runtime (serve/, ISSUE 8)
+declare("SRJT_SERVE_MAX_CONCURRENT", "int", 4,
+        "scheduler dispatch slots: queries executing concurrently "
+        "across the op_boundary -> memgov -> sidecar-pool path",
+        minimum=1)
+declare("SRJT_SERVE_QUEUE_DEPTH", "int", 64,
+        "per-tenant bounded FIFO queue depth; a full queue sheds "
+        "lowest-priority-first with retryable Overloaded", minimum=1)
+declare("SRJT_SERVE_MAX_QUEUED", "int", 0,
+        "global queued-query cap across all tenants (0 = per-tenant "
+        "bounds only); past it the overload controller sheds at "
+        "admission")
+declare("SRJT_SERVE_MAX_QUEUE_AGE_SEC", "float", 30.0,
+        "overload controller: oldest-queued-query age past which "
+        "admission sheds lowest-priority-first", positive=True)
+declare("SRJT_SERVE_RETRY_AFTER_SEC", "float", 0.25,
+        "default retry_after_s backoff hint carried by a shed's "
+        "Overloaded error", positive=True)
+
 # runtime / harness
 declare("SRJT_NATIVE_LIB", "str", None,
         "explicit libsrjt.so path (before the packaged / dev-build "

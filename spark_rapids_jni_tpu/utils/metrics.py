@@ -566,6 +566,15 @@ def stage_report(stage: str) -> dict:
             "crc_mismatch": _REGISTRY.value("sidecar.integrity.crc_mismatch"),
             "frames_checked": _REGISTRY.value("sidecar.integrity.frames_checked"),
         },
+        # ISSUE 8 serving counters: admission outcomes under load — the
+        # chaos-under-load artifacts assert sheds surfaced as Overloaded
+        # (serve.shed_total) and never as silent buffering or timeouts
+        "serve": {
+            "submitted": _REGISTRY.value("serve.submitted"),
+            "completed": _REGISTRY.value("serve.completed"),
+            "shed_total": _REGISTRY.value("serve.shed_total"),
+            "expired_in_queue": _REGISTRY.value("serve.expired_in_queue"),
+        },
     }
 
 

@@ -47,15 +47,70 @@ def _assert_no_arena_slab_leak():
         )
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _assert_no_scheduler_thread_leak():
+    """ISSUE 8 leak tripwire (mirrors the slab-leak check): every serve
+    Scheduler started during the session must have joined all its
+    dispatch-slot threads (Scheduler.shutdown) by session end — a live
+    scheduler is leaked daemon threads still able to dispatch queries
+    into torn-down fixtures. Lazy sys.modules lookup: runs only when
+    the suite actually touched the serving layer."""
+    yield
+    import sys as _sys
+    import threading as _threading
+
+    serve_mod = _sys.modules.get("spark_rapids_jni_tpu.serve")
+    if serve_mod is not None:
+        serve_mod.shutdown_scheduler(drain=False, timeout_s=10.0)
+        leaked = serve_mod.live_scheduler_count()
+        assert leaked == 0, (
+            f"{leaked} serve scheduler(s) leaked past session teardown: "
+            + "; ".join(serve_mod.leak_report())
+        )
+        stragglers = [
+            t.name for t in _threading.enumerate()
+            if t.name.startswith("srjt-serve-") and t.is_alive()
+        ]
+        assert not stragglers, (
+            f"serve dispatch threads leaked past session teardown: "
+            f"{stragglers}"
+        )
+
+
 # ---------------------------------------------------------------------------
 # premerge fast tier (VERDICT r3 item 9)
 # ---------------------------------------------------------------------------
 # The full hermetic suite takes ~25 min on this 1-core box; ci/premerge.sh
 # runs `-m "not slow"` (<~8 min) and ci/nightly.sh runs everything. The
-# set below is the measured top of the duration report (>=10 s each,
-# ~1030 s combined, round-4 run); a renamed test silently drops back
-# into the fast tier, which is the safe failure mode.
+# set below is the measured top of the duration report (>=10 s each;
+# calibrated round 4, re-calibrated round 8 when the accumulated tail
+# pushed the fast tier past the 870 s harness ceiling — ~345 s moved
+# out); a renamed test silently drops back into the fast tier, which
+# is the safe failure mode.
 _SLOW_TESTS = {
+    # round-8 re-calibration: the >=10 s tail accumulated since round 4
+    # (tpcds distributed/oracle pairs, decimal128 long multiplies, the
+    # chaos parity storm, ragged encode parity, the two-process
+    # exchange chaos acceptance — the last two still run premerge in
+    # their dedicated env-armed tiers, everything runs nightly)
+    "test_tpcds_queries.py::TestQ94::test_distributed_identical",
+    "test_tpcds_queries.py::TestQ94::test_matches_exact_oracle",
+    "test_tpcds_queries.py::TestQ7::test_distributed_bit_identical",
+    "test_tpcds_queries.py::TestQ7::test_matches_exact_oracle",
+    "test_tpcds_queries.py::TestQ19::test_distributed_bit_identical",
+    "test_tpcds_queries.py::TestQ98WindowRatio::test_matches_oracle",
+    "test_tpcds_queries.py::TestReportingShapes::"
+    "test_q52_distributed_bit_identical",
+    "test_models.py::TestQ55::test_q55_distributed_matches_single_chip",
+    "test_decimal_utils.py::test_overflow_mult",
+    "test_decimal_utils.py::test_simple_neg_multiply",
+    "test_decimal_utils.py::test_null_propagation",
+    "test_chaos.py::test_chaos_parity_retryable_storm",
+    "test_ragged_bytes.py::test_pallas_kernels_interpret_parity",
+    "test_ragged_bytes.py::test_padded_vs_scatter_encode_parity",
+    "test_data_plane.py::TestTcpExchangeTwoProcess::"
+    "test_two_process_groupby_bit_identical_under_chaos",
+    "test_table_ops.py::test_distributed_groupby_table_int_keys",
     # the hang-storm acceptance burns ~6 budget expiries of wall-clock
     # by design; ci/premerge.sh runs it env-armed in the dedicated
     # deadline tier (no slow filter there), nightly runs it too
@@ -109,6 +164,14 @@ _SLOW_TESTS = {
     # the real-subprocess pool tier spawns 2-3 jax workers each;
     # ci/premerge.sh runs the whole file env-armed in the dedicated
     # crash-storm tier (no slow filter there), nightly runs them too
+    # the chaos-under-load serving acceptance runs 40 concurrent TPC
+    # queries under a retryable+reject storm (and the pipeline
+    # submission test pays a q6 compile); ci/premerge.sh runs the
+    # whole file env-armed in the dedicated serve tier (no slow filter
+    # there), nightly runs it too
+    "test_serve.py::TestChaosUnderLoad::"
+    "test_storm_while_serving_yields_bit_identical_results",
+    "test_serve.py::TestSubmit::test_compiled_pipeline_is_submittable",
     "test_sidecar_pool.py::TestRealWorkerPool::"
     "test_q1_bit_identical_through_kill9_failover",
     "test_sidecar_pool.py::TestRealWorkerPool::"
@@ -123,7 +186,11 @@ _SLOW_TESTS = {
 
 # parametrized ids with regex metacharacters escape unpredictably in
 # nodeids — match those families by prefix instead of exact id
-_SLOW_PREFIXES = ("test_regex.py::test_replace_re[",)
+_SLOW_PREFIXES = (
+    "test_regex.py::test_replace_re[",
+    # round-8: the java-semantics split family runs 9-16 s per pattern
+    "test_regex.py::test_split_re_vs_java_semantics[",
+)
 
 
 def pytest_collection_modifyitems(config, items):
