@@ -21,6 +21,15 @@ bench_pool.py discipline; ``SRJT_RESULTS`` appends them to a file):
   p999 (<= the per-query deadline), ``serve.shed_total > 0``, and
   ``sidecar.pool.failovers > 0`` (the storm really fired). Exit 1 on
   any violation — this is the premerge serve tier's gate.
+- **gray** (``--gray``, ISSUE 9): the same workload while
+  ``ci/chaos_gray.json`` ramps ONE worker of the real pool into
+  persistent slowness (the per-worker ``@w1`` fault keys — a gray
+  failure, not a crash). Asserts the tail-tolerance contract: zero
+  wrong answers (every completed query bit-identical), p999 <= the
+  deadline, the slow worker QUARANTINED (quarantines >= 1) and later
+  REINSTATED after the ramp ends, hedged dispatch WON at least one
+  race, and the hedge volume stayed within its configured budget.
+  Exit 1 on any violation — the premerge gray tier's gate.
 
 Usage::
 
@@ -56,6 +65,10 @@ from spark_rapids_jni_tpu.utils.errors import (
 _CHAOS_PROFILE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "ci", "chaos_serve.json",
+)
+_GRAY_PROFILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ci", "chaos_gray.json",
 )
 
 
@@ -96,7 +109,7 @@ class _Workload:
     counting as completed."""
 
     def __init__(self, rows: int, seed: int, pool=None, pool_payload=None,
-                 pool_want=None):
+                 pool_want=None, pool_ops: int = 1):
         self.lineitem = tpch.gen_lineitem(rows, seed=seed)
         self.store = tpcds.gen_store(max(rows // 2, 1000), seed=seed)
         t0 = time.perf_counter()
@@ -107,23 +120,26 @@ class _Workload:
         self.pool = pool
         self.pool_payload = pool_payload
         self.pool_want = pool_want
+        self.pool_ops = int(pool_ops)
         self.wrong: list = []
         self.end_times: dict = {}
 
     def _pool_leg(self):
-        """The device-path leg under crash chaos: one arena op through
-        the REAL worker pool, answer checked against the host oracle —
-        a kill -9 mid-request must surface as a healed failover, never
-        a wrong answer."""
+        """The device-path leg under chaos: ``pool_ops`` arena ops
+        through the REAL worker pool, each answer checked against the
+        host oracle — a kill -9 mid-request must surface as a healed
+        failover and a gray worker's straggler as a quarantine or a
+        lost hedge race, never a wrong answer."""
         if self.pool is None:
             return
         from spark_rapids_jni_tpu import sidecar
 
-        got = self.pool.call_arena(
-            sidecar.OP_GROUPBY_SUM_F32, self.pool_payload
-        )
-        if got != self.pool_want:
-            self.wrong.append("pool groupby diverged from host oracle")
+        for _ in range(self.pool_ops):
+            got = self.pool.call_arena(
+                sidecar.OP_GROUPBY_SUM_F32, self.pool_payload
+            )
+            if got != self.pool_want:
+                self.wrong.append("pool groupby diverged from host oracle")
 
     def make(self, kind: str, qid: int):
         def run():
@@ -146,8 +162,10 @@ class _Workload:
 def run_bench(args) -> int:
     pool = None
     pool_payload = pool_want = None
-    if args.chaos:
-        faultinj.configure_from_file(args.profile)
+    storm = args.chaos or args.gray
+    profile = args.profile or (_GRAY_PROFILE if args.gray else _CHAOS_PROFILE)
+    if storm:
+        faultinj.configure_from_file(profile)
         if not retry.is_enabled():
             # the chaos tier is meaningless without the recovery loop
             retry.configure(max_attempts=10, base_delay_ms=2,
@@ -163,11 +181,12 @@ def run_bench(args) -> int:
             pool = sidecar_pool.SidecarPool(
                 size=args.pool_size, deadline_s=60, heartbeat_s=1e9,
                 startup_timeout_s=args.startup_timeout,
-                env={"SRJT_FAULTINJ_CONFIG": args.profile},
+                env={"SRJT_FAULTINJ_CONFIG": profile},
             )
             pool.call_arena(sidecar.OP_GROUPBY_SUM_F32, pool_payload)
 
-    wl = _Workload(args.rows, args.seed, pool, pool_payload, pool_want)
+    wl = _Workload(args.rows, args.seed, pool, pool_payload, pool_want,
+                   pool_ops=args.pool_ops)
     print(f"# oracles computed sequentially in {wl.oracle_secs:.1f}s "
           f"(compile-warm)", flush=True)
 
@@ -221,6 +240,17 @@ def run_bench(args) -> int:
                 )
                 bad_shed.append(f"{i}: {type(e).__name__}: {e}")
         t_last = max(wl.end_times.values()) if wl.end_times else t0
+        if args.gray and pool is not None:
+            # the gray contract includes the RECOVERY: the ramp's fault
+            # budget has exhausted by now, so the background probes must
+            # reinstate the quarantined worker — wait (bounded) for the
+            # probe loop to finish its clean run
+            wait_end = time.perf_counter() + args.gray_wait
+            while time.perf_counter() < wait_end and (
+                _counter("sidecar.pool.quarantines") == 0
+                or _counter("sidecar.pool.reinstatements") == 0
+            ):
+                time.sleep(0.2)
     finally:
         sched.shutdown(drain=False, timeout_s=60)
         if pool is not None:
@@ -238,8 +268,16 @@ def run_bench(args) -> int:
     qps = len(completed) / span
     shed_total = _counter("serve.shed_total")
     failovers = _counter("sidecar.pool.failovers")
+    quarantines = _counter("sidecar.pool.quarantines")
+    reinstatements = _counter("sidecar.pool.reinstatements")
+    hedges_launched = _counter("sidecar.pool.hedges_launched")
+    hedges_won = _counter("sidecar.pool.hedges_won")
+    pool_calls = _counter("sidecar.pool.calls")
+    from spark_rapids_jni_tpu.utils import knobs as knobs_mod
+
+    hedge_budget_pct = knobs_mod.get_float("SRJT_HEDGE_BUDGET_PCT")
     row = {
-        "metric": "serve_mixed_qps",
+        "metric": "serve_gray_qps" if args.gray else "serve_mixed_qps",
         "value": round(qps, 2),
         "unit": "qps",
         "offered_qps": args.offered_qps,
@@ -257,10 +295,20 @@ def run_bench(args) -> int:
         "tenants": args.tenants,
         "rows": args.rows,
         "chaos": bool(args.chaos),
-        "pool_size": args.pool_size if args.chaos else 0,
+        "gray": bool(args.gray),
+        "pool_size": args.pool_size if storm else 0,
         "failovers": failovers,
         "shed_total_counter": shed_total,
         "expired_in_queue": _counter("serve.expired_in_queue"),
+        "quarantines": quarantines,
+        "reinstatements": reinstatements,
+        "hedges_launched": hedges_launched,
+        "hedges_won": hedges_won,
+        "hedges_cancelled": _counter("sidecar.pool.hedges_cancelled"),
+        "hedges_suppressed": _counter("sidecar.pool.hedges_suppressed"),
+        "pool_calls": pool_calls,
+        "hedge_budget_pct": hedge_budget_pct,
+        "adaptive_timeout_clamps": _counter("sidecar.adaptive_timeout_clamps"),
         "bit_identical": not wl.wrong,
     }
     _emit(row)
@@ -276,20 +324,43 @@ def run_bench(args) -> int:
         print(f"non-Overloaded admission failures: {bad_shed[:5]}",
               file=sys.stderr)
         rc = 1
+    if storm:
+        # invariants shared by both storm tiers: bounded tails, and a
+        # workload that actually ran
+        tier = "gray" if args.gray else "chaos"
+        if lat_ms and p999 > args.deadline_s * 1e3:
+            print(f"p999 {p999:.0f} ms exceeds the {args.deadline_s}s "
+                  f"deadline under the {tier} storm: enforcement broke",
+                  file=sys.stderr)
+            rc = 1
+        if not completed:
+            print(f"{tier} tier completed zero queries", file=sys.stderr)
+            rc = 1
     if args.chaos:
         if shed_total <= 0:
             print("chaos tier shed nothing (serve.shed_total == 0)",
                   file=sys.stderr)
             rc = 1
-        if lat_ms and p999 > args.deadline_s * 1e3:
-            print(f"p999 {p999:.0f} ms exceeds the {args.deadline_s}s "
-                  "deadline: enforcement broke", file=sys.stderr)
-            rc = 1
         if args.pool_size > 0 and failovers <= 0:
             print("crash storm produced no pool failover", file=sys.stderr)
             rc = 1
-        if not completed:
-            print("chaos tier completed zero queries", file=sys.stderr)
+    if args.gray:
+        if quarantines <= 0:
+            print("gray storm quarantined nothing "
+                  "(sidecar.pool.quarantines == 0)", file=sys.stderr)
+            rc = 1
+        if reinstatements <= 0:
+            print("quarantined worker never reinstated after the ramp "
+                  "(sidecar.pool.reinstatements == 0)", file=sys.stderr)
+            rc = 1
+        if hedges_won <= 0:
+            print("hedged dispatch won no race "
+                  "(sidecar.pool.hedges_won == 0)", file=sys.stderr)
+            rc = 1
+        # the hedge budget is a hard ceiling on extra dispatch volume
+        if hedges_launched * 100.0 > hedge_budget_pct * max(pool_calls, 1):
+            print(f"hedge volume {hedges_launched} of {pool_calls} calls "
+                  f"exceeds the {hedge_budget_pct}% budget", file=sys.stderr)
             rc = 1
     return rc
 
@@ -310,11 +381,23 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true",
                     help="arm ci/chaos_serve.json while serving and "
                     "gate on the chaos invariants")
-    ap.add_argument("--profile", default=_CHAOS_PROFILE,
-                    help="chaos profile path (default ci/chaos_serve.json)")
+    ap.add_argument("--gray", action="store_true",
+                    help="arm ci/chaos_gray.json (one ramped-slow "
+                    "worker) and gate on the tail-tolerance "
+                    "invariants: quarantine + reinstate + hedges won")
+    ap.add_argument("--gray-wait", type=float, default=45.0,
+                    help="max seconds to wait post-workload for the "
+                    "quarantined worker's reinstatement")
+    ap.add_argument("--profile", default=None,
+                    help="chaos profile path (default ci/chaos_serve."
+                    "json, or ci/chaos_gray.json with --gray)")
     ap.add_argument("--pool-size", type=int, default=2,
                     help="REAL sidecar workers for the chaos crash leg "
                     "(0 = no pool)")
+    ap.add_argument("--pool-ops", type=int, default=1,
+                    help="arena ops per query through the pool (the "
+                    "gray tier raises this so the health scorer sees "
+                    "enough samples)")
     ap.add_argument("--startup-timeout", type=float, default=180.0)
     return run_bench(ap.parse_args())
 

@@ -210,10 +210,57 @@ print(f"serve tier: {b['completed']} queries at {b['value']} qps "
       "-> artifacts/serve_metrics.jsonl")
 EOF
 
+# gray-failure tier (ISSUE 9): the serve bench against a REAL pool of
+# 3 with ONE worker ramped into persistent slowness (ci/chaos_gray.json
+# keys its delay ramp to w1's SRJT_FAULTINJ_WORKER tag — a gray
+# failure, not a crash). The tail-tolerance contract is gated from the
+# archived artifacts, not test-self-certified: every completed query
+# bit-identical to its sequential oracle, p999 <= the deadline, the
+# slow worker QUARANTINED and later REINSTATED after the ramp ends,
+# hedged dispatch WON at least one race, and the hedge volume within
+# its budget. SRJT_LOCKDEP=1 rides along: the quarantine/hedge lock
+# sites feed the merged zero-cycle gate below.
+rm -f artifacts/gray_metrics.jsonl artifacts/bench_gray.jsonl
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=2 SRJT_RETRY_MAX_DELAY_MS=50 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/gray_metrics.jsonl \
+  SRJT_RESULTS=artifacts/bench_gray.jsonl \
+  SRJT_HEDGE_BUDGET_PCT=25 SRJT_ADAPTIVE_TIMEOUT_FLOOR_S=2 \
+  SRJT_QUARANTINE_PROBE_INTERVAL_S=0.2 \
+  python benchmarks/bench_serve.py --gray --rows 4000 --queries 36 \
+  --offered-qps 2 --deadline-s 90 --max-concurrent 3 --pool-size 3 \
+  --pool-ops 3
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/bench_gray.jsonl")]
+bench = [r for r in rows if r.get("metric") == "serve_gray_qps"]
+assert bench, "no gray BENCH row emitted"
+b = bench[-1]
+assert b["wrong_answers"] == 0 and b["bit_identical"], b
+assert b["quarantines"] > 0, "slow worker never quarantined"
+assert b["reinstatements"] > 0, "quarantined worker never reinstated"
+assert b["hedges_won"] > 0, "hedged dispatch won no race"
+assert b["completed"] > 0 and b["value"] > 0, "no sustained throughput"
+assert b["p999_ms"] <= b["deadline_s"] * 1000, "p999 exceeds the deadline"
+assert b["hedges_launched"] * 100.0 <= (
+    b["hedge_budget_pct"] * max(b["pool_calls"], 1)
+), "hedge volume exceeded its budget"
+lines = [json.loads(s) for s in open("artifacts/gray_metrics.jsonl")]
+kinds = {r["event"] for r in lines}
+assert "sidecar.pool.quarantine" in kinds, "no quarantine event archived"
+assert "sidecar.pool.reinstate" in kinds, "no reinstate event archived"
+assert "sidecar.pool.hedge_won" in kinds, "no hedge_won event archived"
+print(f"gray tier: {b['completed']} queries at {b['value']} qps "
+      f"(p50 {b['p50_ms']} / p99 {b['p99_ms']} / p999 {b['p999_ms']} ms), "
+      f"{b['quarantines']} quarantines, {b['reinstatements']} reinstated, "
+      f"{b['hedges_won']}/{b['hedges_launched']} hedges won/launched "
+      "-> artifacts/gray_metrics.jsonl")
+EOF
+
 # lockdep gate (ISSUE 7, layer 2): merge every per-process report the
 # armed tiers above dropped (fast tier + the chaos tiers + the serve
-# tier, incl. spawned sidecar/exchange workers — the env rides into
-# children) and
+# and gray tiers, incl. spawned sidecar/exchange workers — the env
+# rides into children) and
 # fail on any lock-order cycle or self-deadlock. The merged graph is
 # archived as artifacts/lockdep_report.json; blocking-while-locked
 # events are reported but advisory (the deadline tier owns that risk).

@@ -622,11 +622,23 @@ class TcpExchange:
         from ..utils.errors import RetryableError
 
         d = deadline_mod.current()
-        budget_s = self.deadline_s
+        # adaptive fetch deadline (ISSUE 9): observed q99 × multiplier
+        # once warm, clamped into [floor, SRJT_EXCHANGE_TIMEOUT_SEC] —
+        # a hung peer is detected at straggler timescales, not the
+        # static knob's; the query budget still clamps below
+        budget_s, clamped = metrics.adaptive_timeout_s(
+            "shuffle.tcp.fetch_lat_us", self.deadline_s
+        )
+        if clamped:
+            metrics.registry().counter(
+                "shuffle.tcp.adaptive_timeout_clamps"
+            ).inc()
         if d is not None:
             d.check("tcp_exchange_fetch")
             budget_s = min(budget_s, max(d.remaining(), 1e-3))
         deadline = time.monotonic() + budget_s
+        t0 = time.monotonic()
+        lat_hist = metrics.registry().histogram("shuffle.tcp.fetch_lat_us")
         host, port = _parse_addr(addr)
         s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
         try:
@@ -639,6 +651,9 @@ class TcpExchange:
                 )
                 blob = _recv_exact_tcp(s, blen, deadline) if blen else b""
             except socket_mod.timeout as e:
+                # record the timed-out elapsed as a latency sample so
+                # an over-tight adaptive clamp self-corrects upward
+                lat_hist.record((time.monotonic() - t0) * 1e6)
                 if d is not None and d.done():
                     raise d.exceeded("tcp exchange fetch") from e
                 raise RetryableError(
@@ -671,6 +686,7 @@ class TcpExchange:
                 f"{status} (protocol mismatch — wrong service or "
                 "version-skewed peer?)"
             )
+        lat_hist.record((time.monotonic() - t0) * 1e6)
         metrics.counter("shuffle.tcp.bytes_in").inc(len(blob))
         # decode verifies the frame header + every column CRC: a
         # tampered exchange is retryable DataCorruption, never rows

@@ -784,6 +784,13 @@ def stats_report(pretty: bool = False):
     verdicts (frames/spills/exchanges checked, ``crc_mismatch`` — the
     count that separates "corruption caught" from "wrong answer").
 
+    ``health`` and ``hedge`` are the tail-tolerance layer (ISSUE 9):
+    gray-failure quarantine verdicts (quarantines, probe counts,
+    reinstatements, per-worker latency EWMAs when a pool is live) and
+    hedged-dispatch accounting (launched/won/cancelled/suppressed plus
+    adaptive-timeout clamp counts from both the sidecar client and the
+    TCP exchange).
+
     ``serve`` is the concurrent serving runtime (serve/, ISSUE 8:
     submissions/completions, shed counts per cause, expired-in-queue,
     and every live scheduler's tenant/queue snapshot — None until a
@@ -804,6 +811,8 @@ def stats_report(pretty: bool = False):
         "memgov": memgov.stats_section(),
         "breaker": sidecar.breaker().snapshot(),
         "pool": sidecar_pool.stats_section(),
+        "health": sidecar_pool.health_section(),
+        "hedge": sidecar_pool.hedge_section(),
         "serve": serve.stats_section(),
         "integrity": integrity.stats_section(),
         "deadline": {

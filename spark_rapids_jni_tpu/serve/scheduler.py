@@ -33,6 +33,10 @@ mid-flight kill, never a timeout in disguise):
 - **breaker**: the sidecar pool is dark (circuit breaker OPEN) and the
   query declared ``host_eligible=False`` — host-engine-eligible work
   keeps flowing when the pool is down.
+- **quarantine** (ISSUE 9): every LIVE pool worker is quarantined by
+  the gray-failure detector (sidecar_pool.py) and the query declared
+  ``host_eligible=False`` — device-only work is shed instead of
+  queueing onto known stragglers; host-eligible work keeps flowing.
 - **shutting_down**: ``shutdown()`` was called.
 - **injected**: the fault injector's ``reject`` kind fired at the
   ``serve.admit`` choke point (deterministic shed-path chaos).
@@ -89,7 +93,7 @@ S_EXPIRED = "expired"
 _FINAL = (S_DONE, S_FAILED, S_CANCELLED, S_SHED, S_EXPIRED)
 
 SHED_CAUSES = ("queue_full", "pressure", "doa_deadline", "breaker",
-               "shutting_down", "injected")
+               "quarantine", "shutting_down", "injected")
 
 # stride scheduling: pass advance per dispatch for weight 1.0
 _STRIDE1 = float(1 << 20)
@@ -292,6 +296,11 @@ class Scheduler:
         reg = self._reg()
         reg.counter("serve.shed_total").inc()
         reg.counter(f"serve.shed.{cause}").inc()
+        # shed-pressure stamp (ISSUE 9): the sidecar pool's hedged
+        # dispatch auto-disarms within SRJT_HEDGE_SHED_WINDOW_S of this
+        # monotonic timestamp — an overloaded pool must not carry
+        # duplicate load on top of the traffic it is already shedding
+        reg.gauge("serve.last_shed_s").set(time.monotonic())
 
     @staticmethod
     def _shed_event(tenant: str, cause: str) -> None:
@@ -375,10 +384,14 @@ class Scheduler:
             self._count_shed("injected")
             self._shed_event(tenant, "injected")
             raise
-        # breaker-aware routing: a dark pool sheds only the work that
-        # CANNOT run on the host engine; everything else keeps flowing
+        # breaker- AND quarantine-aware routing (ISSUE 9): a dark pool
+        # sheds only the work that CANNOT run on the host engine, and a
+        # pool whose every live worker is QUARANTINED (gray, not dead —
+        # the breaker never sees it) sheds the same way: queueing
+        # device-only work onto known stragglers just converts sheds
+        # into deadline expiries
         if not host_eligible:
-            from .. import sidecar
+            from .. import sidecar, sidecar_pool
 
             if sidecar.breaker().state() != "closed":
                 self._count_shed("breaker")
@@ -386,6 +399,16 @@ class Scheduler:
                 raise self._overloaded(
                     "sidecar pool dark (breaker open) and query is not "
                     "host-engine-eligible", "breaker",
+                )
+            pool = sidecar_pool.current_pool()
+            if (pool is not None and pool.live_count() > 0
+                    and pool.routable_count() == 0):
+                self._count_shed("quarantine")
+                self._shed_event(tenant, "quarantine")
+                raise self._overloaded(
+                    "every live pool worker is quarantined (gray "
+                    "failure) and query is not host-engine-eligible",
+                    "quarantine",
                 )
         # dead-on-arrival deadline: fast-fail beats queueing work that
         # must expire (the effective budget inherits + clamps to an

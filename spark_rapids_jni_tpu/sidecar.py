@@ -867,6 +867,27 @@ class SupervisedClient:
 
     # -- framed request/response under a deadline ----------------------------
 
+    def _op_budget_s(self, op: int) -> float:
+        """ADAPTIVE per-op socket deadline (ISSUE 9): once an op class
+        has enough observed samples (``sidecar.op_lat_us.<OP>``,
+        recorded registry-direct by ``request()``), the deadline is its
+        q99 × ``SRJT_ADAPTIVE_TIMEOUT_MULT``, clamped into
+        [``SRJT_ADAPTIVE_TIMEOUT_FLOOR_S``, the static
+        ``SRJT_SIDECAR_TIMEOUT_SEC``] — a hung worker is detected in
+        seconds instead of the static knob's minutes, while cold-start
+        ops (first compile, first dial) keep the conservative static
+        deadline. The caller still clamps to the remaining query
+        budget, so an adaptive deadline can never outlive the query.
+        Clamps are counted (``sidecar.adaptive_timeout_clamps``)."""
+        from .utils import metrics
+
+        budget, clamped = metrics.adaptive_timeout_s(
+            f"sidecar.op_lat_us.{op_name(op)}", self.deadline_s
+        )
+        if clamped:
+            metrics.registry().counter("sidecar.adaptive_timeout_clamps").inc()
+        return budget
+
     def _recv_deadline(self, n: int, deadline: float) -> bytes:
         """Read exactly n bytes under a WHOLE-REQUEST deadline: the
         socket timeout shrinks to the remaining budget each iteration,
@@ -909,7 +930,7 @@ class SupervisedClient:
         from .utils.errors import DataCorruption, RetryableError
 
         d = deadline_mod.current()
-        budget_s = self.deadline_s
+        budget_s = self._op_budget_s(op)
         if d is not None:
             d.check(f"sidecar_op_{op}")
             budget_s = min(budget_s, max(d.remaining(), 1e-3))
@@ -1060,12 +1081,33 @@ class SupervisedClient:
                 # then the request proceeds (or fails retryably)
                 self.connect()
         armed = metrics.is_enabled()
-        t0 = time.perf_counter() if armed else 0.0
+        # the clock is read unconditionally (one perf_counter pair per
+        # socket round-trip): the per-op latency histogram below is
+        # PRODUCT state — adaptive deadlines (ISSUE 9) derive from it —
+        # not gated instrumentation
+        t0 = time.perf_counter()
         try:
             status, resp = self._raw_request(op, payload, arena_len, region)
-        except Exception:
+        except Exception as e:
             metrics.counter("sidecar.request_failures").inc()
+            if isinstance(e, RetryableError) and "DEADLINE_EXCEEDED" in str(e):
+                # a timed-out request is the strongest latency sample
+                # there is: recording the elapsed budget keeps the
+                # adaptive quantile self-correcting (an over-tight
+                # clamp pushes q99 back up instead of repeating)
+                metrics.registry().histogram(
+                    f"sidecar.op_lat_us.{op_name(op)}"
+                ).record((time.perf_counter() - t0) * 1e6)
             raise
+        if status == STATUS_OK:
+            # only SUCCESSFUL exchanges feed the adaptive/quarantine
+            # baselines (timeouts feed them above, as the strong slow
+            # signal): a storm of fast worker-side ERROR replies —
+            # Overloaded sheds, corruption rejects — must not collapse
+            # the op-class p50 and turn healthy latencies into strikes
+            metrics.registry().histogram(
+                f"sidecar.op_lat_us.{op_name(op)}"
+            ).record((time.perf_counter() - t0) * 1e6)
         if armed:
             metrics.counter("sidecar.requests").inc()
             metrics.histogram("sidecar.request_us").record(

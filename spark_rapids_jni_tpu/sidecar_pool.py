@@ -43,6 +43,37 @@ breaker (a failure is recorded only with ZERO live workers), host-
 engine floor, and CRC trailers on every frame — region payloads
 included.
 
+**Tail tolerance (ISSUE 9).** PR 5 handled workers that DIE; a worker
+that is merely SLOW — the gray failure that dominates tail latency —
+kept its pool slot and poisoned every request round-robined onto it
+until the static socket deadline expired. Three defenses now ride the
+routing layer:
+
+- **Gray-failure quarantine**: every routed exchange feeds a health
+  scorer (per-worker per-op-class latency EWMA + jitter, against the
+  pool-wide op-class p50 read off the always-on
+  ``sidecar.op_lat_us.<OP>`` histograms). A worker collecting
+  ``SRJT_QUARANTINE_STRIKES`` net slow samples (each >
+  ``SRJT_QUARANTINE_SLOW_FACTOR`` × p50, or a request timeout) is
+  QUARANTINED: out of ``_pick`` routing (unless every peer is also
+  unroutable — degraded routing beats a dark pool), background-probed
+  like respawn, and REINSTATED after ``SRJT_QUARANTINE_PROBES``
+  consecutive clean probes. Distinct from death→failover (the worker
+  is alive) and from the pool breaker (which only trips when the pool
+  is dark). States: live → quarantined → reinstated | dead.
+- **Hedged dispatch**: a request outliving the op-class p95 launches
+  ONE duplicate on a different healthy worker; the first valid
+  response wins, the loser is discarded (its region — hedges lease
+  DISTINCT slab regions — releases in its own leg; the generation
+  discipline already guarantees a stale worker can never bless bytes
+  into the winner's region). Hedging carries a global budget
+  (≤ ``SRJT_HEDGE_BUDGET_PCT``% of pool calls) and auto-disarms under
+  memgov pressure or within ``SRJT_HEDGE_SHED_WINDOW_S`` of a
+  serve-layer shed, so it never melts an overloaded pool.
+- **Adaptive timeouts** live in ``SupervisedClient`` (sidecar.py):
+  per-op socket deadlines derived from observed q99, so a hung worker
+  surfaces in seconds and the failover/hedge machinery here engages.
+
 Observability (registry-direct, durable-counter contract):
 ``sidecar.pool.size`` / ``sidecar.pool.live`` /
 ``sidecar.pool.slab_bytes`` / ``sidecar.pool.slab_regions`` gauges,
@@ -64,6 +95,11 @@ Environment:
     SRJT_ARENA_SLAB_BYTES       slab size (rounded up to a power of
                                 two; default 64 MiB — virtual until
                                 touched, memfd-backed)
+    SRJT_QUARANTINE_*           gray-failure detector: slow factor,
+                                strike count, min samples, probe
+                                count/interval/slow threshold
+    SRJT_HEDGE_*                hedged dispatch: budget percent, min
+                                samples, trigger floor, shed window
 """
 
 from __future__ import annotations
@@ -97,6 +133,8 @@ __all__ = [
     "current_pool",
     "shutdown_pool",
     "stats_section",
+    "health_section",
+    "hedge_section",
     "open_slab_count",
     "arena_leak_report",
 ]
@@ -415,11 +453,18 @@ class _Worker:
     connection (concurrent callers of ``SidecarPool.call`` may route to
     the same slot); ``arena_conn`` remembers WHICH socket carried the
     last SET_ARENA — worker-side arena state is per-connection, so any
-    reconnect invalidates it and the pool must replay."""
+    reconnect invalidates it and the pool must replay.
+
+    Tail-tolerance state (ISSUE 9): ``quarantined`` takes the slot out
+    of preferred routing (the worker stays ALIVE — gray, not dead);
+    ``strikes`` is the detector's net slow-sample count and
+    ``clean_probes`` the reinstatement run; ``probe_thread`` is the
+    background prober shutdown joins, like ``respawn_thread``."""
 
     __slots__ = (
         "wid", "proc", "sock_path", "client", "alive", "spawns",
         "io_lock", "arena_conn", "respawn_thread",
+        "quarantined", "strikes", "clean_probes", "probe_thread",
     )
 
     def __init__(self, wid: int):
@@ -432,6 +477,10 @@ class _Worker:
         self.io_lock = threading.Lock()
         self.arena_conn = None
         self.respawn_thread: Optional[threading.Thread] = None
+        self.quarantined = False
+        self.strikes = 0
+        self.clean_probes = 0
+        self.probe_thread: Optional[threading.Thread] = None
 
 
 class SidecarPool:
@@ -471,8 +520,22 @@ class SidecarPool:
         self._respawn_delay_s = knobs.get_float("SRJT_POOL_RESPAWN_DELAY_S")
         self._slab_bytes = slab_bytes
         self._lock = threading.RLock()
+        # wait_healthy and the quarantine/respawn transitions meet on
+        # this condition (notify-backed, ISSUE 9 — no sleep-polling)
+        self._health = threading.Condition(self._lock)
         self._rr = 0
         self._closed = False
+        # health scorer state: per-(worker, op-class) latency EWMA +
+        # jitter, bounded (utils/metrics.KeyedEwma) — the pool-wide
+        # baseline is the always-on sidecar.op_lat_us.<OP> histograms
+        from .utils import metrics as _metrics
+
+        self._ewma = _metrics.KeyedEwma(alpha=0.3, max_keys=512)
+        # hedge-budget reservations are check-AND-increment under one
+        # lock: two dispatch slots racing the same last budget slot
+        # must not both launch (the premerge gate on hedge volume is a
+        # hard ceiling, not a soft target)
+        self._hedge_lock = threading.Lock()
         # the slab-arena data plane: ONE memfd shared by every worker
         # (they all map the same pages), surviving any of them; regions
         # are leased per request, so the only pool-wide arena state is
@@ -503,11 +566,21 @@ class SidecarPool:
                 1 if w.alive else 0
             )
 
+    def _worker_env(self, w: _Worker) -> dict:
+        """Spawn env for slot ``w``: the caller's overrides plus the
+        slot's fault-injection tag (ISSUE 9) — per-worker rule keys
+        like ``sidecar.worker.<OP>@w1`` resolve only inside the worker
+        whose tag matches, so a chaos profile can gray exactly one
+        worker of a real pool."""
+        env = dict(self._env) if self._env else {}
+        env.setdefault("SRJT_FAULTINJ_WORKER", f"w{w.wid}")
+        return env
+
     def _spawn_locked(self, w: _Worker) -> None:
         """Initial spawn of slot ``w`` (no arena exists yet; respawns
         go through ``_respawn``, which also re-hydrates state)."""
         proc, sock = self._spawn_fn(
-            startup_timeout_s=self._startup_timeout_s, env=self._env
+            startup_timeout_s=self._startup_timeout_s, env=self._worker_env(w)
         )
         w.proc, w.sock_path = proc, sock
         w.client = SupervisedClient(
@@ -529,11 +602,20 @@ class SidecarPool:
         with self._lock:
             self._closed = True
             workers = list(self._workers)
+            # wake parked quarantine probers (and wait_healthy callers)
+            # so the joins below never ride out a full probe interval
+            self._health.notify_all()
         join_s = self._startup_timeout_s + self._respawn_delay_s + 10
         for w in workers:
             t = w.respawn_thread
             if t is not None and t.is_alive():
                 t.join(timeout=join_s)
+        for w in workers:
+            # quarantine probers poll _closed every interval and their
+            # probe pings run under a short deadline scope: bounded join
+            t = w.probe_thread
+            if t is not None and t.is_alive():
+                t.join(timeout=30)
         for w in workers:
             if w.client is not None:
                 w.client.close()
@@ -579,15 +661,42 @@ class SidecarPool:
     def live_count(self) -> int:
         return sum(1 for w in self._workers if w.alive)
 
-    def _pick(self) -> Optional[_Worker]:
-        """Round-robin over live workers; None when the pool is dark."""
+    def routable_count(self) -> int:
+        """Live AND unquarantined workers — the set fresh traffic
+        prefers. The serving layer's quarantine-aware routing consults
+        this (a pool whose every live worker is gray sheds
+        non-host-eligible work instead of queueing onto stragglers)."""
+        return sum(1 for w in self._workers if w.alive and not w.quarantined)
+
+    def _pick(self, exclude: Optional[_Worker] = None,
+              allow_quarantined: bool = True) -> Optional[_Worker]:
+        """Round-robin over live workers, PREFERRING the unquarantined
+        (ISSUE 9): a gray worker only takes fresh traffic when every
+        peer is dead or equally gray — degraded routing beats a dark
+        pool, and the fallback is counted so operators can see it.
+        ``exclude`` lets hedged dispatch land the duplicate on a
+        DIFFERENT worker, and ``allow_quarantined=False`` disables the
+        gray fallback entirely (a hedge duplicated onto the known
+        straggler would be pure waste); None when no eligible worker
+        exists."""
         with self._lock:
             n = len(self._workers)
+            fallback = None
             for i in range(n):
                 w = self._workers[(self._rr + i) % n]
-                if w.alive:
-                    self._rr = (self._rr + i + 1) % n
-                    return w
+                if not w.alive or w is exclude:
+                    continue
+                if w.quarantined:
+                    if allow_quarantined and fallback is None:
+                        fallback = (w, i)
+                    continue
+                self._rr = (self._rr + i + 1) % n
+                return w
+            if fallback is not None:
+                w, i = fallback
+                self._rr = (self._rr + i + 1) % n
+                self._reg().counter("sidecar.pool.quarantine_fallbacks").inc()
+                return w
         return None
 
     def _on_worker_failure(self, w: _Worker, exc: BaseException) -> None:
@@ -601,6 +710,15 @@ class SidecarPool:
             if not w.alive or self._closed:
                 return
             w.alive = False
+            if w.quarantined:
+                # quarantined → dead: the slot leaves the gray state
+                # (the replacement process starts with a clean record);
+                # the probe thread sees alive=False and exits
+                w.quarantined = False
+                reg.gauge(f"sidecar.pool.worker.w{w.wid}.quarantined").set(0)
+                self._set_quarantined_gauge_locked()
+            w.strikes = 0
+            w.clean_probes = 0
             if w.client is not None:
                 w.client.close()
             reg.counter("sidecar.pool.worker_deaths").inc()
@@ -609,6 +727,7 @@ class SidecarPool:
             reg.gauge("sidecar.pool.live").set(live)
             if live > 0:
                 reg.counter("sidecar.pool.failovers").inc()
+            self._health.notify_all()
             metrics.event(
                 "sidecar.pool.worker_death",
                 wid=w.wid,
@@ -641,7 +760,8 @@ class SidecarPool:
                 return
             try:
                 proc, sock = self._spawn_fn(
-                    startup_timeout_s=self._startup_timeout_s, env=self._env
+                    startup_timeout_s=self._startup_timeout_s,
+                    env=self._worker_env(w),
                 )
             except BaseException as e:  # srjt-lint: allow-broad-except(detached respawn supervisor: ANY spawn failure — incl. interpreter-teardown errors — is one counted attempt; escaping would kill the supervisor thread and strand the slot forever)
                 metrics.event(
@@ -690,17 +810,197 @@ class SidecarPool:
                 w.alive = True
                 self._reg().counter("sidecar.pool.respawns").inc()
                 self._set_gauges()
+                self._health.notify_all()
             metrics.event("sidecar.pool.respawn", wid=w.wid)
             return
 
+    def _healthy_locked(self) -> bool:
+        return not self._closed and all(
+            w.alive and not w.quarantined for w in self._workers
+        )
+
     def wait_healthy(self, timeout_s: float = 60.0) -> bool:
-        """Block until every slot is live (tests / operators)."""
+        """Block until every slot is live AND unquarantined (tests /
+        operators). NOTIFY-backed (ISSUE 9): respawn completions,
+        reinstatements, and deaths all signal the health condition, so
+        the wait wakes the instant the pool turns healthy instead of
+        on a poll tick — and it is quarantine-AWARE: a pool whose only
+        live worker is gray is not healthy."""
         end = time.monotonic() + timeout_s
-        while time.monotonic() < end:
-            if self.live_count() == self.size:
-                return True
-            time.sleep(0.05)
-        return self.live_count() == self.size
+        with self._health:
+            while not self._healthy_locked():
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return self._healthy_locked()
+                self._health.wait(remaining)
+            return True
+
+    # -- the health scorer + quarantine (gray-failure defense, ISSUE 9) ------
+
+    def _set_quarantined_gauge_locked(self) -> None:
+        self._reg().gauge("sidecar.pool.quarantined").set(
+            sum(1 for w in self._workers if w.quarantined)
+        )
+
+    def _note_latency(self, w: _Worker, op: int, elapsed_s: float,
+                      timed_out: bool = False) -> None:
+        """One routed exchange's latency verdict: fold the sample into
+        the worker's per-op-class EWMA/jitter and run the gray-failure
+        detector — a sample slower than ``SRJT_QUARANTINE_SLOW_FACTOR``
+        × the pool-wide op-class p50 (or any request TIMEOUT, the
+        unambiguous slow signal) is a strike; a clean sample pays one
+        back. ``SRJT_QUARANTINE_STRIKES`` net strikes quarantine the
+        slot. Cold op classes (fewer than
+        ``SRJT_QUARANTINE_MIN_SAMPLES`` pool-wide samples) yield no
+        verdict either way: a first compile is slow, not gray."""
+        from .utils import knobs
+
+        if not knobs.get_bool("SRJT_QUARANTINE_ENABLED"):
+            return
+        name = op_name(op)
+        self._ewma.update(f"w{w.wid}.{name}", elapsed_s)
+        slow = timed_out
+        if not slow:
+            h = self._reg().histogram(f"sidecar.op_lat_us.{name}")
+            if h.count < knobs.get_int("SRJT_QUARANTINE_MIN_SAMPLES"):
+                return
+            p50_us = h.quantile(0.5)
+            if p50_us is None:
+                return
+            factor = knobs.get_float("SRJT_QUARANTINE_SLOW_FACTOR")
+            slow = elapsed_s > max(p50_us / 1e6, 1e-5) * factor
+        cause = None
+        strikes = 0
+        with self._lock:
+            if self._closed or not w.alive:
+                return
+            if not slow:
+                w.strikes = max(w.strikes - 1, 0)
+                return
+            w.strikes += 1
+            if (not w.quarantined
+                    and w.strikes >= knobs.get_int("SRJT_QUARANTINE_STRIKES")):
+                cause = "timeout" if timed_out else "slow"
+                strikes = w.strikes
+                self._quarantine_locked(w, cause)
+        if cause is not None:
+            # event-log file I/O strictly OUTSIDE the routing lock (the
+            # PR 8 discipline): a slow log write during a quarantine
+            # transition must not stall _pick/wait_healthy
+            from .utils import metrics
+
+            metrics.event(
+                "sidecar.pool.quarantine", wid=w.wid, cause=cause,
+                strikes=strikes,
+            )
+
+    def _quarantine_locked(self, w: _Worker, cause: str) -> None:
+        """Move a live-but-gray slot out of preferred routing and hand
+        it to the background prober (caller holds self._lock; caller
+        also owns emitting the quarantine EVENT after the lock drops —
+        counters are in-lock-safe memory, file I/O is not). The worker
+        process is NOT touched — in-flight requests drain on their own
+        deadlines, and reinstatement is cheap."""
+        w.quarantined = True
+        w.clean_probes = 0
+        reg = self._reg()
+        reg.counter("sidecar.pool.quarantines").inc()
+        reg.gauge(f"sidecar.pool.worker.w{w.wid}.quarantined").set(1)
+        self._set_quarantined_gauge_locked()
+        t = threading.Thread(
+            target=self._probe_quarantined, args=(w,), daemon=True,
+            name=f"srjt-pool-probe-w{w.wid}",
+        )
+        w.probe_thread = t  # shutdown joins this, like the respawner
+        t.start()
+        self._health.notify_all()
+
+    def _probe_quarantined(self, w: _Worker) -> None:
+        """Background prober for one quarantined slot: a PING every
+        ``SRJT_QUARANTINE_PROBE_INTERVAL_S`` under a short deadline
+        scope (utils/deadline.py — the probe can never hang on the
+        wedge it is probing). A round-trip within
+        ``SRJT_QUARANTINE_PROBE_SLOW_S`` is CLEAN; anything else —
+        slow answer, expired probe budget, or the io_lock still held
+        by a wedged data op — resets the run.
+        ``SRJT_QUARANTINE_PROBES`` consecutive clean probes reinstate
+        the slot; a dead transport hands it to the failover/respawn
+        path instead (gray → dead is a real transition)."""
+        from .utils import deadline as deadline_mod, knobs
+        from .utils.errors import RetryableError
+
+        reg = self._reg()
+        while True:
+            interval = knobs.get_float("SRJT_QUARANTINE_PROBE_INTERVAL_S")
+            # detached prober cadence: the wait rides the health
+            # condition so shutdown/death/reinstatement wake it
+            # immediately instead of stranding a long interval — but a
+            # spurious wakeup (any peer's health event notifies too)
+            # re-waits the REMAINING interval, so probe spacing honors
+            # the knob even under pool churn; each probe itself runs
+            # under its own deadline scope below
+            wake_at = time.monotonic() + interval
+            with self._health:
+                while True:
+                    if self._closed or not w.alive or not w.quarantined:
+                        return
+                    left = wake_at - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._health.wait(left)
+                client = w.client
+            slow_s = knobs.get_float("SRJT_QUARANTINE_PROBE_SLOW_S")
+            probe_budget = max(slow_s * 4, 1.0)
+            ok = False
+            dead_exc = None
+            if w.io_lock.acquire(timeout=probe_budget):
+                try:
+                    t0 = time.monotonic()
+                    try:
+                        with deadline_mod.scope(probe_budget):
+                            client.ping()
+                        ok = (time.monotonic() - t0) <= slow_s
+                    except RetryableError as e:
+                        if self._worker_is_dead(w, e):
+                            dead_exc = e
+                    except Exception:  # srjt-lint: allow-broad-except(probe outcome is binary — an expired probe budget (DeadlineExceeded) or any semantic error is simply a dirty probe; the prober must outlive its subject)
+                        pass
+                finally:
+                    w.io_lock.release()
+            reg.counter("sidecar.pool.quarantine_probes").inc()
+            if dead_exc is not None:
+                self._on_worker_failure(w, dead_exc)
+                return
+            reinstated = False
+            with self._lock:
+                if self._closed or not w.alive or not w.quarantined:
+                    return
+                if not ok:
+                    w.clean_probes = 0
+                    continue
+                w.clean_probes += 1
+                if w.clean_probes >= knobs.get_int("SRJT_QUARANTINE_PROBES"):
+                    self._reinstate_locked(w)
+                    reinstated = True
+            if reinstated:
+                from .utils import metrics
+
+                # event file I/O outside the routing lock, as above
+                metrics.event("sidecar.pool.reinstate", wid=w.wid)
+                return
+
+    def _reinstate_locked(self, w: _Worker) -> None:
+        """K clean probes: the slot rejoins preferred routing with a
+        clean record (caller holds self._lock and owns emitting the
+        reinstate EVENT after the lock drops)."""
+        w.quarantined = False
+        w.strikes = 0
+        w.clean_probes = 0
+        reg = self._reg()
+        reg.counter("sidecar.pool.reinstatements").inc()
+        reg.gauge(f"sidecar.pool.worker.w{w.wid}.quarantined").set(0)
+        self._set_quarantined_gauge_locked()
+        self._health.notify_all()
 
     # -- the data path -------------------------------------------------------
 
@@ -711,19 +1011,14 @@ class SidecarPool:
         region: Optional[ArenaRegion],
         region_req: Optional[bytes] = None,
     ):
-        """One routed exchange — the unit the retry orchestrator
-        re-runs. Worker death re-raises retryably AFTER marking the
-        slot dead, so the next attempt routes around the corpse: that
-        re-route IS the failover. Region requests REWRITE the request
-        bytes (``region_req``, snapshotted by ``call``) into the leased
-        region first, under a fresh generation: the worker answers into
-        the same region, so a prior attempt's (possibly partial)
-        response must never be what the retry re-sends — and a worker
-        still holding the old generation gets a retryable desync, not
-        stale bytes. Only the target worker's ``io_lock`` serializes:
-        two region ops on two workers genuinely overlap (the whole
-        point of the slab)."""
-        from .utils.errors import DataCorruption, RetryableError
+        """One routed — and possibly HEDGED (ISSUE 9) — exchange: the
+        unit the retry orchestrator re-runs. When the op class is warm
+        and hedging is armed, the primary leg runs with a hedge timer:
+        past the op-class p95 a duplicate launches on a different
+        healthy worker and the first valid response wins. Cold classes,
+        single-worker pools, pressure, and budget exhaustion all fall
+        back to the plain inline attempt."""
+        from .utils.errors import RetryableError
 
         w = self._pick()
         if w is None:
@@ -731,25 +1026,336 @@ class SidecarPool:
                 "sidecar pool: UNAVAILABLE: no live workers "
                 f"(size={self.size}; respawn in progress or exhausted)"
             )
+        delay_s = self._hedge_delay_s(op, w)
+        if delay_s is None:
+            return self._attempt_on(w, op, payload, region, region_req)
+        return self._race(w, delay_s, op, payload, region, region_req)
+
+    def _attempt_on(
+        self,
+        w: _Worker,
+        op: int,
+        payload: bytes,
+        region: Optional[ArenaRegion],
+        region_req: Optional[bytes] = None,
+    ):
+        """One exchange on a SPECIFIC worker. Worker death re-raises
+        retryably AFTER marking the slot dead, so the next attempt
+        routes around the corpse: that re-route IS the failover.
+        Region requests REWRITE the request bytes (``region_req``,
+        snapshotted by ``call``) into the leased region first, under a
+        fresh generation: the worker answers into the same region, so
+        a prior attempt's (possibly partial) response must never be
+        what the retry re-sends — and a worker still holding the old
+        generation gets a retryable desync, not stale bytes. Only the
+        target worker's ``io_lock`` serializes: two region ops on two
+        workers genuinely overlap (the whole point of the slab). Every
+        exchange feeds the health scorer: successes and timeouts are
+        latency samples (a timeout is the strongest), dead transports
+        are the failover path's business. The sample clock starts AFTER
+        the io_lock is acquired — the scorer judges the worker's
+        SERVICE time, not time spent queued behind a peer caller on
+        the same slot (contended routing must never quarantine a
+        healthy worker)."""
+        from .utils.errors import DataCorruption, RetryableError
+
+        t0 = time.monotonic()
         try:
             with w.io_lock:
+                t0 = time.monotonic()
                 if region is None:
-                    return w.client.request(op, payload)
-                # worker-side arena state is per-CONNECTION: replay
-                # SET_ARENA if the client reconnected since the last
-                # upload (timeout redial, desync close, respawn)
-                self._ensure_arena(w)
-                region.write(region_req)
-                return w.client.request(op, b"", region=region)
+                    resp = w.client.request(op, payload)
+                else:
+                    # worker-side arena state is per-CONNECTION: replay
+                    # SET_ARENA if the client reconnected since the last
+                    # upload (timeout redial, desync close, respawn)
+                    self._ensure_arena(w)
+                    region.write(region_req)
+                    resp = w.client.request(op, b"", region=region)
         except DataCorruption:
             # a corrupted FRAME is not a dead WORKER: the transport
             # round-tripped, the payload rotted. Retry re-sends; the
             # worker keeps its slot.
+            self._note_latency(w, op, time.monotonic() - t0)
             raise
         except RetryableError as e:
             if self._worker_is_dead(w, e):
                 self._on_worker_failure(w, e)
+            else:
+                # every exchange the worker ANSWERED is a latency
+                # observation, whatever the classification: a lost
+                # hedge race's loser surfaces as a region desync (the
+                # winner's caller released the lease), and before this
+                # was scored a gray worker whose stragglers kept losing
+                # races never accumulated strikes — the defense hid the
+                # evidence. Timeouts stay the unambiguous strong signal.
+                self._note_latency(
+                    w, op, time.monotonic() - t0,
+                    timed_out="DEADLINE_EXCEEDED" in str(e),
+                )
             raise
+        self._note_latency(w, op, time.monotonic() - t0)
+        return resp
+
+    # -- hedged dispatch (tail-latency defense, ISSUE 9) ---------------------
+
+    def _hedge_pressure_cause(self) -> Optional[str]:
+        """Hedging must never melt an overloaded pool: duplicates are
+        withheld while the memory governor reports blocked admissions
+        or within ``SRJT_HEDGE_SHED_WINDOW_S`` of a serve-layer shed
+        (the scheduler stamps ``serve.last_shed_s`` registry-direct)."""
+        from . import memgov
+        from .utils import knobs
+
+        reg = self._reg()
+        if memgov.is_enabled() and reg.value("memgov.queue_depth", 0) > 0:
+            return "memgov_pressure"
+        last_shed = reg.value("serve.last_shed_s", None)
+        if (
+            last_shed is not None
+            and time.monotonic() - last_shed
+            < knobs.get_float("SRJT_HEDGE_SHED_WINDOW_S")
+        ):
+            return "shed_pressure"
+        return None
+
+    def _hedge_budget_ok(self) -> bool:
+        """Global hedge budget: duplicates stay ≤
+        ``SRJT_HEDGE_BUDGET_PCT`` percent of total pool calls."""
+        from .utils import knobs
+
+        reg = self._reg()
+        pct = knobs.get_float("SRJT_HEDGE_BUDGET_PCT")
+        launched = reg.value("sidecar.pool.hedges_launched", 0)
+        calls = reg.value("sidecar.pool.calls", 0)
+        return (launched + 1) * 100.0 <= pct * max(calls, 1)
+
+    def _hedge_try_reserve(self) -> bool:
+        """Atomically claim one hedge-budget slot (check + increment of
+        ``sidecar.pool.hedges_launched`` under one lock): concurrent
+        races at the budget margin get exactly one launch, never two —
+        the gate on hedge volume is a hard ceiling."""
+        with self._hedge_lock:
+            if not self._hedge_budget_ok():
+                return False
+            self._reg().counter("sidecar.pool.hedges_launched").inc()
+            return True
+
+    def _hedge_delay_s(self, op: int, primary: _Worker) -> Optional[float]:
+        """The hedge trigger for this attempt, or None to dispatch
+        plainly inline: hedging needs the knob armed, a SECOND healthy
+        worker to land on, a warm op class (≥ ``SRJT_HEDGE_MIN_SAMPLES``
+        pool-wide samples), no pressure, and enough remaining budget
+        for a second leg to matter. The delay itself is the op-class
+        p95 floored at ``SRJT_HEDGE_MIN_DELAY_S`` — only the slow tail
+        pays for a duplicate."""
+        from .utils import deadline as deadline_mod, knobs, metrics
+
+        if not knobs.get_bool("SRJT_HEDGE_ENABLED"):
+            return None
+        with self._lock:
+            if not any(
+                x.alive and not x.quarantined and x is not primary
+                for x in self._workers
+            ):
+                return None
+        reg = self._reg()
+        h = reg.histogram(f"sidecar.op_lat_us.{op_name(op)}")
+        if h.count < knobs.get_int("SRJT_HEDGE_MIN_SAMPLES"):
+            return None
+        cause = self._hedge_pressure_cause()
+        if cause is not None:
+            reg.counter("sidecar.pool.hedges_suppressed").inc()
+            metrics.event(
+                "sidecar.pool.hedge_suppressed", cause=cause, op=op_name(op)
+            )
+            return None
+        p95_us = h.quantile(0.95)
+        p50_us = h.quantile(0.5)
+        if p95_us is None or p50_us is None:
+            return None
+        # pollution guard: one gray worker's slow samples inflate the
+        # op-class p95 toward ITS latency — exactly the regime hedging
+        # exists for — so the trigger is additionally ceilinged at the
+        # quarantine slow threshold (factor × p50, median-robust). A
+        # healthy tight distribution keeps p95 ≈ p50 and the ceiling
+        # inert; a poisoned tail gets a trigger the stragglers still
+        # cross.
+        ceiling = max(p50_us / 1e6, 1e-5) * knobs.get_float(
+            "SRJT_QUARANTINE_SLOW_FACTOR"
+        )
+        delay = max(
+            min(p95_us / 1e6, ceiling),
+            knobs.get_float("SRJT_HEDGE_MIN_DELAY_S"),
+        )
+        d = deadline_mod.current()
+        if d is not None and delay >= d.remaining():
+            return None  # no time left for a second leg to help
+        return delay
+
+    def _race(
+        self,
+        primary: _Worker,
+        delay_s: float,
+        op: int,
+        payload: bytes,
+        region: Optional[ArenaRegion],
+        region_req: Optional[bytes],
+    ):
+        """Hedged dispatch: run the primary leg on its own thread (the
+        ambient deadline scope rides contextvars into it); if it
+        outlives ``delay_s``, launch ONE duplicate on a different
+        healthy worker. FIRST VALID RESPONSE WINS — a winner is
+        recorded exactly once under the race lock, the loser's eventual
+        response (or error) is discarded. EVERY raced leg of a REGION
+        request leases its own PRIVATE region, released in that leg's
+        finally — the caller's lease is never handed to a thread that
+        may outlive the race, so a straggling loser can neither write
+        a released lease nor collide with the winner (and its full
+        round-trip still lands in the health scorer: the gray evidence
+        this race exists to collect). Both-legs-fail re-raises the
+        primary's error so retry classification is unchanged from the
+        unhedged path."""
+        import contextvars
+
+        from .utils import deadline as deadline_mod, metrics
+        from .utils.errors import RetryableError
+
+        reg = self._reg()
+        primary_region = None
+        if region is not None:
+            try:
+                # match the CALLER's capacity, not the request length:
+                # the worker answers into the leg's region, and a
+                # caller that leased big for a big response must keep
+                # that headroom on every raced leg
+                primary_region = self.lease(region.capacity)
+            except RetryableError:
+                # slab too tight for a private racing lease: dispatch
+                # plainly inline on the caller's region instead
+                return self._attempt_on(primary, op, payload, region,
+                                        region_req)
+        st_lock = threading.Lock()
+        done = threading.Event()
+        outcome = {"winner": None, "errors": {}, "legs": 1, "completed": 0}
+
+        def leg(w, leg_region, is_hedge):
+            try:
+                r = self._attempt_on(w, op, payload, leg_region, region_req)
+            except BaseException as e:  # srjt-lint: allow-broad-except(race leg: the error is stored for the settling thread to re-raise with full taxonomy; escaping would kill the leg thread and strand the race)
+                with st_lock:
+                    outcome["errors"][is_hedge] = e
+                    outcome["completed"] += 1
+                    if (outcome["completed"] >= outcome["legs"]
+                            and outcome["winner"] is None):
+                        done.set()
+                return
+            with st_lock:
+                outcome["completed"] += 1
+                if outcome["winner"] is None:
+                    outcome["winner"] = (r, is_hedge)
+                done.set()
+
+        def primary_leg():
+            try:
+                leg(primary, primary_region, False)
+            finally:
+                if primary_region is not None:
+                    primary_region.release()
+
+        ctx = contextvars.copy_context()
+        threading.Thread(
+            target=ctx.run, args=(primary_leg,),
+            daemon=True, name=f"srjt-pool-leg-w{primary.wid}",
+        ).start()
+        hedged = False
+        if not done.wait(delay_s):
+            # the duplicate must land on a HEALTHY peer — a hedge
+            # routed onto a quarantined straggler is pure waste, so the
+            # gray fallback is disabled for this pick
+            w2 = self._pick(exclude=primary, allow_quarantined=False)
+            hedge_region = None
+            suppress_cause = None
+            if w2 is None:
+                suppress_cause = "no_peer"
+            else:
+                if region is not None:
+                    try:
+                        # hedges lease DISTINCT regions (caller-sized,
+                        # as above): the duplicate must never write
+                        # into the primary's lease
+                        hedge_region = self.lease(region.capacity)
+                    except RetryableError:
+                        # slab exhausted: the hedge is a nicety, the
+                        # primary leg is the request — suppress, don't
+                        # fail the race
+                        suppress_cause = "slab_exhausted"
+                if suppress_cause is None and not self._hedge_try_reserve():
+                    suppress_cause = "budget"
+                    if hedge_region is not None:
+                        hedge_region.release()
+                        hedge_region = None
+            if suppress_cause is not None:
+                reg.counter("sidecar.pool.hedges_suppressed").inc()
+                metrics.event(
+                    "sidecar.pool.hedge_suppressed",
+                    cause=suppress_cause, op=op_name(op),
+                )
+            else:
+                with st_lock:
+                    outcome["legs"] = 2
+                    if outcome["winner"] is None and outcome["completed"]:
+                        # the primary FAILED inside the launch window
+                        # and settled a one-leg race: un-settle it —
+                        # the hedge is now in play, and first valid
+                        # response still wins (both-fail re-settles
+                        # via the completed >= legs path)
+                        done.clear()
+                hedged = True
+                metrics.event(
+                    "sidecar.pool.hedge", op=op_name(op),
+                    primary=primary.wid, hedge=w2.wid,
+                    delay_ms=round(delay_s * 1e3, 3),
+                )
+
+                def hedge_leg(hr=hedge_region, w=w2):
+                    try:
+                        leg(w, hr, True)
+                    finally:
+                        if hr is not None:
+                            hr.release()
+
+                threading.Thread(
+                    target=contextvars.copy_context().run,
+                    args=(hedge_leg,), daemon=True,
+                    name=f"srjt-pool-hedge-w{w2.wid}",
+                ).start()
+        while not done.wait(0.25):
+            # both legs are bounded by their own (adaptive) socket
+            # deadlines, so the event always settles; the check here
+            # just surfaces a dying QUERY budget promptly
+            deadline_mod.check(f"sidecar_pool_hedge_{op_name(op)}")
+        with st_lock:
+            winner = outcome["winner"]
+            errors = dict(outcome["errors"])
+            completed = outcome["completed"]
+            legs = outcome["legs"]
+        if winner is None:
+            # every launched leg failed: re-raise the primary's error
+            # (retry classification identical to the unhedged path)
+            raise errors.get(False) or errors.get(True)
+        resp, is_hedge = winner
+        if hedged:
+            if is_hedge:
+                reg.counter("sidecar.pool.hedges_won").inc()
+                metrics.event("sidecar.pool.hedge_won", op=op_name(op))
+            if legs == 2:
+                # the loser was either still in flight (cancelled: its
+                # response will be discarded on arrival) or already
+                # answered a duplicate that lost the winner slot —
+                # either way exactly one completion reached the caller
+                reg.counter("sidecar.pool.hedges_cancelled").inc()
+        return resp
 
     @staticmethod
     def _worker_is_dead(w: _Worker, exc: BaseException) -> bool:
@@ -783,15 +1389,20 @@ class SidecarPool:
         failover, invisible to the breaker.
 
         Region contract: ``lease()`` a region, ``region.write()`` the
-        request, pass ``region=``; the response replaces the region's
-        payload. Within one call the pool snapshots the request up
-        front and replays it (fresh generation) before every retry
-        attempt — a dead worker's partial response can never be what
-        the failover re-sends."""
+        request, pass ``region=``; the RESPONSE IS THE RETURN VALUE.
+        (With hedging armed a raced attempt runs both legs on private
+        leases, so the caller's region is NOT rewritten with the
+        response — its post-call contents are unspecified; read the
+        returned bytes, as ``call_arena`` does.) Within one call the
+        pool snapshots the request up front and replays it (fresh
+        generation) before every retry attempt — a dead worker's
+        partial response can never be what the failover re-sends."""
         from .utils import deadline as deadline_mod, metrics, retry
         from .utils.errors import DeadlineExceeded, DeviceError
 
         deadline_mod.check(f"sidecar_pool_op_{op}")
+        # the hedge budget's denominator: every pool call, hedged or not
+        self._reg().counter("sidecar.pool.calls").inc()
         region_req = None
         if region is not None:
             # snapshot the request NOW, from the bytes the caller handed
@@ -1006,9 +1617,12 @@ class SidecarPool:
             return {
                 "size": self.size,
                 "live": self.live_count(),
+                "routable": self.routable_count(),
                 "workers": {
                     f"w{w.wid}": {
                         "alive": w.alive,
+                        "quarantined": w.quarantined,
+                        "strikes": w.strikes,
                         "spawns": w.spawns,
                         "pid": None if w.proc is None else w.proc.pid,
                     }
@@ -1019,6 +1633,10 @@ class SidecarPool:
                 "respawns": reg.value("sidecar.pool.respawns"),
                 "rehydrations": reg.value("sidecar.pool.rehydrations"),
                 "host_fallbacks": reg.value("sidecar.pool.host_fallbacks"),
+                "quarantines": reg.value("sidecar.pool.quarantines"),
+                "reinstatements": reg.value("sidecar.pool.reinstatements"),
+                "hedges_launched": reg.value("sidecar.pool.hedges_launched"),
+                "hedges_won": reg.value("sidecar.pool.hedges_won"),
                 "arena_bytes": 0 if slab is None else slab.size,
                 "slab_regions": 0 if slab is None else slab.outstanding,
                 "region_leases": reg.value("sidecar.pool.region_leases"),
@@ -1102,3 +1720,44 @@ def stats_section() -> Optional[dict]:
     pool has been connected (the seed posture)."""
     p = current_pool()
     return None if p is None else p.snapshot()
+
+
+def health_section() -> dict:
+    """The ``health`` section of runtime.stats_report() (ISSUE 9):
+    gray-failure verdicts — registry-direct, so it answers (zeros)
+    even before any pool exists, plus the live pool's per-worker EWMA
+    snapshot when one is connected."""
+    from .utils import metrics
+
+    reg = metrics.registry()
+    out = {
+        "quarantines": reg.value("sidecar.pool.quarantines"),
+        "reinstatements": reg.value("sidecar.pool.reinstatements"),
+        "probes": reg.value("sidecar.pool.quarantine_probes"),
+        "quarantined_now": reg.value("sidecar.pool.quarantined"),
+        "quarantine_fallbacks": reg.value("sidecar.pool.quarantine_fallbacks"),
+    }
+    p = current_pool()
+    if p is not None:
+        out["worker_latency"] = p._ewma.snapshot()
+    return out
+
+
+def hedge_section() -> dict:
+    """The ``hedge`` section of runtime.stats_report() (ISSUE 9):
+    hedged-dispatch accounting plus the adaptive-timeout clamp counts
+    from both adaptive-deadline call sites."""
+    from .utils import metrics
+
+    reg = metrics.registry()
+    return {
+        "launched": reg.value("sidecar.pool.hedges_launched"),
+        "won": reg.value("sidecar.pool.hedges_won"),
+        "cancelled": reg.value("sidecar.pool.hedges_cancelled"),
+        "suppressed": reg.value("sidecar.pool.hedges_suppressed"),
+        "pool_calls": reg.value("sidecar.pool.calls"),
+        "adaptive_timeout_clamps": {
+            "sidecar": reg.value("sidecar.adaptive_timeout_clamps"),
+            "exchange": reg.value("shuffle.tcp.adaptive_timeout_clamps"),
+        },
+    }

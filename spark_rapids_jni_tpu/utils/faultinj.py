@@ -12,6 +12,14 @@ sit. Feature parity:
   keys a whole choke-point family, e.g. ``"exchange.*"`` covers
   ``exchange.serve`` and ``exchange.frame``), or the ``"*"`` wildcard
   (:142-152),
+- PER-WORKER targeting (ISSUE 9): any key may carry an ``@<tag>``
+  suffix (``sidecar.worker.GROUPBY_SUM_F32@w1``) that matches only in
+  a process whose ``SRJT_FAULTINJ_WORKER`` tag equals ``<tag>`` — the
+  worker pool stamps every spawned worker ``w<slot>``, so ONE gray
+  worker can be simulated deterministically while its peers stay
+  clean. Resolution specificity, most-specific first:
+  ``op@tag`` > ``op`` > longest ``prefix.*@tag`` > longest
+  ``prefix.*`` > ``*@tag`` > ``*``,
 - injection types: ``fatal`` (FatalDeviceError — the trap/assert
   analog, :135-140), ``retryable`` (RetryableError), ``exception``
   (plain RuntimeError — the FI_RETURN_VALUE analog), ``delay``
@@ -119,12 +127,18 @@ class _State:
         self.path: Optional[str] = None
         self.mtime: float = 0.0
         self.enabled = False
+        self.worker_tag: Optional[str] = None  # SRJT_FAULTINJ_WORKER
 
 
 _state = _State()
 
 
 def _parse(cfg: dict) -> None:
+    # the process's worker tag is latched per configure (the spawned
+    # worker reads its env-stamped slot name once, with the profile)
+    from . import knobs as _k
+
+    _state.worker_tag = _k.get_str("SRJT_FAULTINJ_WORKER") or None
     _state.rules = {}
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
@@ -189,6 +203,47 @@ def _reload_if_changed() -> None:
         _state.mtime = m
 
 
+def _resolve_rule_locked(op_name: str) -> Optional[_Rule]:
+    """Rule resolution, most-specific first (ISSUE 9): exact with this
+    process's worker tag (``op@w1``) > plain exact > longest
+    tag-suffixed prefix family (``prefix.*@w1``) > longest plain
+    prefix family > tagged wildcard (``*@w1``) > bare ``*``. Keys
+    carrying a FOREIGN tag never match, so one profile can ramp a
+    single gray worker while its pool peers run the same config
+    clean."""
+    tag = _state.worker_tag
+    if tag:
+        rule = _state.rules.get(f"{op_name}@{tag}")
+        if rule is not None:
+            return rule
+    rule = _state.rules.get(op_name)
+    if rule is not None:
+        return rule
+    for suffix in (f"@{tag}" if tag else None, ""):
+        if suffix is None:
+            continue
+        best, best_len = None, -1
+        for key, r in _state.rules.items():
+            if suffix and not key.endswith(suffix):
+                continue
+            stem = key[: len(key) - len(suffix)] if suffix else key
+            if "@" in stem:
+                continue  # a foreign (or any) tag on the plain pass
+            if (
+                stem.endswith(".*")
+                and op_name.startswith(stem[:-1])
+                and len(stem) > best_len
+            ):
+                best, best_len = r, len(stem)
+        if best is not None:
+            return best
+    if tag:
+        rule = _state.rules.get(f"*@{tag}")
+        if rule is not None:
+            return rule
+    return _state.rules.get("*")
+
+
 def _draw_locked(op_name: str, corrupt: bool):
     """Locked half of fault arming shared by ``maybe_inject`` and
     ``maybe_corrupt``: resolve the rule, run the `after`/`ramp`/budget
@@ -198,20 +253,7 @@ def _draw_locked(op_name: str, corrupt: bool):
     budget on a ``maybe_inject`` dispatch (its choke point is the
     payload producer), and vice versa."""
     _reload_if_changed()
-    rule = _state.rules.get(op_name)
-    if rule is None:
-        # "prefix.*" family rules: longest matching prefix wins, the
-        # bare "*" wildcard is the floor
-        best_len = -1
-        for key, r in _state.rules.items():
-            if (
-                key.endswith(".*")
-                and op_name.startswith(key[:-1])
-                and len(key) > best_len
-            ):
-                rule, best_len = r, len(key)
-        if rule is None:
-            rule = _state.rules.get("*")
+    rule = _resolve_rule_locked(op_name)
     if rule is None:
         return None
     if (rule.kind == "corrupt") != corrupt:

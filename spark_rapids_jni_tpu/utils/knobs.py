@@ -287,6 +287,10 @@ declare("SRJT_FAULTINJ_CONFIG", "str", None,
 declare("SRJT_CHAOS_EXIT_ON_OP", "int", None,
         "sidecar worker chaos: die (exit 42) after consuming a request "
         "for this op code, before any response")
+declare("SRJT_FAULTINJ_WORKER", "str", None,
+        "this process's worker tag (w0, w1, ...) for per-worker fault "
+        "rule keys like sidecar.worker.<OP>@w1; the pool sets it on "
+        "every spawned worker")
 
 # sidecar supervision (sidecar.py, PRs 1/3/5)
 declare("SRJT_SIDECAR_TIMEOUT_SEC", "float", 600.0,
@@ -321,6 +325,63 @@ declare("SRJT_POOL_RESPAWN_DELAY_S", "float", 0.5,
 declare("SRJT_ARENA_SLAB_BYTES", "int", 64 << 20,
         "slab arena size, rounded up to a power of two (memfd-backed, "
         "virtual until touched)", minimum=4096)
+
+# tail tolerance: gray-failure quarantine + hedged dispatch
+# (sidecar_pool.py, ISSUE 9)
+declare("SRJT_QUARANTINE_ENABLED", "bool", True,
+        "arm the gray-failure detector: persistently-slow pool workers "
+        "are quarantined out of routing and background-probed")
+declare("SRJT_QUARANTINE_SLOW_FACTOR", "float", 3.0,
+        "a sample slower than this multiple of the pool-wide op-class "
+        "p50 is a strike", positive=True)
+declare("SRJT_QUARANTINE_STRIKES", "int", 5,
+        "net strikes (slow samples minus clean ones) before a worker "
+        "is quarantined", minimum=1)
+declare("SRJT_QUARANTINE_MIN_SAMPLES", "int", 20,
+        "op-class samples required before the detector issues "
+        "verdicts (cold starts are never strikes)", minimum=1)
+declare("SRJT_QUARANTINE_PROBES", "int", 3,
+        "consecutive clean probes before a quarantined worker is "
+        "reinstated", minimum=1)
+declare("SRJT_QUARANTINE_PROBE_INTERVAL_S", "float", 0.25,
+        "pause between background probes of a quarantined worker",
+        positive=True)
+declare("SRJT_QUARANTINE_PROBE_SLOW_S", "float", 0.25,
+        "a probe round-trip slower than this is dirty (resets the "
+        "clean-probe run)", positive=True)
+declare("SRJT_HEDGE_ENABLED", "bool", True,
+        "arm hedged dispatch: a pool request outliving the op-class "
+        "p95 launches one duplicate on a different healthy worker, "
+        "first valid response wins")
+declare("SRJT_HEDGE_BUDGET_PCT", "float", 10.0,
+        "global hedge budget: duplicates stay under this percent of "
+        "total pool calls", positive=True)
+declare("SRJT_HEDGE_MIN_SAMPLES", "int", 20,
+        "op-class samples required before hedging arms (cold ops "
+        "never hedge)", minimum=1)
+declare("SRJT_HEDGE_MIN_DELAY_S", "float", 0.05,
+        "floor on the hedge trigger delay: ops faster than this "
+        "never hedge", positive=True)
+declare("SRJT_HEDGE_SHED_WINDOW_S", "float", 5.0,
+        "hedging auto-disarms for this long after a serve-layer shed "
+        "(an overloaded pool must not carry duplicate load)",
+        positive=True)
+
+# adaptive timeouts (sidecar.py / parallel/shuffle.py, ISSUE 9)
+declare("SRJT_ADAPTIVE_TIMEOUT_ENABLED", "bool", True,
+        "derive per-op socket deadlines from observed latency "
+        "quantiles (q99 x multiplier) instead of the static knob "
+        "once enough samples exist")
+declare("SRJT_ADAPTIVE_TIMEOUT_MULT", "float", 4.0,
+        "adaptive deadline = observed op q99 x this multiplier",
+        positive=True)
+declare("SRJT_ADAPTIVE_TIMEOUT_FLOOR_S", "float", 10.0,
+        "adaptive deadlines never shrink below this floor",
+        positive=True)
+declare("SRJT_ADAPTIVE_TIMEOUT_MIN_SAMPLES", "int", 40,
+        "per-op samples required before the adaptive deadline "
+        "replaces the static knob (cold-start ops keep the knob)",
+        minimum=1)
 
 # cross-process exchange (parallel/shuffle.py, PR 6)
 declare("SRJT_EXCHANGE_MODE", "str", "mesh",

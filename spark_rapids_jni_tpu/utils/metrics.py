@@ -58,6 +58,8 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "KeyedEwma",
+    "adaptive_timeout_s",
     "Registry",
     "registry",
     "counter",
@@ -178,6 +180,38 @@ class Histogram:
     def count(self) -> int:
         return self._count
 
+    def quantile(self, q: float):
+        """Approximate quantile read off the log2 buckets (ISSUE 9):
+        the rank's bucket is found by cumulative count, then linearly
+        interpolated across the bucket's [2^(k-1), 2^k) span and
+        tightened by the recorded min/max. None when empty. Good to a
+        factor of 2 by construction — exactly the precision an
+        adaptive timeout or a hedge trigger needs, at zero extra
+        hot-path cost (the recording side is unchanged)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return None
+            lo, hi = self._min, self._max
+            rank = q * total
+            if rank <= 1:
+                return lo
+            cum = 0
+            for k, n in enumerate(self._buckets):
+                if not n:
+                    continue
+                if cum + n >= rank:
+                    if k == 0:
+                        return min(max(0.0, lo), hi)
+                    lower, upper = float(1 << (k - 1)), float(1 << k)
+                    frac = (rank - cum) / n
+                    est = lower + frac * (upper - lower)
+                    return min(max(est, lo), hi)
+                cum += n
+            return hi
+
     def _reset(self) -> None:
         with self._lock:
             self._buckets = [0] * _N_BUCKETS
@@ -227,8 +261,79 @@ class _NullMetric:
     def count(self):
         return 0
 
+    def quantile(self, q: float):
+        return None
+
 
 _NULL = _NullMetric()
+
+
+class KeyedEwma:
+    """Bounded-memory per-key EWMA + jitter tracker (ISSUE 9): the
+    health scorer's streaming state. Each key carries an exponentially
+    weighted moving average of its samples plus an EWMA of the absolute
+    deviation (the jitter — a worker whose heartbeat round-trips wander
+    is as suspect as one whose mean drifts). The map is BOUNDED:
+    at ``max_keys`` the least-recently-updated key is evicted, so a
+    per-(worker, op) keying can never grow with workload cardinality."""
+
+    __slots__ = ("_lock", "_alpha", "_max_keys", "_entries", "_seq")
+
+    def __init__(self, alpha: float = 0.3, max_keys: int = 512):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if max_keys < 1:
+            raise ValueError(f"max_keys must be >= 1, got {max_keys}")
+        self._lock = threading.Lock()
+        self._alpha = float(alpha)
+        self._max_keys = int(max_keys)
+        self._entries: Dict[str, list] = {}  # key -> [ewma, jitter, count, seq]
+        self._seq = 0
+
+    def update(self, key: str, value: float) -> float:
+        """Fold one sample into ``key``'s EWMA; returns the new mean."""
+        v = float(value)
+        with self._lock:
+            self._seq += 1
+            e = self._entries.get(key)
+            if e is None:
+                if len(self._entries) >= self._max_keys:
+                    oldest = min(self._entries, key=lambda k: self._entries[k][3])
+                    del self._entries[oldest]
+                self._entries[key] = [v, 0.0, 1, self._seq]
+                return v
+            dev = abs(v - e[0])
+            e[0] += self._alpha * (v - e[0])
+            e[1] += self._alpha * (dev - e[1])
+            e[2] += 1
+            e[3] = self._seq
+            return e[0]
+
+    def get(self, key: str, default=None):
+        with self._lock:
+            e = self._entries.get(key)
+            return default if e is None else e[0]
+
+    def jitter(self, key: str, default=None):
+        with self._lock:
+            e = self._entries.get(key)
+            return default if e is None else e[1]
+
+    def count(self, key: str) -> int:
+        with self._lock:
+            e = self._entries.get(key)
+            return 0 if e is None else e[2]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                k: {"ewma": e[0], "jitter": e[1], "count": e[2]}
+                for k, e in self._entries.items()
+            }
 
 
 class Registry:
@@ -484,6 +589,35 @@ def snapshot() -> dict:
     return _REGISTRY.snapshot()
 
 
+def adaptive_timeout_s(hist_name: str, static_s: float):
+    """Derive an ADAPTIVE socket deadline from an observed latency
+    histogram recorded in MICROSECONDS (ISSUE 9): returns
+    ``(budget_s, clamped)`` where ``budget_s`` is
+    ``clamp(q99 × SRJT_ADAPTIVE_TIMEOUT_MULT,
+    [SRJT_ADAPTIVE_TIMEOUT_FLOOR_S, static_s])`` once the histogram
+    holds at least ``SRJT_ADAPTIVE_TIMEOUT_MIN_SAMPLES`` samples, and
+    the static knob unchanged before that (cold-start ops — first
+    compile, first dial — keep the conservative deadline). ``clamped``
+    is True only when observation actually SHRANK the deadline, so
+    callers can count clamps without re-deriving. Reads the registry
+    directly (never creates the histogram): adaptive deadlines are
+    product behavior and must work with SRJT_METRICS_ENABLED off."""
+    if not knobs.get_bool("SRJT_ADAPTIVE_TIMEOUT_ENABLED"):
+        return static_s, False
+    h = _REGISTRY._metrics.get(hist_name)
+    if not isinstance(h, Histogram):
+        return static_s, False
+    if h.count < knobs.get_int("SRJT_ADAPTIVE_TIMEOUT_MIN_SAMPLES"):
+        return static_s, False
+    q99_us = h.quantile(0.99)
+    if q99_us is None:
+        return static_s, False
+    budget = q99_us / 1e6 * knobs.get_float("SRJT_ADAPTIVE_TIMEOUT_MULT")
+    budget = max(budget, knobs.get_float("SRJT_ADAPTIVE_TIMEOUT_FLOOR_S"))
+    budget = min(budget, float(static_s))
+    return budget, budget < float(static_s)
+
+
 def fold_worker_counters(counters: Optional[dict], prefix: str = "sidecar.worker.") -> None:
     """Fold a sidecar WORKER's counter snapshot (the STATS verb's
     ``snapshot.counters`` map) into this process's registry under
@@ -565,6 +699,25 @@ def stage_report(stage: str) -> dict:
         "integrity": {
             "crc_mismatch": _REGISTRY.value("sidecar.integrity.crc_mismatch"),
             "frames_checked": _REGISTRY.value("sidecar.integrity.frames_checked"),
+        },
+        # ISSUE 9 tail-tolerance counters: gray-failure quarantine
+        # verdicts and hedged-dispatch accounting — the gray-storm
+        # artifacts assert quarantines/hedges_won > 0 from exactly these
+        "health": {
+            "quarantines": _REGISTRY.value("sidecar.pool.quarantines"),
+            "reinstatements": _REGISTRY.value("sidecar.pool.reinstatements"),
+            "probes": _REGISTRY.value("sidecar.pool.quarantine_probes"),
+            "quarantined_now": _REGISTRY.value("sidecar.pool.quarantined"),
+        },
+        "hedge": {
+            "launched": _REGISTRY.value("sidecar.pool.hedges_launched"),
+            "won": _REGISTRY.value("sidecar.pool.hedges_won"),
+            "cancelled": _REGISTRY.value("sidecar.pool.hedges_cancelled"),
+            "suppressed": _REGISTRY.value("sidecar.pool.hedges_suppressed"),
+            "adaptive_timeout_clamps": (
+                _REGISTRY.value("sidecar.adaptive_timeout_clamps")
+                + _REGISTRY.value("shuffle.tcp.adaptive_timeout_clamps")
+            ),
         },
         # ISSUE 8 serving counters: admission outcomes under load — the
         # chaos-under-load artifacts assert sheds surfaced as Overloaded
