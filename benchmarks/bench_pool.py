@@ -48,14 +48,14 @@ import numpy as np
 from spark_rapids_jni_tpu import sidecar, sidecar_pool
 from spark_rapids_jni_tpu.ops.copying import concatenate, slice_table
 from spark_rapids_jni_tpu.parallel import shuffle
-from spark_rapids_jni_tpu.utils import metrics, retry
+from spark_rapids_jni_tpu.utils import knobs, metrics, retry
 
 import struct
 
 
 def _emit(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
-    out_path = os.environ.get("SRJT_RESULTS")
+    out_path = knobs.get_str("SRJT_RESULTS")
     if out_path:
         with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")

@@ -56,7 +56,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu import serve
 from spark_rapids_jni_tpu.models import tpcds, tpch
-from spark_rapids_jni_tpu.utils import faultinj, metrics, retry
+from spark_rapids_jni_tpu.utils import faultinj, knobs, metrics, retry
 from spark_rapids_jni_tpu.utils.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -74,7 +74,7 @@ _GRAY_PROFILE = os.path.join(
 
 def _emit(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
-    out_path = os.environ.get("SRJT_RESULTS")
+    out_path = knobs.get_str("SRJT_RESULTS")
     if out_path:
         with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")

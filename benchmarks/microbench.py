@@ -39,6 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.utils import knobs
 from spark_rapids_jni_tpu.columnar import dtype as dt
 from spark_rapids_jni_tpu.models.datagen import Profile, create_random_table, cycle_dtypes
 
@@ -101,7 +102,7 @@ def _report(
     if protocol != "chained" and rec["gb_per_s"] > _HBM_ROOFLINE_GBS:
         rec["suspect_rawsync"] = True
     print(json.dumps(rec), flush=True)
-    out_path = os.environ.get("SRJT_RESULTS")
+    out_path = knobs.get_str("SRJT_RESULTS")
     if out_path:
         with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")

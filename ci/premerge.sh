@@ -21,21 +21,36 @@ EOF
 ./native/build/jni_harness ./native/build/libsrjt_jnitest.so \
   /tmp/srjt_jni_harness.parquet 1000
 
-# correctness-tooling tier (ISSUE 7, layer 1): srjt-lint must be clean
-# — undeclared/undocumented SRJT knobs, taxonomy-violating raises,
-# unsuppressed broad excepts, stub-pattern regressions, and blind
-# blocking calls all fail the merge here, before any test runs
-python -m spark_rapids_jni_tpu.analysis.lint
+# correctness-tooling tier (ISSUEs 7 + 11, layer 1): srjt-lint AND the
+# srjt-race static pass must be clean — undeclared/undocumented SRJT
+# knobs (now including tests/ and benchmarks/), taxonomy-violating
+# raises, unsuppressed broad excepts, stub-pattern regressions, blind
+# blocking calls, mixed guarded/unguarded attribute access (SRJT008),
+# check-then-act splits (SRJT009), and bare mutable-global mutation
+# (SRJT010) all fail the merge here, before any test runs. Findings
+# are archived as SARIF next to the other artifacts (exit-code parity
+# with text mode, so the gate semantics are unchanged).
+mkdir -p artifacts
+python -m spark_rapids_jni_tpu.analysis.lint --format=sarif --out artifacts/srjt_lint.sarif
+python -m spark_rapids_jni_tpu.analysis.races --format=sarif --out artifacts/srjt_race.sarif
 
 # fast tier: the measured heavy tail (tests/conftest.py _SLOW_TESTS)
 # runs nightly (ci/nightly.sh); this keeps the premerge gate usable on
 # a 1-core box (VERDICT r3 item 9). SRJT_LOCKDEP=1 (ISSUE 7, layer 2)
 # arms the lock-order instrumentation so every concurrency test in the
-# tier doubles as a deadlock probe; each process (incl. spawned sidecar
-# workers, which inherit the env) drops artifacts/lockdep/
-# lockdep_<pid>.json at exit, merged and gated after the chaos tiers.
+# tier doubles as a deadlock probe, and SRJT_RACE=1 (ISSUE 11, layer 2)
+# rides the same shim: per-thread vector clocks over every
+# lock/Event/Thread/Semaphore/Barrier edge, with the scheduler's
+# tenant lanes, the pool's worker-health records and hedge budget, the
+# memgov catalog map, and the metrics registry all tracked — an
+# unordered access lands as race_pairs in the same per-process report
+# and fails the same merge gate. The armed tier must stay within 1.5x
+# its unarmed wall-clock (the shim is proportional to sync-op count,
+# not data volume). Each process (incl. spawned sidecar workers, which
+# inherit the env) drops artifacts/lockdep/lockdep_<pid>.json at exit,
+# merged and gated after the chaos tiers.
 rm -rf artifacts/lockdep
-SRJT_LOCKDEP=1 python -m pytest tests/ -q -m "not slow"
+SRJT_LOCKDEP=1 SRJT_RACE=1 python -m pytest tests/ -q -m "not slow"
 
 # robustness + observability tier: the chaos suite re-runs the
 # end-to-end distributed pipeline under the storm profile (retryable +
@@ -175,11 +190,11 @@ EOF
 # artifact contract. SRJT_LOCKDEP=1 rides along: the dispatcher's new
 # lock sites feed the merged zero-cycle gate below.
 rm -f artifacts/serve_metrics.jsonl artifacts/bench_serve.jsonl
-timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
   python -m pytest tests/test_serve.py -q
-timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=2 SRJT_RETRY_MAX_DELAY_MS=50 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
   SRJT_RESULTS=artifacts/bench_serve.jsonl \
@@ -221,7 +236,7 @@ EOF
 # its budget. SRJT_LOCKDEP=1 rides along: the quarantine/hedge lock
 # sites feed the merged zero-cycle gate below.
 rm -f artifacts/gray_metrics.jsonl artifacts/bench_gray.jsonl
-timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
   SRJT_RETRY_BASE_DELAY_MS=2 SRJT_RETRY_MAX_DELAY_MS=50 SRJT_RETRY_SEED=99 \
   SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/gray_metrics.jsonl \
   SRJT_RESULTS=artifacts/bench_gray.jsonl \
@@ -257,13 +272,17 @@ print(f"gray tier: {b['completed']} queries at {b['value']} qps "
       "-> artifacts/gray_metrics.jsonl")
 EOF
 
-# lockdep gate (ISSUE 7, layer 2): merge every per-process report the
-# armed tiers above dropped (fast tier + the chaos tiers + the serve
-# and gray tiers, incl. spawned sidecar/exchange workers — the env
-# rides into children) and
-# fail on any lock-order cycle or self-deadlock. The merged graph is
-# archived as artifacts/lockdep_report.json; blocking-while-locked
-# events are reported but advisory (the deadline tier owns that risk).
+# lockdep + race gate (ISSUEs 7 + 11, layer 2): merge every
+# per-process report the armed tiers above dropped (fast tier + the
+# chaos tiers + the serve and gray tiers, incl. spawned
+# sidecar/exchange workers — the env rides into children) and fail on
+# any lock-order cycle, self-deadlock, OR race pair. The fast + serve
+# + gray tiers ran with SRJT_RACE=1, so the merged report must show
+# the detector was armed and found ZERO unordered accesses to the
+# tracked state (tests/test_races.py proves the same gate trips on a
+# seeded race). The merged graph is archived as
+# artifacts/lockdep_report.json; blocking-while-locked events are
+# reported but advisory (the deadline tier owns that risk).
 python -m spark_rapids_jni_tpu.analysis.lockdep \
   --merge artifacts/lockdep --out artifacts/lockdep_report.json
 python - <<'EOF'
@@ -272,8 +291,12 @@ rep = json.load(open("artifacts/lockdep_report.json"))
 assert rep["reports"] > 0, "lockdep armed but no process wrote a report"
 assert not rep["cycles"] and not rep["self_deadlocks"], rep["cycles"]
 assert not rep["site_cycles"], rep["site_cycles"]  # cross-process inversions
+assert rep["race_armed"], "race tiers ran but no report carries race_armed"
+assert not rep["race_pairs"], rep["race_pairs"]  # srjt-race layer 2
+assert rep["race_total"] == 0, rep["race_total"]
 print(f"lockdep: {rep['reports']} reports, {len(rep['locks'])} lock sites, "
-      f"{len(rep['edges'])} edges, 0 cycles -> artifacts/lockdep_report.json")
+      f"{len(rep['edges'])} edges, 0 cycles, 0 races "
+      "-> artifacts/lockdep_report.json")
 EOF
 
 # pool-scaling gate (ISSUE 6 acceptance): arena-resident ops/s at pool

@@ -14,7 +14,10 @@ offsets), so x64 mode is enabled at import, before any tracing happens.
 
 import os as _os
 
-if _os.environ.get("SRJT_LOCKDEP", "").lower() in ("1", "true", "yes"):  # srjt-lint: allow-environ(bootstrap: lockdep must patch threading before ANY package module creates a lock; importing utils.knobs here would import the whole utils tree first)
+if (
+    _os.environ.get("SRJT_LOCKDEP", "").lower() in ("1", "true", "yes")  # srjt-lint: allow-environ(bootstrap: lockdep must patch threading before ANY package module creates a lock; importing utils.knobs here would import the whole utils tree first)
+    or _os.environ.get("SRJT_RACE", "").lower() in ("1", "true", "yes")  # srjt-lint: allow-environ(bootstrap: the race detector rides the lockdep shim and has the same patch-before-any-lock constraint)
+):
     from .analysis import lockdep as _lockdep
 
     _lockdep.install()

@@ -1,21 +1,35 @@
-"""Correctness tooling for the concurrent substrate (ISSUE 7).
+"""Correctness tooling for the concurrent substrate (ISSUEs 7 + 11).
 
-Two layers, both gated in ci/premerge.sh:
+Three layers, all gated in ci/premerge.sh (full reference:
+ANALYSIS.md at the repo root):
 
 - ``lint.py`` — ``srjt-lint``, an AST static pass (stdlib ``ast``, no
-  new deps) enforcing the repo's hand-enforced invariants: the central
-  knob registry (utils/knobs.py), the error-taxonomy raise/except
-  discipline, the metrics/spill hot-path stub pattern, and deadline
-  cooperation for blocking calls. Run as
-  ``python -m spark_rapids_jni_tpu.analysis.lint``.
-- ``lockdep.py`` — opt-in (``SRJT_LOCKDEP=1``) runtime lock-order
-  instrumentation over ``threading.Lock/RLock/Condition``: per-thread
-  acquisition stacks, the global lock-order graph, cycle (potential
-  deadlock) and blocking-while-locked reporting as a JSON artifact at
-  process exit. Merge/gate the per-process reports with
+  new deps) enforcing the repo's hand-enforced conventions
+  (SRJT000-007): the central knob registry (utils/knobs.py, scanned
+  across the package PLUS tests/ and benchmarks/), the error-taxonomy
+  raise/except discipline, the metrics/spill hot-path stub pattern,
+  deadline cooperation for blocking calls, and registry<->doc drift.
+  Run as ``python -m spark_rapids_jni_tpu.analysis.lint``.
+- ``races.py`` — ``srjt-race`` layer 1 (SRJT008-010): static
+  guarded-by inference over the concurrent modules — per class, which
+  ``self._*`` attributes are accessed under ``with self._lock:`` vs
+  bare — flagging mixed-guard access, check-then-act splits, and bare
+  mutable-global mutation. Run as
+  ``python -m spark_rapids_jni_tpu.analysis.races``.
+- ``lockdep.py`` — opt-in runtime instrumentation over ``threading``:
+  ``SRJT_LOCKDEP=1`` records per-thread acquisition stacks, the
+  lock-order graph, cycles, and blocking-while-locked events;
+  ``SRJT_RACE=1`` additionally arms srjt-race layer 2 — per-thread
+  vector clocks advanced on every sync edge (locks, Condition waits,
+  Thread.start/join, Event.set/wait, Semaphore, Barrier) with a
+  ``track(obj)`` registration API over the scheduler/pool/memgov/
+  metrics shared state; unordered access pairs land in the same
+  per-process JSON report. Merge/gate everything with
   ``python -m spark_rapids_jni_tpu.analysis.lockdep``.
 
-This package must stay import-light (stdlib only at import time): the
-package ``__init__`` installs lockdep BEFORE any other module — and so
-before any package lock exists — when the knob is armed.
+Both static CLIs emit ``--format=json|sarif`` with text-mode
+exit-code parity. This package must stay import-light (stdlib only at
+import time): the package ``__init__`` installs lockdep BEFORE any
+other module — and so before any package lock exists — when either
+runtime knob is armed.
 """

@@ -69,12 +69,21 @@ from __future__ import annotations
 
 import argparse
 import ast
+import json
 import os
 import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Violation", "lint_source", "lint_file", "run", "main"]
+__all__ = [
+    "Violation",
+    "lint_source",
+    "lint_file",
+    "run",
+    "main",
+    "format_findings",
+    "write_findings",
+]
 
 _KNOB_RE = re.compile(r"SRJT_[A-Z0-9_]*[A-Z0-9]")
 
@@ -149,7 +158,8 @@ def _suppressions(src: str) -> Dict[int, Tuple[str, str, int]]:
 
 class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, src: str,
-                 knob_names: frozenset, sentinels: frozenset):
+                 knob_names: frozenset, sentinels: frozenset,
+                 knob_rules_only: bool = False):
         self.path = path
         self.rel = rel  # package-relative path ("utils/retry.py")
         self.src = src
@@ -160,11 +170,20 @@ class _FileLinter(ast.NodeVisitor):
         self.violations: List[Violation] = []
         self.is_knobs = rel == "utils/knobs.py"
         self.is_analysis = rel.startswith("analysis/")
+        # tests/ and benchmarks/ ride the KNOB rules only (SRJT001/002):
+        # the package-convention rules (taxonomy raises, stub pattern,
+        # broad excepts) deliberately do not govern test harness code,
+        # and the stale-suppression audit is skipped there too (test
+        # fixtures carry suppression syntax inside string literals,
+        # which the line scanner cannot tell from live comments)
+        self.knob_rules_only = knob_rules_only
         self._func_stack: List[ast.AST] = []
 
     # -- plumbing ------------------------------------------------------------
 
     def _flag(self, node, rule: str, message: str) -> None:
+        if self.knob_rules_only and rule not in ("SRJT001", "SRJT002"):
+            return
         line = getattr(node, "lineno", 1)
         kind = _RULE_SUPPRESSIONS.get(rule)
         sup = self.suppress.get(line)
@@ -183,6 +202,8 @@ class _FileLinter(ast.NodeVisitor):
         # a suppression nothing matched is stale — reasons rot fast.
         # analysis/ is exempt from the staleness audit only: its
         # docstrings carry the syntax examples.
+        if self.knob_rules_only:
+            return
         for line, (kind, reason, comment_line) in self.suppress.items():
             if line != comment_line:
                 continue  # only audit each comment once
@@ -435,7 +456,8 @@ class _FileLinter(ast.NodeVisitor):
 
 def lint_source(src: str, path: str, rel: Optional[str] = None,
                 knob_names: Optional[frozenset] = None,
-                sentinels: Optional[frozenset] = None) -> List[Violation]:
+                sentinels: Optional[frozenset] = None,
+                knob_rules_only: bool = False) -> List[Violation]:
     """Lint one source blob. ``rel`` is its package-relative path (rule
     scoping); tests pass fixture snippets with a synthetic ``rel``."""
     if knob_names is None or sentinels is None:
@@ -447,17 +469,20 @@ def lint_source(src: str, path: str, rel: Optional[str] = None,
     except SyntaxError as e:
         return [Violation(path, e.lineno or 1, "SRJT999",
                           f"syntax error: {e.msg}")]
-    linter = _FileLinter(path, rel, src, knob_names, sentinels)
+    linter = _FileLinter(path, rel, src, knob_names, sentinels,
+                         knob_rules_only=knob_rules_only)
     linter.visit(tree)
     linter.finish()
     return linter.violations
 
 
-def lint_file(path: str, pkg_root: str, knob_names, sentinels):
+def lint_file(path: str, pkg_root: str, knob_names, sentinels,
+              knob_rules_only: bool = False):
     rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
     with open(path, encoding="utf-8") as f:
         src = f.read()
-    return lint_source(src, path, rel, knob_names, sentinels)
+    return lint_source(src, path, rel, knob_names, sentinels,
+                       knob_rules_only=knob_rules_only)
 
 
 def _discover(pkg_root: str) -> List[str]:
@@ -521,18 +546,122 @@ def check_docs(repo_root: str, knob_names: Optional[frozenset] = None,
 
 
 def run(pkg_root: Optional[str] = None,
-        with_docs: bool = True) -> List[Violation]:
+        with_docs: bool = True,
+        with_harness: bool = True) -> List[Violation]:
     if pkg_root is None:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     knob_names, sentinels = _knob_names()
     violations: List[Violation] = []
     for path in _discover(pkg_root):
         violations.extend(lint_file(path, pkg_root, knob_names, sentinels))
+    if with_harness:
+        # ISSUE 11 satellite: tests/ and benchmarks/ honor the knob
+        # registry too (SRJT001/002 only — see _FileLinter) so a test
+        # reading an SRJT env var directly, or inventing an undeclared
+        # knob name, fails the same gate the package does
+        repo_root = os.path.dirname(pkg_root)
+        for sub in ("tests", "benchmarks"):
+            d = os.path.join(repo_root, sub)
+            if not os.path.isdir(d):
+                continue
+            for path in _discover(d):
+                rel = sub + "/" + os.path.relpath(path, d).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                violations.extend(lint_source(
+                    src, path, rel, knob_names, sentinels,
+                    knob_rules_only=True,
+                ))
     if with_docs:
         violations.extend(check_docs(os.path.dirname(pkg_root),
                                      knob_names, sentinels))
     violations.sort(key=lambda v: (v.path, v.line))
     return violations
+
+
+# -- machine-readable findings (ISSUE 11 satellite) ---------------------------
+
+
+def format_findings(violations: List[Violation], fmt: str,
+                    tool: str = "srjt-lint") -> str:
+    """Render findings as ``text`` / ``json`` / ``sarif``. Every format
+    carries the same (path, line, rule, message) tuples; premerge
+    archives the sarif next to the other artifacts."""
+    if fmt == "text":
+        return "\n".join(repr(v) for v in violations)
+    if fmt == "json":
+        return json.dumps({
+            "tool": tool,
+            "findings": [
+                {"path": v.path, "line": v.line, "rule": v.rule,
+                 "message": v.message}
+                for v in violations
+            ],
+        }, indent=1)
+    if fmt == "sarif":
+        # SARIF consumers anchor results by RELATIVE uri: strip the
+        # repo root off the absolute paths run() produces (paths from
+        # elsewhere — tmpdirs, fixtures — pass through unchanged)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))) + os.sep
+
+        def _uri(path: str) -> str:
+            if path.startswith(repo_root):
+                path = path[len(repo_root):]
+            return path.replace(os.sep, "/")
+
+        rules = sorted({v.rule for v in violations})
+        return json.dumps({
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": tool,
+                    "rules": [{"id": r} for r in rules],
+                }},
+                "results": [
+                    {
+                        "ruleId": v.rule,
+                        "level": "error",
+                        "message": {"text": v.message},
+                        "locations": [{
+                            "physicalLocation": {
+                                "artifactLocation": {
+                                    "uri": _uri(v.path),
+                                },
+                                "region": {"startLine": max(v.line, 1)},
+                            },
+                        }],
+                    }
+                    for v in violations
+                ],
+            }],
+        }, indent=1)
+    raise ValueError(f"unknown findings format {fmt!r}")
+
+
+def write_findings(violations: List[Violation], fmt: str,
+                   out: Optional[str], tool: str) -> int:
+    """Emit findings and return the EXIT CODE — identical across every
+    format (the text-mode contract: 1 on any violation, else 0). With
+    ``--out`` the formatted findings land in the file and stdout gets
+    the one-line summary; without it they go to stdout."""
+    body = format_findings(violations, fmt, tool)
+    if out:
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(body + "\n")
+    elif body:
+        print(body)
+    if violations:
+        print(f"{tool}: {len(violations)} violation(s)"
+              + (f" -> {out}" if out else ""), file=sys.stderr)
+        return 1
+    print(f"{tool}: clean" + (f" -> {out}" if out else ""))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -544,22 +673,26 @@ def main(argv=None) -> int:
                     "spark_rapids_jni_tpu directory)")
     ap.add_argument("--no-docs", action="store_true",
                     help="skip the README/PACKAGING knob-table drift check")
+    ap.add_argument("--no-harness", action="store_true",
+                    help="skip the tests/ + benchmarks/ knob-rule scan")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the registry as a markdown table and exit")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"),
+                    help="findings format (exit code is identical in "
+                    "every mode)")
+    ap.add_argument("--out", default=None,
+                    help="also write the formatted findings to this path "
+                    "(stdout then carries the one-line summary)")
     args = ap.parse_args(argv)
     if args.knob_table:
         from ..utils import knobs
 
         print(knobs.markdown_table())
         return 0
-    violations = run(args.root, with_docs=not args.no_docs)
-    for v in violations:
-        print(repr(v))
-    if violations:
-        print(f"srjt-lint: {len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    print("srjt-lint: clean")
-    return 0
+    violations = run(args.root, with_docs=not args.no_docs,
+                     with_harness=not args.no_harness)
+    return write_findings(violations, args.format, args.out, "srjt-lint")
 
 
 if __name__ == "__main__":

@@ -129,7 +129,7 @@ class AdmissionController:
         return int(self._capacity_fn())
 
     def in_use(self) -> int:
-        return self._in_use
+        return self._in_use  # srjt-race: allow-unguarded(single machine-word stats read; GIL-atomic, monitoring only — admission decisions re-read under _cond)
 
     def snapshot(self) -> dict:
         with self._cond:
@@ -143,7 +143,7 @@ class AdmissionController:
                 "max_wait_s": self._max_wait_s,
             }
 
-    def _occupancy(self) -> int:
+    def _occupancy_locked(self) -> int:
         return self._in_use + self._catalog.device_bytes()
 
     def _update_gauges_locked(self) -> None:
@@ -179,7 +179,7 @@ class AdmissionController:
                         or self._active < self._max_concurrent
                     )
                     if at_head and conc_ok:
-                        need = self._occupancy() + nbytes - cap
+                        need = self._occupancy_locked() + nbytes - cap
                         # relieve when there is something to spill (or
                         # once, for the last-resort valve) — a blocked
                         # waiter must not spin the pressure loop on an
@@ -192,7 +192,7 @@ class AdmissionController:
                             from . import pressure
 
                             pressure.relieve(need, self._catalog, name=name)
-                            need = self._occupancy() + nbytes - cap
+                            need = self._occupancy_locked() + nbytes - cap
                         if need <= 0:
                             self._queue.popleft()
                             self._in_use += nbytes
@@ -220,7 +220,7 @@ class AdmissionController:
                             )
                             raise MemoryBudgetExceeded(
                                 f"admission: {name} needs {nbytes} device bytes "
-                                f"(budget {cap}, {self._occupancy()} occupied, "
+                                f"(budget {cap}, {self._occupancy_locked()} occupied, "
                                 f"nothing left to spill or release); split the "
                                 f"batch"
                             )
@@ -291,12 +291,12 @@ class AdmissionController:
             held = 0
             if admission is not None and not admission._released:
                 held = min(admission.nbytes, self._in_use)
-            need = self._occupancy() - held + nbytes - cap
+            need = self._occupancy_locked() - held + nbytes - cap
             if need > 0:
                 from . import pressure
 
                 pressure.relieve(need, self._catalog, name=name)
-                need = self._occupancy() - held + nbytes - cap
+                need = self._occupancy_locked() - held + nbytes - cap
             if need > 0:
                 reg.counter("memgov.rejected").inc()
                 metrics.event(
@@ -306,7 +306,7 @@ class AdmissionController:
                 raise MemoryBudgetExceeded(
                     f"{name}: escalated footprint {nbytes} bytes cannot fit "
                     f"the device budget ({cap} bytes, "
-                    f"{self._occupancy()} occupied); split the batch"
+                    f"{self._occupancy_locked()} occupied); split the batch"
                 )
             if admission is not None and not admission._released and \
                     nbytes > admission.nbytes:

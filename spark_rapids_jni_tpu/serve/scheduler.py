@@ -254,7 +254,14 @@ class Scheduler:
             if retry_after_s is None else retry_after_s
         )
         self._cond = threading.Condition(threading.Lock())
-        self._tenants: Dict[str, _Tenant] = {}
+        # srjt-race layer 2: the tenant-lane table is tracked — every
+        # key/iteration access is checked for happens-before ordering
+        # when SRJT_RACE=1 (a plain dict otherwise, zero cost)
+        from ..analysis.lockdep import track as _race_track
+
+        self._tenants: Dict[str, _Tenant] = _race_track(
+            {}, f"serve.{self.name}.tenants"
+        )
         self._queued = 0  # entries in S_QUEUED across all tenant deques
         self._running = 0
         self._inflight: set = set()

@@ -531,6 +531,20 @@ class SidecarPool:
         from .utils import metrics as _metrics
 
         self._ewma = _metrics.KeyedEwma(alpha=0.3, max_keys=512)
+        # srjt-race layer 2 (ISSUE 11): the health/quarantine state is
+        # dynamically tracked when SRJT_RACE=1 — per-worker records
+        # (alive/quarantined/strikes/clean_probes writes), the scorer's
+        # EWMA map, and the hedge-budget counter all feed the
+        # vector-clock detector; disarmed, track() is one boolean read
+        from .analysis.lockdep import track as _race_track
+
+        self._ewma._entries = _race_track(
+            self._ewma._entries, "pool.ewma_entries"
+        )
+        _race_track(
+            self._reg().counter("sidecar.pool.hedges_launched"),
+            "pool.hedge_budget",
+        )
         # hedge-budget reservations are check-AND-increment under one
         # lock: two dispatch slots racing the same last budget slot
         # must not both launch (the premerge gate on hedge volume is a
@@ -541,7 +555,9 @@ class SidecarPool:
         # are leased per request, so the only pool-wide arena state is
         # the allocator
         self._slab: Optional[ArenaSlab] = None
-        self._workers = [_Worker(i) for i in range(self.size)]
+        self._workers = [
+            _race_track(_Worker(i), f"pool.w{i}") for i in range(self.size)
+        ]
         try:
             for w in self._workers:
                 self._spawn_locked(w)
@@ -756,8 +772,13 @@ class SidecarPool:
             except OSError:
                 pass
         for attempt in range(self._respawn_max):
-            if self._closed or w.alive:
-                return
+            # liveness check under the pool lock (srjt-race SRJT008): a
+            # shutdown() racing this read must either be seen here or
+            # see this respawner's subsequent spawn via the in-lock
+            # re-checks below — a torn bare read could do neither
+            with self._lock:
+                if self._closed or w.alive:
+                    return
             try:
                 proc, sock = self._spawn_fn(
                     startup_timeout_s=self._startup_timeout_s,
@@ -1569,7 +1590,21 @@ class SidecarPool:
         c = w.client
         if c._sock is None:
             c.connect()
-        slab = self._slab
+        # the slab reference is read under the pool lock (srjt-race
+        # SRJT008: a concurrent set_arena()/_close_slab() nulls the
+        # attribute) — the upload itself stays OUTSIDE the lock, and a
+        # replace cannot munmap the pages mid-send because set_arena
+        # refuses while regions are leased and re-uploads every live
+        # worker itself afterwards
+        with self._lock:
+            slab = self._slab
+        if slab is None:
+            from .utils.errors import RetryableError
+
+            raise RetryableError(
+                f"sidecar pool: UNAVAILABLE: arena slab torn down while "
+                f"re-hydrating w{w.wid} (set_arena/shutdown in flight)"
+            )
         hdr = struct.pack("<IQ", OP_SET_ARENA, 16) + struct.pack(
             "<QQ", slab.size, ARENA_MODE_SLAB
         )

@@ -98,11 +98,14 @@ class CancelToken:
                 self._reason = str(reason)
 
     def cancelled(self) -> bool:
-        return self._flag
+        # this poll sits on every cancel point of every hot path: a
+        # bool read is GIL-atomic, monotonic False->True, and a racing
+        # reader that misses the flip just polls again one layer down
+        return self._flag  # srjt-race: allow-unguarded(lock-free cancel-point poll; GIL-atomic monotonic flag, next poll sees the flip)
 
     @property
     def reason(self) -> Optional[str]:
-        return self._reason
+        return self._reason  # srjt-race: allow-unguarded(written once under _lock before _flag flips; only read after cancelled() observed True)
 
 
 class Deadline:
@@ -368,8 +371,7 @@ class CircuitBreaker:
 
         return metrics.registry().gauge(f"{self.name}.state")
 
-    def _transition(self, new_state: str, cause: str) -> None:
-        """Caller holds self._lock."""
+    def _transition_locked(self, new_state: str, cause: str) -> None:
         from . import metrics
 
         self._state = new_state
@@ -436,7 +438,7 @@ class CircuitBreaker:
             if self._state == STATE_CLOSED:
                 return True
             if self._state == STATE_OPEN and self._clock() >= self._open_until:
-                self._transition(STATE_HALF_OPEN, cause="cooldown_elapsed")
+                self._transition_locked(STATE_HALF_OPEN, cause="cooldown_elapsed")
                 self._probe_in_flight = True
                 return True
             if self._state == STATE_HALF_OPEN and not self._probe_in_flight:
@@ -453,7 +455,7 @@ class CircuitBreaker:
             self._failures = 0
             self._probe_in_flight = False
             if self._state != STATE_CLOSED:
-                self._transition(STATE_CLOSED, cause="probe_success")
+                self._transition_locked(STATE_CLOSED, cause="probe_success")
 
     def abort_probe(self) -> None:
         """Release the half-open probe slot with NO health verdict (the
@@ -474,7 +476,7 @@ class CircuitBreaker:
             ):
                 self._last_trip_cause = cause
                 self._open_until = self._clock() + self._cooldown_s
-                self._transition(STATE_OPEN, cause=cause)
+                self._transition_locked(STATE_OPEN, cause=cause)
             elif self._state == STATE_OPEN:
                 # stragglers failing while open keep the cooldown fresh
                 self._open_until = self._clock() + self._cooldown_s

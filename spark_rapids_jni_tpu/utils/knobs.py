@@ -459,3 +459,9 @@ declare("SRJT_LOCKDEP_DIR", "str", "artifacts/lockdep",
         "directory lockdep writes its per-process JSON reports into "
         "(merged/gated by python -m "
         "spark_rapids_jni_tpu.analysis.lockdep)")
+declare("SRJT_RACE", "bool", False,
+        "arm the dynamic race detector (srjt-race layer 2, rides the "
+        "lockdep shim): per-thread vector clocks over lock/Event/"
+        "Thread/Semaphore/Barrier edges; unordered accesses to tracked "
+        "state land as race_pairs in the lockdep report and fail the "
+        "merge gate")

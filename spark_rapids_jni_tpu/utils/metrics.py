@@ -102,14 +102,14 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._value
+        return self._value  # srjt-race: allow-unguarded(single machine-word stats read; GIL-atomic — a reader sees a valid pre- or post-increment value, never a tear)
 
     def _reset(self) -> None:
         with self._lock:
             self._value = 0
 
     def _snapshot(self):
-        return self._value
+        return self._value  # same GIL-atomic word read as .value (annotated there)
 
 
 class Gauge:
@@ -131,14 +131,14 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        return self._value  # srjt-race: allow-unguarded(last-write-wins scalar; a reference read is GIL-atomic and any concurrent set is a valid value)
 
     def _reset(self) -> None:
         with self._lock:
             self._value = 0
 
     def _snapshot(self):
-        return self._value
+        return self._value  # same GIL-atomic reference read as .value (annotated there)
 
 
 class Histogram:
@@ -178,7 +178,7 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        return self._count  # srjt-race: allow-unguarded(single machine-word warm-up check; GIL-atomic, and quantile() re-reads under _lock)
 
     def quantile(self, q: float):
         """Approximate quantile read off the log2 buckets (ISSUE 9):
@@ -343,16 +343,28 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
+        # srjt-race layer 2: the registry map is tracked when
+        # SRJT_RACE=1 — every metric lookup/registration is a checked
+        # access (a plain dict otherwise; analysis/lockdep is
+        # import-light stdlib, safe this early in the import order)
+        from ..analysis.lockdep import track as _race_track
+
+        self._metrics: Dict[str, object] = _race_track(
+            {}, "metrics.registry"
+        )
 
     def _get(self, name: str, cls):
-        m = self._metrics.get(name)
-        if m is None:
-            with self._lock:
-                m = self._metrics.get(name)
-                if m is None:
-                    m = cls()
-                    self._metrics[name] = m
+        # the whole get-or-create runs under the lock (srjt-race
+        # SRJT008): the old lock-free first probe was the textbook
+        # benign-until-it-isn't double-checked read — the dynamic
+        # detector flags it, and hot call sites cache their metric
+        # handles anyway (record_op), so the lock costs one uncontended
+        # acquire per registry lookup
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
         if not isinstance(m, cls):
             raise TypeError(
                 f"metric {name!r} already registered as {type(m).__name__}, "
@@ -369,10 +381,18 @@ class Registry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def peek(self, name: str):
+        """The live metric object for ``name``, or None — WITHOUT
+        creating it (stats assembly and the adaptive-timeout reader
+        must never mint histograms as a side effect). The map read is
+        locked; the returned object carries its own lock."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def value(self, name: str, default=0):
         """Scalar read with a default — snapshot assembly for counters
         that may never have been touched."""
-        m = self._metrics.get(name)
+        m = self.peek(name)
         if m is None:
             return default
         if isinstance(m, Histogram):
@@ -604,7 +624,7 @@ def adaptive_timeout_s(hist_name: str, static_s: float):
     product behavior and must work with SRJT_METRICS_ENABLED off."""
     if not knobs.get_bool("SRJT_ADAPTIVE_TIMEOUT_ENABLED"):
         return static_s, False
-    h = _REGISTRY._metrics.get(hist_name)
+    h = _REGISTRY.peek(hist_name)
     if not isinstance(h, Histogram):
         return static_s, False
     if h.count < knobs.get_int("SRJT_ADAPTIVE_TIMEOUT_MIN_SAMPLES"):

@@ -7,7 +7,7 @@ the same suite against real devices.
 
 import os
 
-if os.environ.get("SRJT_TEST_TPU", "0") != "1":
+if os.environ.get("SRJT_TEST_TPU", "0") != "1":  # srjt-lint: allow-environ(bootstrap: JAX_PLATFORMS must be set BEFORE any package import, and importing utils/knobs imports the package which imports jax)
     # jax is preloaded at interpreter startup in this image with
     # JAX_PLATFORMS=axon, so the env var alone is too late — update the
     # live config before any backend initializes.

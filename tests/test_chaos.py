@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.columnar import dtype as dt
-from spark_rapids_jni_tpu.utils import errors, faultinj, retry
+from spark_rapids_jni_tpu.utils import errors, faultinj, knobs, retry
 
 # the premerge storm profile: retryable faults at 30% on every pipeline
 # stage, an injected-latency fault on the all-to-all, `after`/`ramp`
@@ -122,9 +122,9 @@ def test_chaos_parity_retryable_storm(mesh8):
     retry.reset_stats()
 
     faultinj.configure_from_file(
-        os.environ.get("SRJT_FAULTINJ_CONFIG") or _STORM_PATH
+        knobs.get_str("SRJT_FAULTINJ_CONFIG") or _STORM_PATH
     )
-    if os.environ.get("SRJT_RETRY_ENABLED", "").lower() in ("1", "true", "yes"):
+    if knobs.get_bool("SRJT_RETRY_ENABLED"):
         # premerge path: honor the operator's SRJT_RETRY_* env knobs
         # (ci/premerge.sh sets attempts/delays for the gate)
         arm = retry.enabled()

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from spark_rapids_jni_tpu import sidecar
 from spark_rapids_jni_tpu.columnar import Column, Table
 from spark_rapids_jni_tpu.columnar import dtype as dt
-from spark_rapids_jni_tpu.utils import deadline, faultinj, metrics, retry
+from spark_rapids_jni_tpu.utils import deadline, faultinj, knobs, metrics, retry
 from spark_rapids_jni_tpu.utils.deadline import CancelToken, CircuitBreaker, Deadline
 from spark_rapids_jni_tpu.utils.dispatch import op_boundary
 from spark_rapids_jni_tpu.utils.errors import DeadlineExceeded, RetryableError
@@ -743,7 +743,7 @@ class TestChaosHangStorm:
         SRJT_DEADLINE_SEC / SRJT_RETRY_*) like the storm tier does."""
         from spark_rapids_jni_tpu.ops.aggregate import groupby_aggregate
 
-        budget = float(os.environ.get("SRJT_DEADLINE_SEC") or 1.5)
+        budget = knobs.get_float("SRJT_DEADLINE_SEC", default=1.5)
         rng = np.random.default_rng(7)
         n = 512
         t = Table(
@@ -763,10 +763,10 @@ class TestChaosHangStorm:
         expect = np.asarray(query().column("v_sum").data).tobytes()  # warm jit
 
         faultinj.configure_from_file(
-            os.environ.get("SRJT_FAULTINJ_CONFIG") or _HANG_PATH
+            knobs.get_str("SRJT_FAULTINJ_CONFIG") or _HANG_PATH
         )
         deadline.set_default_budget(budget)
-        if os.environ.get("SRJT_RETRY_ENABLED", "").lower() in ("1", "true", "yes"):
+        if knobs.get_bool("SRJT_RETRY_ENABLED"):
             arm = retry.enabled()  # premerge path: operator env knobs win
         else:
             arm = retry.enabled(max_attempts=10, base_delay_ms=1,

@@ -1,0 +1,659 @@
+"""Tests for srjt-race (ISSUE 11): the static guarded-by inference
+pass (SRJT008/009/010) and the dynamic vector-clock race detector.
+
+- static rule fixtures: each rule FIRES on a seeded snippet and stays
+  quiet on the guarded/suppressed/immutable variants; the suppression
+  grammar (guarded-by / allow-unguarded) and its SRJT000 stale audit
+  are part of the tool's contract.
+- dynamic detector: a deliberately seeded unguarded write is REPORTED
+  (with both stacks) under a thread storm — the gate-can-fail proof —
+  while lock/Event/Thread.start-join/Semaphore/Barrier-ordered access
+  is clean; the merge CLI fails on any race_pairs, same discipline as
+  lockdep cycles.
+- the integration gates: the REAL tree is statically clean, and the
+  machine-readable formats carry exit-code parity with text mode.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.analysis import lint, lockdep, races
+
+# ---------------------------------------------------------------------------
+# static layer: fixtures
+# ---------------------------------------------------------------------------
+
+
+def scan(src, rel="serve/x.py", rules=None):
+    vs = races.scan_source(src, path=f"<fixture:{rel}>", rel=rel)
+    if rules is None:
+        return vs
+    return [v for v in vs if v.rule in rules]
+
+
+MIXED = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count
+"""
+
+
+def test_mixed_guarded_bare_access_fires():
+    vs = scan(MIXED, rules={"SRJT008"})
+    assert len(vs) == 1 and "C._count" in vs[0].message
+    assert "guarded-by" in vs[0].message  # the fix-or-annotate hint
+
+
+def test_fully_guarded_is_clean():
+    src = MIXED.replace(
+        "    def peek(self):\n        return self._count\n",
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self._count\n",
+    )
+    assert scan(src) == []
+
+
+def test_locked_suffix_method_counts_as_guarded():
+    src = MIXED.replace("def peek(self):", "def peek_locked(self):")
+    assert scan(src) == []
+
+
+def test_init_only_writes_do_not_fire():
+    # immutable-after-__init__ shape: guarded + bare READS are fine
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._workers = [1, 2, 3]
+
+    def pick(self):
+        with self._lock:
+            return self._workers[0]
+
+    def count(self):
+        return len(self._workers)
+"""
+    assert scan(src) == []
+
+
+def test_condition_alias_guards_the_same_state():
+    # holding the Condition built OVER the lock == holding the lock
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._health = threading.Condition(self._lock)
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        with self._health:
+            self._n += 1
+"""
+    assert scan(src) == []
+
+
+def test_own_condition_is_its_own_guard():
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._q = []
+
+    def put(self, x):
+        with self._cond:
+            self._q.append(x)
+
+    def depth(self):
+        return len(self._q)
+"""
+    vs = scan(src, rules={"SRJT008"})
+    assert len(vs) == 1 and "C._q" in vs[0].message
+
+
+def test_nested_def_counts_as_bare_but_lambda_is_in_place():
+    # a thread-target closure defined under the lock RUNS without it
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m = {}
+
+    def go(self):
+        with self._lock:
+            self._m["x"] = 1
+            def later():
+                self._m["x"] = 2
+            threading.Thread(target=later).start()
+"""
+    vs = scan(src, rules={"SRJT008"})
+    assert len(vs) == 1
+    # ...but a sort-key lambda executes in place, under the lock
+    src2 = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m = {}
+
+    def evict(self):
+        with self._lock:
+            self._m.pop(min(self._m, key=lambda k: self._m[k]))
+"""
+    assert scan(src2) == []
+
+
+def test_suppression_on_init_assignment_covers_the_attribute():
+    src = MIXED.replace(
+        "        self._count = 0",
+        "        self._count = 0  "
+        "# srjt-race: allow-unguarded(GIL-atomic word)",
+    )
+    assert scan(src) == []
+
+
+def test_guarded_by_suppression_on_bare_line():
+    src = MIXED.replace(
+        "        return self._count",
+        "        return self._count  # srjt-race: guarded-by(_lock)",
+    )
+    assert scan(src) == []
+
+
+def test_empty_suppression_arg_is_srjt000():
+    src = MIXED.replace(
+        "        return self._count",
+        "        return self._count  # srjt-race: allow-unguarded()",
+    )
+    vs = scan(src)
+    assert [v.rule for v in vs] == ["SRJT000"]
+    assert "needs a" in vs[0].message
+
+
+def test_stale_suppression_is_srjt000():
+    src = "x = 1  # srjt-race: guarded-by(_lock)\n"
+    vs = scan(src)
+    assert [v.rule for v in vs] == ["SRJT000"]
+    assert "stale" in vs[0].message
+
+
+# -- SRJT009: check-then-act -------------------------------------------------
+
+CHECK_THEN_ACT = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slab = None
+
+    def get(self):
+        if self._slab is None:
+            with self._lock:
+                self._slab = object()
+        return self._slab
+"""
+
+
+def test_check_then_act_fires():
+    vs = scan(CHECK_THEN_ACT, rules={"SRJT009"})
+    assert len(vs) == 1 and "check-then-act" in vs[0].message
+    assert "C._slab" in vs[0].message
+
+
+def test_check_under_a_different_lock_still_fires():
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._slab = None
+
+    def get(self):
+        with self._aux:
+            if self._slab is None:
+                pass
+        with self._lock:
+            self._slab = object()
+"""
+    vs = scan(src, rules={"SRJT009"})
+    assert len(vs) == 1
+
+
+def test_check_under_its_own_lock_is_clean():
+    src = CHECK_THEN_ACT.replace(
+        "        if self._slab is None:\n"
+        "            with self._lock:\n"
+        "                self._slab = object()\n"
+        "        return self._slab\n",
+        "        with self._lock:\n"
+        "            if self._slab is None:\n"
+        "                self._slab = object()\n"
+        "            return self._slab\n",
+    )
+    assert scan(src, rules={"SRJT009"}) == []
+
+
+def test_read_only_function_is_not_check_then_act():
+    # a branch on a guarded attr in a function that never WRITES it is
+    # a stale read at worst, not a lost update — SRJT009 stays quiet
+    # (SRJT008 governs the mixed-access posture)
+    src = CHECK_THEN_ACT.replace(
+        "            with self._lock:\n"
+        "                self._slab = object()\n",
+        "            pass\n",
+    )
+    assert scan(src, rules={"SRJT009"}) == []
+
+
+def test_check_then_act_suppressible():
+    src = CHECK_THEN_ACT.replace(
+        "        if self._slab is None:",
+        "        if self._slab is None:  "
+        "# srjt-race: allow-unguarded(idempotent lazy init; double build is benign)",
+    )
+    assert scan(src, rules={"SRJT009"}) == []
+
+
+# -- SRJT010: bare module-global mutation ------------------------------------
+
+GLOBAL_MUT = """\
+import threading
+
+_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def put(k, v):
+    _CACHE[k] = v
+"""
+
+
+def test_bare_global_mutation_fires():
+    vs = scan(GLOBAL_MUT, rules={"SRJT010"})
+    assert len(vs) == 1 and "_CACHE" in vs[0].message
+
+
+def test_global_mutation_under_lock_is_clean():
+    src = GLOBAL_MUT.replace(
+        "    _CACHE[k] = v",
+        "    with _CACHE_LOCK:\n        _CACHE[k] = v",
+    )
+    assert scan(src, rules={"SRJT010"}) == []
+
+
+def test_mutator_method_on_global_fires():
+    src = "_SEEN = set()\n\n\ndef note(x):\n    _SEEN.add(x)\n"
+    vs = scan(src, rules={"SRJT010"})
+    assert len(vs) == 1
+
+
+def test_local_shadowing_global_name_is_clean():
+    src = "_CACHE = {}\n\n\ndef f():\n    _CACHE = {}\n    _CACHE['x'] = 1\n"
+    assert scan(src, rules={"SRJT010"}) == []
+
+
+def test_global_mutation_suppressible():
+    src = GLOBAL_MUT.replace(
+        "    _CACHE[k] = v",
+        "    _CACHE[k] = v  "
+        "# srjt-race: allow-unguarded(import-time only; single-threaded by construction)",
+    )
+    assert scan(src, rules={"SRJT010"}) == []
+
+
+# -- scoping + integration gates ---------------------------------------------
+
+
+def test_ungoverned_module_is_not_scanned():
+    vs = races.scan_source(MIXED, path="<f>", rel="ops/x.py")
+    # rel scoping happens in run(); scan_source itself scans anything —
+    # prove run()'s governed filter instead
+    assert races._governed("serve/scheduler.py")
+    assert races._governed("sidecar_pool.py")
+    assert races._governed("utils/metrics.py")
+    assert not races._governed("ops/join.py")
+    assert not races._governed("models/tpcds.py")
+    assert vs  # the snippet itself still carries its finding
+
+
+def test_real_tree_is_clean():
+    vs = races.run()
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+def test_races_cli_exit_codes(tmp_path, capsys):
+    assert races.main([]) == 0
+    capsys.readouterr()
+    out = tmp_path / "r.sarif"
+    assert races.main(["--format=sarif", f"--out={out}"]) == 0
+    capsys.readouterr()
+    sarif = json.loads(out.read_text())
+    assert sarif["version"] == "2.1.0"
+
+
+# -- machine-readable formats (shared with srjt-lint) ------------------------
+
+
+def test_format_findings_json_and_sarif_shapes():
+    vs = [lint.Violation("a.py", 3, "SRJT008", "msg one"),
+          lint.Violation("b.py", 7, "SRJT010", "msg two")]
+    j = json.loads(lint.format_findings(vs, "json", tool="t"))
+    assert j["tool"] == "t" and len(j["findings"]) == 2
+    assert j["findings"][0] == {"path": "a.py", "line": 3,
+                                "rule": "SRJT008", "message": "msg one"}
+    s = json.loads(lint.format_findings(vs, "sarif", tool="t"))
+    results = s["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["SRJT008", "SRJT010"]
+    assert results[0]["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 3
+
+
+@pytest.mark.parametrize("fmt", ["text", "json", "sarif"])
+def test_exit_code_parity_across_formats(fmt, tmp_path, capsys):
+    vs = [lint.Violation("a.py", 1, "SRJT008", "m")]
+    rc_dirty = lint.write_findings(vs, fmt, str(tmp_path / f"d.{fmt}"), "t")
+    rc_clean = lint.write_findings([], fmt, str(tmp_path / f"c.{fmt}"), "t")
+    capsys.readouterr()
+    assert rc_dirty == 1 and rc_clean == 0
+
+
+def test_lint_cli_format_flag(tmp_path, capsys):
+    out = tmp_path / "lint.sarif"
+    assert lint.main(["--format=sarif", f"--out={out}"]) == 0
+    capsys.readouterr()
+    assert json.loads(out.read_text())["runs"][0]["tool"]["driver"][
+        "name"] == "srjt-lint"
+
+
+# ---------------------------------------------------------------------------
+# dynamic layer: the vector-clock detector
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def armed_races():
+    """Arm shim + detector for one test in an isolated universe —
+    seeded races must never reach the session report the CI gate
+    merges (the lockdep isolated_state discipline)."""
+    was_installed = lockdep.is_installed()
+    was_armed = lockdep.race_armed()
+    lockdep.enable_race_detection()
+    with lockdep.isolated_state() as st:
+        yield st
+    if not was_armed:
+        lockdep.disable_race_detection()
+    if not was_installed:
+        lockdep.uninstall()
+
+
+def _run_threads(*fns):
+    ts = [threading.Thread(target=f) for f in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+    assert not any(t.is_alive() for t in ts)
+
+
+def test_seeded_unguarded_write_is_reported_with_both_stacks(armed_races):
+    d = lockdep.track({}, "seeded")
+
+    def w1():
+        for i in range(100):
+            d["x"] = i
+
+    _run_threads(w1, w1)
+    rep = lockdep.report(armed_races)
+    assert rep["race_total"] > 0 and rep["race_pairs"]
+    pair = rep["race_pairs"][0]
+    assert "seeded" in pair["location"]
+    assert pair["a"]["stack"] and pair["b"]["stack"]  # both access stacks
+    assert pair["a"]["thread"] != pair["b"]["thread"]
+
+
+def test_lock_ordered_access_is_clean(armed_races):
+    d = lockdep.track({}, "locked")
+    mu = threading.Lock()
+
+    def w():
+        for i in range(100):
+            with mu:
+                d["x"] = i
+
+    _run_threads(w, w)
+    assert lockdep.report(armed_races)["race_total"] == 0
+
+
+def test_event_set_wait_orders_accesses(armed_races):
+    d = lockdep.track({}, "ev")
+    ev = threading.Event()
+    got = []
+
+    def writer():
+        d["k"] = 42
+        ev.set()
+
+    def reader():
+        assert ev.wait(10)
+        got.append(d.get("k"))
+
+    _run_threads(reader, writer)
+    assert got == [42]
+    assert lockdep.report(armed_races)["race_total"] == 0
+
+
+def test_thread_start_join_edges_order_accesses(armed_races):
+    d = lockdep.track({}, "tj")
+    d["a"] = 1  # parent write before start
+
+    def child():
+        d["a"] = d["a"] + 1  # ordered by the start edge
+
+    t = threading.Thread(target=child)
+    t.start()
+    t.join(10)
+    assert d["a"] == 2  # parent read after join: ordered by the join edge
+    assert lockdep.report(armed_races)["race_total"] == 0
+
+
+def test_semaphore_release_acquire_orders_accesses(armed_races):
+    d = lockdep.track({}, "sem")
+    sem = threading.Semaphore(0)
+    got = []
+
+    def producer():
+        d["p"] = 7
+        sem.release()
+
+    def consumer():
+        assert sem.acquire(timeout=10)
+        got.append(d.get("p"))
+
+    _run_threads(consumer, producer)
+    assert got == [7]
+    assert lockdep.report(armed_races)["race_total"] == 0
+
+
+def test_barrier_cycle_orders_accesses(armed_races):
+    d = lockdep.track({}, "bar")
+    b = threading.Barrier(2, timeout=10)
+    got = []
+
+    def phase_writer():
+        d["x"] = 9
+        b.wait()
+
+    def phase_reader():
+        b.wait()
+        got.append(d.get("x"))
+
+    _run_threads(phase_reader, phase_writer)
+    assert got == [9]
+    assert lockdep.report(armed_races)["race_total"] == 0
+
+
+def test_tracked_object_setattr_write_write_race(armed_races):
+    class Slot:
+        __slots__ = ("alive", "strikes")
+
+        def __init__(self):
+            self.alive = True
+            self.strikes = 0
+
+    s = lockdep.track(Slot(), "slot")
+
+    def bump():
+        for i in range(100):
+            s.strikes = i
+
+    _run_threads(bump, bump)
+    rep = lockdep.report(armed_races)
+    assert rep["race_total"] > 0
+    assert any("strikes" in p["location"] for p in rep["race_pairs"])
+
+
+def test_track_disarmed_returns_original_object():
+    was = lockdep.race_armed()
+    lockdep.disable_race_detection()
+    try:
+        d = {}
+        assert lockdep.track(d, "noop") is d
+        assert type(d) is dict
+    finally:
+        if was:
+            lockdep.enable_race_detection()
+
+
+def test_unordered_write_read_is_reported(armed_races):
+    d = lockdep.track({}, "wr")
+    hold = threading.Event()  # start gate only — orders nothing after
+
+    def writer():
+        hold.wait(10)
+        for _ in range(50):
+            d["k"] = 1
+            time.sleep(0)
+
+    def reader():
+        hold.wait(10)
+        for _ in range(50):
+            d.get("k")
+            time.sleep(0)
+
+    ts = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in ts:
+        t.start()
+    hold.set()
+    for t in ts:
+        t.join(20)
+    rep = lockdep.report(armed_races)
+    assert rep["race_total"] > 0
+
+
+def test_seeded_race_under_chaos_storm(armed_races):
+    """The acceptance shape: a storm of correctly-locked workers plus
+    ONE deliberately unguarded writer — the detector must isolate the
+    seeded location and stay quiet on the disciplined one."""
+    good = lockdep.track({}, "disciplined")
+    bad = lockdep.track({}, "seeded_bare")
+    mu = threading.Lock()
+
+    def disciplined(n):
+        def run():
+            for i in range(50):
+                with mu:
+                    good[f"k{n}"] = i
+                    good.get(f"k{(n + 1) % 4}")
+        return run
+
+    def rogue():
+        for i in range(50):
+            bad["x"] = i
+            time.sleep(0)
+
+    _run_threads(disciplined(0), disciplined(1), disciplined(2),
+                 disciplined(3), rogue, rogue)
+    rep = lockdep.report(armed_races)
+    assert rep["race_total"] > 0
+    assert all("seeded_bare" in p["location"] for p in rep["race_pairs"])
+
+
+def test_report_shape_and_merge_gate_can_fail(tmp_path, armed_races, capsys):
+    d = lockdep.track({}, "gate")
+
+    def w():
+        for i in range(100):
+            d["x"] = i
+
+    _run_threads(w, w)
+    rep = lockdep.report(armed_races)
+    assert rep["race_armed"] is True
+    assert rep["tracked_objects"] >= 1
+    assert rep["race_total"] >= len(rep["race_pairs"]) >= 1
+    # the per-process report with races must FAIL the merge gate —
+    # proving ci/premerge.sh's race_pairs == [] assertion can trip
+    (tmp_path / "lockdep_races.json").write_text(json.dumps(rep))
+    out = str(tmp_path / "merged.json")
+    rc = lockdep.main(["--merge", str(tmp_path), "--out", out])
+    capsys.readouterr()
+    assert rc == 1
+    merged = json.loads(open(out).read())
+    assert merged["race_pairs"] and merged["race_total"] == rep["race_total"]
+    # scrubbed of races the same dir gates green
+    clean = {k: ([] if k in ("race_pairs",) else v)
+             for k, v in rep.items()}
+    clean["race_total"] = 0
+    (tmp_path / "lockdep_races.json").write_text(json.dumps(clean))
+    assert lockdep.main(["--merge", str(tmp_path), "--out", out]) == 0
+    capsys.readouterr()
+
+
+def test_keyed_ewma_concurrent_update_during_eviction_is_race_free(
+        armed_races):
+    """ISSUE 11 satellite: KeyedEwma's LRU eviction races its updates
+    by construction (new keys evict the oldest while other threads
+    fold samples) — the internal lock must make that invisible, and
+    the tracked-map detector proves it."""
+    from spark_rapids_jni_tpu.utils.metrics import KeyedEwma
+
+    e = KeyedEwma(alpha=0.5, max_keys=8)
+    e._entries = lockdep.track(e._entries, "ewma_entries")
+
+    def churner(base):
+        def run():
+            for i in range(200):
+                e.update(f"{base}.{i % 16}", float(i))
+                e.get(f"{base}.{(i + 3) % 16}")
+        return run
+
+    _run_threads(churner("a"), churner("b"), churner("c"))
+    assert len(e) <= 8  # the LRU bound held under churn
+    assert lockdep.report(armed_races)["race_total"] == 0

@@ -196,6 +196,29 @@ class TestHistogramQuantile:
             h.record(0)
         assert h.quantile(0.5) == 0
 
+    def test_single_bucket_mass(self):
+        # ISSUE 11 satellite: every sample in ONE log2 bucket ([16,32))
+        # — interpolation must stay inside the bucket AND inside the
+        # recorded min/max for every q, including the exact edges
+        h = metrics.Histogram()
+        for v in (17, 19, 23, 29, 31) * 40:
+            h.record(v)
+        for q in (0.0, 0.01, 0.25, 0.5, 0.75, 0.99, 1.0):
+            est = h.quantile(q)
+            assert 17 <= est <= 31, (q, est)
+        assert h.quantile(0.0) == 17
+        assert h.quantile(1.0) == 31
+
+    def test_q0_q1_exact_bounds(self):
+        # q=0 is the recorded min and q=1 the recorded max, never an
+        # interpolated bucket edge — the clamp contract callers of
+        # p0/p100 rely on
+        h = metrics.Histogram()
+        for v in (5, 100, 3000, 70000):
+            h.record(v)
+        assert h.quantile(0.0) == 5
+        assert h.quantile(1.0) == 70000
+
 
 class TestKeyedEwma:
     def test_update_and_jitter(self):
@@ -221,6 +244,49 @@ class TestKeyedEwma:
             metrics.KeyedEwma(alpha=0.0)
         with pytest.raises(ValueError):
             metrics.KeyedEwma(max_keys=0)
+
+    def test_concurrent_update_during_lru_eviction(self):
+        # ISSUE 11 satellite: updates that force LRU evictions while
+        # other threads read/snapshot the same map — the bound must
+        # hold, nothing may raise, and every surviving entry must be a
+        # coherent [ewma, jitter, count, seq] record. The
+        # race-detector-armed variant (tracked map, vector clocks)
+        # lives in tests/test_races.py.
+        import threading
+
+        e = metrics.KeyedEwma(alpha=0.4, max_keys=8)
+        stop = threading.Event()
+        errors = []
+
+        def churn(base):
+            try:
+                for i in range(400):
+                    e.update(f"{base}.{i % 16}", float(i))
+            except Exception as exc:  # pragma: no cover - failure path
+                errors.append(exc)
+
+        def read():
+            try:
+                while not stop.is_set():
+                    e.get("a.0")
+                    e.jitter("b.1")
+                    snap = e.snapshot()
+                    for rec in snap.values():
+                        assert set(rec) == {"ewma", "jitter", "count"}
+            except Exception as exc:  # pragma: no cover - failure path
+                errors.append(exc)
+
+        ts = [threading.Thread(target=churn, args=(b,)) for b in "abc"]
+        r = threading.Thread(target=read)
+        r.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        stop.set()
+        r.join(20)
+        assert not errors
+        assert len(e) <= 8
 
 
 # ---------------------------------------------------------------------------
