@@ -110,22 +110,34 @@ def bench_cpu_ref() -> float:
 
 
 def main():
-    from spark_rapids_jni_tpu.utils import metrics, retry
+    from spark_rapids_jni_tpu.utils import metrics, retry, trace_sink, tracing
 
     emit_metrics = metrics.is_enabled()
     stage_snaps = []
+    trace_snaps = []
 
     def staged(name, fn):
         """Run one bench stage with an attributable metrics window:
         registry + retry stats reset at entry, stage report captured at
-        exit (timed through the op metrics namespace)."""
-        if not emit_metrics:
+        exit (timed through the op metrics namespace). With srjt-trace
+        armed too (ISSUE 12), a per-stage trace summary — span count,
+        max tree depth, p99 span duration — is captured from the same
+        reset registry window, so a BENCH latency regression can be
+        correlated with the span that grew. The trace summary rides
+        the TRACING gate alone (its counters are registry-direct), so
+        SRJT_TRACE_ENABLED=1 without SRJT_METRICS_ENABLED still emits
+        it."""
+        emit_trace = tracing.is_enabled()
+        if not emit_metrics and not emit_trace:
             return fn()
         metrics.reset()
         retry.reset_stats()
         with metrics.timer(f"bench.{name}"):
             out = fn()
-        stage_snaps.append(metrics.stage_report(name))
+        if emit_metrics:
+            stage_snaps.append(metrics.stage_report(name))
+        if emit_trace:
+            trace_snaps.append({"stage": name, **trace_sink.stage_summary()})
         return out
 
     t_dev, per_iters, t_short, t_long = staged("device_groupby", bench_device)
@@ -162,9 +174,12 @@ def main():
     )
     # per-stage metrics snapshots ride NEXT TO the BENCH row, one JSON
     # line each, so the harness that archives the row archives the
-    # runtime counters with it
+    # runtime counters with it; armed tracing adds one {"trace": ...}
+    # summary line per stage beside them
     for snap in stage_snaps:
         print(json.dumps({"metrics": snap}))
+    for snap in trace_snaps:
+        print(json.dumps({"trace": snap}))
 
 
 if __name__ == "__main__":

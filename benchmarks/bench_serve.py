@@ -56,7 +56,7 @@ import numpy as np
 
 from spark_rapids_jni_tpu import serve
 from spark_rapids_jni_tpu.models import tpcds, tpch
-from spark_rapids_jni_tpu.utils import faultinj, knobs, metrics, retry
+from spark_rapids_jni_tpu.utils import faultinj, knobs, metrics, retry, tracing
 from spark_rapids_jni_tpu.utils.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -314,6 +314,15 @@ def run_bench(args) -> int:
     _emit(row)
     if metrics.is_enabled():
         _emit({"metrics": metrics.stage_report("serve_bench")})
+    if tracing.is_enabled():
+        # per-stage trace summary (ISSUE 12): span volume, max tree
+        # depth, and p99 span duration next to the metrics line, so a
+        # p99 latency regression in the BENCH row can be correlated
+        # with the span that grew
+        from spark_rapids_jni_tpu.utils import trace_sink
+
+        _emit({"trace": {"stage": "serve_bench",
+                         **trace_sink.stage_summary()}})
 
     rc = 0
     if wl.wrong:

@@ -272,6 +272,83 @@ print(f"gray tier: {b['completed']} queries at {b['value']} qps "
       "-> artifacts/gray_metrics.jsonl")
 EOF
 
+# trace tier (ISSUE 12): the distributed-tracing suite env-armed —
+# including the real-pool acceptance, which runs a crash-profile storm
+# (per-worker delay ramp + kill -9 mid-STATS) through a REAL pool of 2
+# with SRJT_TRACE_ENABLED=1 and per-process span logs. The merge gate
+# is artifact-based, not test-self-certified: tracemerge joins the
+# client's and both workers' logs and must show a trace containing the
+# FAILOVER (two pool.request attempts on distinct workers under one
+# pool.call span), the HEDGED sibling pair with the winner marked
+# exactly once, a cross-process worker span, and ZERO orphan spans
+# (every span's parent resolves within its trace — --gate-orphans
+# exits 1 otherwise). The Perfetto-loadable export is archived too.
+rm -f artifacts/trace_spans*.jsonl artifacts/trace_merged.json \
+  artifacts/trace_perfetto.json
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 \
+  SRJT_TRACE_ENABLED=1 SRJT_TRACE_LOG=artifacts/trace_spans.jsonl \
+  SRJT_METRICS_ENABLED=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  python -m pytest tests/test_tracing.py -q
+python -m spark_rapids_jni_tpu.analysis.tracemerge \
+  "artifacts/trace_spans*.jsonl" --format json \
+  --out artifacts/trace_merged.json --gate-orphans
+python -m spark_rapids_jni_tpu.analysis.tracemerge \
+  "artifacts/trace_spans*.jsonl" --format chrome \
+  --out artifacts/trace_perfetto.json
+python - <<'EOF'
+import json
+rep = json.load(open("artifacts/trace_merged.json"))
+assert rep["traces"], "trace tier archived no merged traces"
+assert rep["orphans"] == 0, f"{rep['orphans']} orphan spans"
+failover = hedged = chain = False
+for t in rep["traces"].values():
+    spans = t["spans"]
+    by_id = {s["span"]: s for s in spans}
+    for call in (s for s in spans if s["name"] == "pool.call"):
+        kids = [s for s in spans
+                if s.get("parent") == call["span"]
+                and s["name"] == "pool.request"]
+        if len(kids) >= 2 and len({
+            (s.get("annotations") or {}).get("wid") for s in kids
+        }) >= 2:
+            failover = True
+    legs = [s for s in spans if s["name"] == "pool.hedge_leg"]
+    by_parent = {}
+    for s in legs:
+        by_parent.setdefault(s["parent"], []).append(s)
+    for pair in by_parent.values():
+        if len(pair) == 2 and sum(
+            1 for s in pair if (s.get("annotations") or {}).get("winner")
+        ) == 1:
+            hedged = True
+    # the acceptance chain: a CROSS-PROCESS worker span whose ancestor
+    # walk reaches submit -> queue -> admission -> op -> wire -> worker
+    client_pids = {s["pid"] for s in spans if s["name"] == "serve.query"}
+    for w in (s for s in spans if s["name"] == "sidecar.worker_op"
+              and client_pids and s["pid"] not in client_pids):
+        names, cur = set(), w
+        while cur.get("parent") in by_id:
+            cur = by_id[cur["parent"]]
+            names.add(cur["name"])
+        if {"sidecar.request", "pool.call", "serve.run",
+                "serve.query"} <= names and any(
+            n.startswith("op.") for n in names
+        ) and {"serve.queue_wait", "memgov.admission_wait"} <= {
+            s["name"] for s in spans
+        }:
+            chain = True
+doc = json.load(open("artifacts/trace_perfetto.json"))
+assert doc["traceEvents"], "empty Perfetto export"
+assert failover, "merged traces show no failover (two attempts, two workers)"
+assert hedged, "merged traces show no hedged sibling pair with one winner"
+assert chain, ("no cross-process query tree spans submit -> queue -> "
+               "admission -> op -> wire -> worker")
+print(f"trace tier: {len(rep['traces'])} traces, 0 orphans, "
+      f"failover+hedge spans and the cross-process submit->worker "
+      "chain present -> artifacts/trace_merged.json / trace_perfetto.json")
+EOF
+
 # lockdep + race gate (ISSUEs 7 + 11, layer 2): merge every
 # per-process report the armed tiers above dropped (fast tier + the
 # chaos tiers + the serve and gray tiers, incl. spawned

@@ -100,10 +100,11 @@ _RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "serve/", "sidecar.py",
                    "sidecar_pool.py")
 _BLOCKING_GOVERNED = ("sidecar.py", "sidecar_pool.py", "parallel/",
                       "memgov/", "serve/", "utils/retry.py",
-                      "utils/faultinj.py")
+                      "utils/faultinj.py", "utils/tracing.py",
+                      "utils/trace_sink.py")
 _STUB_MODULES = ("utils/metrics.py", "utils/tracing.py",
                  "utils/integrity.py", "utils/faultinj.py",
-                 "memgov/__init__.py")
+                 "memgov/__init__.py", "utils/trace_sink.py")
 
 # identifiers marking the enabled-gate (SRJT005) ...
 _GATE_NAMES = {"_enabled", "is_enabled", "enabled", "is_armed"}

@@ -83,6 +83,11 @@ RACE_GOVERNED = (
     "parallel/shuffle.py",
     "utils/metrics.py",
     "utils/deadline.py",
+    # ISSUE 12: the srjt-trace span layer — TraceContext's span buffer
+    # and the sink's recorder/log state are cross-thread (hedge legs,
+    # slot threads) and carry their own locks worth proving
+    "utils/tracing.py",
+    "utils/trace_sink.py",
 )
 
 _SUPPRESS_RE = re.compile(

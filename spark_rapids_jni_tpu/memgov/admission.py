@@ -158,7 +158,21 @@ class AdmissionController:
         """Block until ``nbytes`` fits (FIFO order), spilling catalog
         entries under pressure. Raises ``DeadlineExceeded`` when the
         active query budget dies first, ``MemoryBudgetExceeded`` when
-        the demand is hopeless or outwaits the admission bound."""
+        the demand is hopeless or outwaits the admission bound.
+
+        srjt-trace (ISSUE 12): the whole acquire — queue wait, pressure
+        spills, and the admit/reject verdict — is one
+        ``memgov.admission_wait`` span when a traced query is active,
+        so a query stuck behind the byte semaphore shows the wait as a
+        span, not as unexplained time inside its op."""
+        from ..utils import tracing
+
+        with tracing.span(
+            "memgov.admission_wait", op=name, nbytes=int(nbytes)
+        ):
+            return self._acquire(int(nbytes), name)
+
+    def _acquire(self, nbytes: int, name: str) -> Admission:
         from ..utils import deadline as deadline_mod
         from ..utils import metrics
 

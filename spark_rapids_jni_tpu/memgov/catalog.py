@@ -333,12 +333,17 @@ class BufferCatalog:
         """device -> host. Raises RetryableError when the chaos
         ``spill_fail`` rule fires (caller skips the entry); afterwards
         enforces the host budget by demoting LRU host entries to disk."""
-        from ..utils import faultinj, metrics
+        from ..utils import faultinj, metrics, tracing
 
         reg = _registry()
         t0 = time.perf_counter()
-        faultinj.maybe_inject("memgov.spill")
-        h._host = [np.asarray(x) for x in h._device]
+        # srjt-trace (ISSUE 12): a traced query that pays for a spill
+        # (its own pressure, or a neighbor's data) sees the demotion as
+        # a span — like metrics.event below, the record is written
+        # under the catalog lock the spill itself already holds
+        with tracing.span("memgov.spill", key=h.key, nbytes=h.nbytes):
+            faultinj.maybe_inject("memgov.spill")
+            h._host = [np.asarray(x) for x in h._device]
         h._device = None
         if h.spill_count:
             reg.counter("memgov.respilled").inc()
@@ -539,12 +544,20 @@ class BufferCatalog:
             self._seq += 1
             h._seq = self._seq  # LRU refresh
             if h._device is None:
-                t0 = time.perf_counter()
-                if h._disk_path is not None:
-                    self._load_disk_locked(h)
-                import jax.numpy as jnp
+                from ..utils import tracing
 
-                h._device = [jnp.asarray(x) for x in h._host]
+                t0 = time.perf_counter()
+                # srjt-trace (ISSUE 12): re-materialization is the
+                # other half of the spill cost a traced query pays
+                with tracing.span(
+                    "memgov.rematerialize", key=h.key, nbytes=h.nbytes,
+                    tier=h.tier,
+                ):
+                    if h._disk_path is not None:
+                        self._load_disk_locked(h)
+                    import jax.numpy as jnp
+
+                    h._device = [jnp.asarray(x) for x in h._host]
                 h._host = None
                 reg.counter("memgov.rematerialized").inc()
                 reg.counter("memgov.rematerialized_bytes").inc(h.nbytes)

@@ -397,6 +397,12 @@ _EXC_MAGIC = b"SRJTEXC1"
 _EXC_REQ = struct.Struct("<8sIII")  # magic, verb, epoch, part
 _EXC_RESP = struct.Struct("<IQ")  # status, payload length
 _EXC_GET = 1
+# srjt-trace (ISSUE 12): GET whose request carries a 17-byte trace
+# context (utils/tracing.wire_context) right after the header — the
+# serving peer's span parents to the fetcher's span across the process
+# boundary. Negotiated per request: untraced peers keep verb 1
+# byte-for-byte.
+_EXC_GET_TRACED = 3
 _EXC_OK = 0
 _EXC_RETRY = 1  # partition not (yet) published here: retryable
 _EXC_ERR = 2
@@ -524,7 +530,7 @@ class TcpExchange:
             ).start()
 
     def _serve_conn(self, conn) -> None:
-        from ..utils import faultinj, metrics
+        from ..utils import tracing
 
         try:
             conn.settimeout(self.deadline_s)
@@ -539,40 +545,74 @@ class TcpExchange:
                 except (OSError, socket_mod.timeout):
                     return
                 magic, verb, epoch, part = _EXC_REQ.unpack(hdr)
-                if magic != _EXC_MAGIC or verb != _EXC_GET:
+                if magic != _EXC_MAGIC or verb not in (
+                    _EXC_GET, _EXC_GET_TRACED,
+                ):
                     conn.sendall(_EXC_RESP.pack(_EXC_ERR, 0))
                     return
-                # chaos choke point: `crash` kills the serving process
-                # mid-request (the peer sees a dead transport and
-                # retries), `delay` models a slow peer
-                if faultinj.is_enabled():
-                    faultinj.maybe_inject("exchange.serve")
-                with self._published:
-                    end = time.monotonic() + self.publish_wait_s
-                    blob = self._frames.get((epoch, part))
-                    while blob is None and not self._closed:
-                        left = end - time.monotonic()
-                        if left <= 0:
-                            break
-                        self._published.wait(left)
-                        blob = self._frames.get((epoch, part))
-                if blob is None:
-                    conn.sendall(
-                        _EXC_RESP.pack(_EXC_RETRY, 0)
-                    )
-                    continue
-                wire = blob
-                if faultinj.is_enabled():
-                    # flips bytes AFTER the frame (and its CRCs) was
-                    # encoded — the fetcher's decode MUST catch it
-                    wire = faultinj.maybe_corrupt("exchange.frame", blob)
-                conn.sendall(_EXC_RESP.pack(_EXC_OK, len(wire)) + wire)
-                metrics.counter("shuffle.tcp.bytes_out").inc(len(wire))
+                # srjt-trace (ISSUE 12): a traced GET carries the
+                # 17-byte context right after the header — read it
+                # unconditionally so the stream stays framed even when
+                # tracing is disarmed on this side
+                tctx = None
+                if verb == _EXC_GET_TRACED:
+                    try:
+                        tb = b""
+                        while len(tb) < tracing.TRACE_CTX_LEN:
+                            chunk = conn.recv(tracing.TRACE_CTX_LEN - len(tb))
+                            if not chunk:
+                                return
+                            tb += chunk
+                    except (OSError, socket_mod.timeout):
+                        return
+                    tctx = tracing.decode_wire_context(tb)
+                if tctx is not None and tracing.is_enabled():
+                    # the serving peer's half of the cross-process
+                    # trace: the wait-for-publish and the frame send
+                    # parent to the fetcher's span, logged HERE
+                    with tracing.remote_scope(*tctx):
+                        with tracing.span(
+                            "exchange.serve", epoch=int(epoch),
+                            part=int(part), rank=self.rank,
+                        ):
+                            self._answer_get(conn, epoch, part)
+                else:
+                    self._answer_get(conn, epoch, part)
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _answer_get(self, conn, epoch: int, part: int) -> None:
+        """Answer one GET: wait (bounded) for the partition to publish,
+        then send it — or a retryable not-yet-published status."""
+        from ..utils import faultinj, metrics
+
+        # chaos choke point: `crash` kills the serving process
+        # mid-request (the peer sees a dead transport and
+        # retries), `delay` models a slow peer
+        if faultinj.is_enabled():
+            faultinj.maybe_inject("exchange.serve")
+        with self._published:
+            end = time.monotonic() + self.publish_wait_s
+            blob = self._frames.get((epoch, part))
+            while blob is None and not self._closed:
+                left = end - time.monotonic()
+                if left <= 0:
+                    break
+                self._published.wait(left)
+                blob = self._frames.get((epoch, part))
+        if blob is None:
+            conn.sendall(_EXC_RESP.pack(_EXC_RETRY, 0))
+            return
+        wire = blob
+        if faultinj.is_enabled():
+            # flips bytes AFTER the frame (and its CRCs) was
+            # encoded — the fetcher's decode MUST catch it
+            wire = faultinj.maybe_corrupt("exchange.frame", blob)
+        conn.sendall(_EXC_RESP.pack(_EXC_OK, len(wire)) + wire)
+        metrics.counter("shuffle.tcp.bytes_out").inc(len(wire))
 
     def publish(self, epoch: int, partitions: Dict[int, "Table"]) -> None:
         """Encode and expose this rank's outgoing partitions for
@@ -643,9 +683,19 @@ class TcpExchange:
         s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
         try:
             s.settimeout(budget_s)
+            # srjt-trace (ISSUE 12): a sampled active context rides the
+            # request as the traced GET verb + 17-byte blob, so the
+            # peer's serve span parents to this fetch across processes
+            from ..utils import tracing
+
+            tblob = tracing.wire_context()
+            verb = _EXC_GET if tblob is None else _EXC_GET_TRACED
             try:
                 s.connect((host, port))
-                s.sendall(_EXC_REQ.pack(_EXC_MAGIC, _EXC_GET, epoch, part))
+                s.sendall(
+                    _EXC_REQ.pack(_EXC_MAGIC, verb, epoch, part)
+                    + (tblob or b"")
+                )
                 status, blen = _EXC_RESP.unpack(
                     _recv_exact_tcp(s, _EXC_RESP.size, deadline)
                 )
@@ -696,7 +746,19 @@ class TcpExchange:
         """Pull one partition from ``addr`` under retry + breaker +
         deadline. Corruption and transport faults retry; exhaustion
         records a breaker failure and re-raises retryably (the caller's
-        supervisor may respawn the peer and call again)."""
+        supervisor may respawn the peer and call again).
+
+        srjt-trace (ISSUE 12): one ``exchange.fetch`` span per fetch
+        covers every retry attempt; each attempt propagates the
+        context to the serving peer (``_fetch_once``)."""
+        from ..utils import tracing
+
+        with tracing.span(
+            "exchange.fetch", peer=addr, epoch=int(epoch), part=int(part)
+        ):
+            return self._fetch_impl(addr, epoch, part)
+
+    def _fetch_impl(self, addr: str, epoch: int, part: int) -> "Table":
         from ..utils import metrics, retry
         from ..utils.errors import DeadlineExceeded, RetryableError
 

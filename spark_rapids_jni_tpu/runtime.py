@@ -801,11 +801,15 @@ def stats_report(pretty: bool = False):
     the one-command artifact VERDICT items 5/7/8 ask for."""
     from . import memgov, serve, sidecar, sidecar_pool
     from .utils import deadline as deadline_mod
-    from .utils import integrity, memory, metrics, retry
+    from .utils import integrity, memory, metrics, retry, trace_sink
 
     native = device_stats(fold=True)
     report = {
         "metrics": metrics.snapshot(),
+        # ISSUE 12: srjt-trace — span/trace volume, sampling, and the
+        # flight recorder's ring state (the worst recent query itself
+        # renders via runtime.explain_last())
+        "trace": trace_sink.stats_section(),
         "retry": retry.stats(),
         "memory": {"split_retries": memory.split_retry_count()},
         "memgov": memgov.stats_section(),
@@ -824,6 +828,19 @@ def stats_report(pretty: bool = False):
     if pretty:
         return metrics.render_report(report)
     return report
+
+
+def explain_last():
+    """Render the WORST recent traced query (failures and sheds first,
+    then duration) as an annotated span tree — the flight recorder's
+    one-command answer to "why was THAT query slow" (ISSUE 12). Returns
+    None when tracing never recorded a query in this process. The
+    rendering is this process's view; cross-process spans (sidecar
+    workers, exchange peers) live in the per-process span logs, joined
+    by ``python -m spark_rapids_jni_tpu.analysis.tracemerge``."""
+    from .utils import trace_sink
+
+    return trace_sink.explain_last()
 
 
 def device_groupby_sum(keys, vals, num_keys: int, deadline_s: Optional[float] = None):

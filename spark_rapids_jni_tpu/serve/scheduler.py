@@ -65,7 +65,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ..utils import deadline as deadline_mod
-from ..utils import faultinj, knobs, metrics
+from ..utils import faultinj, knobs, metrics, tracing
 from ..utils.deadline import CancelToken
 from ..utils.errors import DeadlineExceeded, Overloaded
 
@@ -92,6 +92,25 @@ S_EXPIRED = "expired"
 
 _FINAL = (S_DONE, S_FAILED, S_CANCELLED, S_SHED, S_EXPIRED)
 
+# handle state -> srjt-trace root status (the flight recorder flushes
+# every non-"ok" trace, so shed/failed/expired/cancelled queries from a
+# storm are all captured with their span trees)
+_TRACE_STATUS = {
+    S_DONE: "ok",
+    S_FAILED: "failed",
+    S_CANCELLED: "cancelled",
+    S_SHED: "shed",
+    S_EXPIRED: "expired",
+}
+
+
+def _shed_trace(qt, cause: str) -> None:
+    """Finish a (possibly None) root trace as shed — the recorder's
+    capture of an admission-rejected query."""
+    if qt is not None:
+        qt.annotate(shed_cause=cause)
+        qt.finish("shed")
+
 SHED_CAUSES = ("queue_full", "pressure", "doa_deadline", "breaker",
                "quarantine", "shutting_down", "injected")
 
@@ -110,7 +129,7 @@ class QueryHandle:
         "_scheduler", "_fn", "_args", "_kwargs", "tenant", "priority",
         "query_id", "_memory_bytes", "host_eligible", "_token", "_done",
         "_state", "_result", "_exc", "_t_submit", "_t_deadline",
-        "_t_dispatch", "_budget_s",
+        "_t_dispatch", "_budget_s", "_trace",
     )
 
     def __init__(self, scheduler, fn, args, kwargs, tenant, priority,
@@ -134,6 +153,7 @@ class QueryHandle:
         self._budget_s = budget_s
         self._t_deadline = None if budget_s is None else t_submit + budget_s
         self._t_dispatch: Optional[float] = None
+        self._trace = None  # srjt-trace root (tracing.QueryTrace), or None
 
     # -- the public surface --------------------------------------------------
 
@@ -384,12 +404,20 @@ class Scheduler:
                 f"got {type(fn).__name__}"
             )
         tenant = str(tenant)
+        # srjt-trace (ISSUE 12): the root trace opens AT SUBMIT so the
+        # queue wait is inside the query's span tree, and so every shed
+        # — even a pre-admission one — reaches the flight recorder with
+        # its cause. One boolean read (None back) when tracing is off.
+        qt = tracing.start_trace(
+            "serve.query", tenant=tenant, priority=int(priority)
+        )
         # deterministic shed chaos: the `reject` kind keyed serve.admit
         try:
             faultinj.maybe_inject("serve.admit")
         except Overloaded:
             self._count_shed("injected")
             self._shed_event(tenant, "injected")
+            _shed_trace(qt, "injected")
             raise
         # breaker- AND quarantine-aware routing (ISSUE 9): a dark pool
         # sheds only the work that CANNOT run on the host engine, and a
@@ -403,6 +431,7 @@ class Scheduler:
             if sidecar.breaker().state() != "closed":
                 self._count_shed("breaker")
                 self._shed_event(tenant, "breaker")
+                _shed_trace(qt, "breaker")
                 raise self._overloaded(
                     "sidecar pool dark (breaker open) and query is not "
                     "host-engine-eligible", "breaker",
@@ -412,6 +441,7 @@ class Scheduler:
                     and pool.routable_count() == 0):
                 self._count_shed("quarantine")
                 self._shed_event(tenant, "quarantine")
+                _shed_trace(qt, "quarantine")
                 raise self._overloaded(
                     "every live pool worker is quarantined (gray "
                     "failure) and query is not host-engine-eligible",
@@ -431,6 +461,7 @@ class Scheduler:
         if (eff is not None and eff <= 0) or (outer is not None and outer.done()):
             self._count_shed("doa_deadline")
             self._shed_event(tenant, "doa_deadline")
+            _shed_trace(qt, "doa_deadline")
             raise self._overloaded(
                 f"query dead on arrival (budget "
                 f"{'cancelled' if outer is not None and outer.cancelled() else 'exhausted'} "
@@ -486,6 +517,15 @@ class Scheduler:
                         else:
                             victim_cause = cause
                 if shed_exc is None:
+                    if qt is not None:
+                        # attach the trace BEFORE the handle becomes
+                        # visible to a dispatcher: the notify below can
+                        # wake a slot that runs the query immediately,
+                        # and a late-published _trace would leave the
+                        # root unfinished (annotate is dict writes —
+                        # in-lock-safe; trace I/O stays outside)
+                        qt.annotate(query=q.query_id, budget_s=eff)
+                        q._trace = qt
                     t.q.append(q)
                     t.submitted += 1
                     self._queued += 1
@@ -495,12 +535,15 @@ class Scheduler:
                     self._cond.notify()
         # event I/O (one file write per line) strictly OUTSIDE the
         # dispatch lock — a shed storm must not serialize admission and
-        # dispatch behind the event log
+        # dispatch behind the event log; trace finishing (span-log
+        # writes, the flight-recorder flush) follows the same rule
         if victim is not None:
             self._shed_event(victim.tenant, victim_cause)
+            _shed_trace(victim._trace, victim_cause)
             victim._done.set()
         if shed_exc is not None:
             self._shed_event(tenant, shed_exc.cause)
+            _shed_trace(qt, shed_exc.cause)
             raise shed_exc
         metrics.event(
             "serve.submit", query=q.query_id, tenant=tenant,
@@ -658,6 +701,10 @@ class Scheduler:
             where=where, reason=reason,
         )
         if where == "queued":
+            qt = q._trace
+            if qt is not None:
+                qt.annotate(cancel_reason=reason)
+                qt.finish("cancelled")
             q._done.set()
         return True
 
@@ -697,6 +744,9 @@ class Scheduler:
                     "serve.expired_in_queue", query=e.query_id,
                     tenant=e.tenant, budget_s=e._budget_s,
                 )
+                if e._trace is not None:
+                    e._trace.annotate(expired_in_queue=True)
+                    e._trace.finish("expired")
                 e._done.set()
             if q is None:
                 if exiting:
@@ -758,6 +808,32 @@ class Scheduler:
             return q
 
     def _run(self, q: QueryHandle) -> None:
+        # srjt-trace (ISSUE 12): the slot thread installs the query's
+        # trace context for the fn's whole dynamic extent (op spans,
+        # memgov admission waits, pool requests, wire hops all nest
+        # under it), records the queue wait as a closed span, and
+        # finishes the root from the handle's final state AFTER the run
+        # span closed — so the in-memory tree explain_last() renders is
+        # complete before the recorder sees it.
+        qt = q._trace
+        if qt is None:
+            self._run_inner(q)
+            return
+        with qt.activate():
+            tracing.closed_span(
+                "serve.queue_wait",
+                max(q._t_dispatch - q._t_submit, 0.0),
+                tenant=q.tenant,
+            )
+            try:
+                with tracing.span(
+                    "serve.run", query=q.query_id, tenant=q.tenant
+                ):
+                    self._run_inner(q)
+            finally:
+                qt.finish(_TRACE_STATUS.get(q._state, q._state))
+
+    def _run_inner(self, q: QueryHandle) -> None:
         from .. import memgov
 
         if metrics.is_enabled():
@@ -830,6 +906,7 @@ class Scheduler:
             self._cond.notify_all()
         for q in shed_queued:  # event I/O + wakeups outside the lock
             self._shed_event(q.tenant, "shutting_down")
+            _shed_trace(q._trace, "shutting_down")
             q._done.set()
         t_end = None if timeout_s is None else time.monotonic() + timeout_s
         for w in self._workers:

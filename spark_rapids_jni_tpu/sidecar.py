@@ -166,7 +166,17 @@ def op_name(op: int) -> str:
 
 ARENA_FLAG = 0x80000000  # high bit of op/status: payload at arena[0:len]
 CRC_FLAG = 0x40000000  # op/status bit: a u32 CRC trailer follows the header
-_FLAG_MASK = ARENA_FLAG | CRC_FLAG
+# srjt-trace (ISSUE 12): op bit negotiated per request exactly like
+# CRC_FLAG — when set, a fixed 17-byte trace-context blob (trace id,
+# parent span id, flags; utils/tracing.wire_context) rides the socket
+# right after the CRC trailer (or the header when CRC is off), BEFORE
+# the payload/region descriptor. The worker installs the context for
+# the request's dynamic extent so its spans parent to the caller's
+# span in its own per-process span log. The native C++ client never
+# sets it, so the legacy walker stays byte-for-byte; responses never
+# carry it.
+TRACE_FLAG = 0x20000000
+_FLAG_MASK = ARENA_FLAG | CRC_FLAG | TRACE_FLAG
 
 # slab-arena data plane (ISSUE 6): a SET_ARENA payload of >= 16 bytes
 # carries a u64 mode word after the size; mode bit 0 marks the arena a
@@ -510,7 +520,7 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
     import mmap
 
     from . import memgov
-    from .utils import faultinj, integrity, metrics
+    from .utils import faultinj, integrity, metrics, tracing
     from .utils.errors import DataCorruption
 
     reg = metrics.registry()  # worker-side counters: always-on
@@ -590,6 +600,17 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
             # early-out so the stream stays framed
             req_crc = (
                 integrity.unpack_crc(_recv_exact(conn, 4, fds)) if with_crc else None
+            )
+            # srjt-trace (ISSUE 12): the trace-context blob follows the
+            # trailer, before the payload/descriptor — read it
+            # unconditionally when flagged so the stream stays framed
+            # even if tracing is disarmed on this side
+            tctx = (
+                tracing.decode_wire_context(
+                    _recv_exact(conn, tracing.TRACE_CTX_LEN, fds)
+                )
+                if wire_op & TRACE_FLAG
+                else None
             )
             region = None  # (offset, capacity) of a slab-mode region request
             if in_arena and arena_mode == ARENA_MODE_SLAB:
@@ -719,7 +740,19 @@ def _handle_conn(conn: socket.socket, backend: str, shutdown) -> None:
                 # COUNTERS above — disarmed, no clock is touched
                 timed = metrics.is_enabled()
                 t0 = time.perf_counter() if timed else 0.0
-                resp = _dispatch(op, payload, backend)
+                if tctx is not None and tracing.is_enabled():
+                    # the worker's half of the cross-process trace: one
+                    # span per dispatched op, parented (via the wire
+                    # context) to the client's request span, streamed
+                    # to THIS process's span log for tracemerge to join
+                    with tracing.remote_scope(*tctx):
+                        with tracing.span(
+                            "sidecar.worker_op", op=op_name(op),
+                            backend=backend,
+                        ):
+                            resp = _dispatch(op, payload, backend)
+                else:
+                    resp = _dispatch(op, payload, backend)
                 if timed:
                     reg.histogram(f"sidecar.worker.op_us.{op_name(op)}").record(
                         (time.perf_counter() - t0) * 1e6
@@ -978,10 +1011,22 @@ class SupervisedClient:
         trailer = (
             integrity.pack_crc(integrity.checksum(body)) if use_crc else b""
         )
+        # srjt-trace (ISSUE 12): the active sampled context rides the
+        # SAME sendall under the TRACE flag bit (negotiated per request
+        # exactly like CRC_FLAG — one boolean read when tracing is off,
+        # frame byte-identical); the worker's spans then parent to this
+        # request's span across the process boundary
+        from .utils import tracing
+
+        tblob = tracing.wire_context()
+        if tblob is not None:
+            wire_op |= TRACE_FLAG
+        else:
+            tblob = b""
         try:
             self._sock.settimeout(budget_s)
             self._sock.sendall(
-                struct.pack("<IQ", wire_op, plen) + trailer + payload
+                struct.pack("<IQ", wire_op, plen) + trailer + tblob + payload
             )
             hdr = self._recv_deadline(12, deadline)
             status, rlen = struct.unpack("<IQ", hdr)
@@ -1059,7 +1104,20 @@ class SupervisedClient:
         ``arena_len`` routes the request through the legacy
         single-buffer data plane and ``region`` through a leased slab
         region (see ``_raw_request``) — both under the SAME deadline
-        clamp, CRC protocol, and taxonomy as a stream frame."""
+        clamp, CRC protocol, and taxonomy as a stream frame.
+
+        srjt-trace (ISSUE 12): one ``sidecar.request`` span per
+        exchange (heartbeat + redial included) when a traced query is
+        active — this span is what the worker's cross-process span
+        parents to, since ``_raw_request`` packs the CURRENT span id
+        into the wire context."""
+        from .utils import tracing
+
+        with tracing.span("sidecar.request", op=op_name(op)):
+            return self._request(op, payload, arena_len, region)
+
+    def _request(self, op: int, payload: bytes, arena_len: int = None,
+                 region=None) -> bytes:
         from .utils import metrics
         from .utils.errors import (
             DataCorruption,

@@ -1077,7 +1077,30 @@ class SidecarPool:
         the io_lock is acquired — the scorer judges the worker's
         SERVICE time, not time spent queued behind a peer caller on
         the same slot (contended routing must never quarantine a
-        healthy worker)."""
+        healthy worker).
+
+        srjt-trace (ISSUE 12): each attempt is one ``pool.request``
+        span annotated with the ROUTING DECISION — worker id and its
+        quarantine state at pick time — so a failover reads as two
+        sibling attempts under the same ``pool.call`` span, the second
+        on a different worker."""
+        from .utils import tracing
+
+        with tracing.span(
+            "pool.request", op=op_name(op), wid=w.wid,
+            quarantined=w.quarantined,
+        ):
+            return self._attempt_on_impl(w, op, payload, region,
+                                           region_req)
+
+    def _attempt_on_impl(
+        self,
+        w: _Worker,
+        op: int,
+        payload: bytes,
+        region: Optional[ArenaRegion],
+        region_req: Optional[bytes] = None,
+    ):
         from .utils.errors import DataCorruption, RetryableError
 
         t0 = time.monotonic()
@@ -1261,21 +1284,36 @@ class SidecarPool:
         outcome = {"winner": None, "errors": {}, "legs": 1, "completed": 0}
 
         def leg(w, leg_region, is_hedge):
-            try:
-                r = self._attempt_on(w, op, payload, leg_region, region_req)
-            except BaseException as e:  # srjt-lint: allow-broad-except(race leg: the error is stored for the settling thread to re-raise with full taxonomy; escaping would kill the leg thread and strand the race)
+            # srjt-trace (ISSUE 12): each raced leg is its own span —
+            # the two legs are SIBLINGS under the caller's pool.call
+            # span (contextvars.copy_context carries the trace into the
+            # leg threads), and the winner is annotated EXACTLY ONCE,
+            # under the same race lock that settles the winner slot,
+            # while its span is still open
+            from .utils import tracing
+
+            with tracing.span(
+                "pool.hedge_leg", op=op_name(op), wid=w.wid,
+                leg="hedge" if is_hedge else "primary",
+            ) as leg_span:
+                try:
+                    r = self._attempt_on(w, op, payload, leg_region,
+                                         region_req)
+                except BaseException as e:  # srjt-lint: allow-broad-except(race leg: the error is stored for the settling thread to re-raise with full taxonomy; escaping would kill the leg thread and strand the race)
+                    leg_span.annotate(error=type(e).__name__)
+                    with st_lock:
+                        outcome["errors"][is_hedge] = e
+                        outcome["completed"] += 1
+                        if (outcome["completed"] >= outcome["legs"]
+                                and outcome["winner"] is None):
+                            done.set()
+                    return
                 with st_lock:
-                    outcome["errors"][is_hedge] = e
                     outcome["completed"] += 1
-                    if (outcome["completed"] >= outcome["legs"]
-                            and outcome["winner"] is None):
-                        done.set()
-                return
-            with st_lock:
-                outcome["completed"] += 1
-                if outcome["winner"] is None:
-                    outcome["winner"] = (r, is_hedge)
-                done.set()
+                    if outcome["winner"] is None:
+                        outcome["winner"] = (r, is_hedge)
+                        leg_span.annotate(winner=True)
+                    done.set()
 
         def primary_leg():
             try:
@@ -1417,7 +1455,20 @@ class SidecarPool:
         returned bytes, as ``call_arena`` does.) Within one call the
         pool snapshots the request up front and replays it (fresh
         generation) before every retry attempt — a dead worker's
-        partial response can never be what the failover re-sends."""
+        partial response can never be what the failover re-sends.
+
+        srjt-trace (ISSUE 12): one ``pool.call`` span covers the whole
+        call — every routed attempt (``pool.request``), hedge legs
+        (``pool.hedge_leg`` siblings), and a degrade to the host engine
+        (annotated ``host_fallback``) — so "the failover retry is a
+        child of the original op span" holds by construction."""
+        from .utils import tracing
+
+        with tracing.span("pool.call", op=op_name(op)):
+            return self._call_impl(op, payload, region)
+
+    def _call_impl(self, op: int, payload: bytes,
+                     region: Optional[ArenaRegion]) -> bytes:
         from .utils import deadline as deadline_mod, metrics, retry
         from .utils.errors import DeadlineExceeded, DeviceError
 
@@ -1484,11 +1535,14 @@ class SidecarPool:
             region.release()
 
     def _host_fallback_count(self, op: int, cause: str) -> None:
-        from .utils import metrics
+        from .utils import metrics, tracing
 
         self._reg().counter("sidecar.pool.host_fallbacks").inc()
         metrics.counter("sidecar.host_fallbacks").inc()
         metrics.event("sidecar.pool.degrade_to_host", op=op_name(op), cls=cause)
+        # the degrade lands on the enclosing pool.call span: a query
+        # whose answer came from the host engine says so in its trace
+        tracing.annotate(host_fallback=cause)
 
     # -- the shared-memory data plane ----------------------------------------
 

@@ -124,20 +124,33 @@ def op_boundary(name: str):
             # deadline scoping mirrors the retry nesting guard inside
             # _run_boundary: one scope per query, owned by the boundary
             # that opened it. The common fully-disarmed path pays two
-            # kwargs.pops, a boolean read (memgov gate), a context-var
-            # read, and one extra frame (_run_boundary) on top of what
-            # the seed paid — no closure beyond `attempt`, no clock, no
+            # kwargs.pops, two boolean reads (memgov + tracing gates),
+            # a context-var read, and two extra frames (_run_boundary
+            # and `scoped`) on top of what the seed paid — no clock, no
             # context manager.
-            dl = deadline.current()
-            if budget_s is None and dl is None:
-                budget_s = deadline.default_budget()
-            if budget_s is not None:
-                with deadline.scope(budget_s) as d:
-                    d.check(name)
-                    return _run_boundary(attempt, name)
-            if dl is not None:
-                dl.check(name)  # nested boundary: cancel point only
-            return _run_boundary(attempt, name)
+            def scoped():
+                dl = deadline.current()
+                bs = budget_s
+                if bs is None and dl is None:
+                    bs = deadline.default_budget()
+                if bs is not None:
+                    with deadline.scope(bs) as d:
+                        d.check(name)
+                        return _run_boundary(attempt, name)
+                if dl is not None:
+                    dl.check(name)  # nested boundary: cancel point only
+                return _run_boundary(attempt, name)
+
+            # srjt-trace (ISSUE 12): the op span covers the WHOLE
+            # boundary — deadline scope, every retry attempt, every
+            # backoff — so retry annotations and split child spans
+            # (utils/retry.py) land inside it. A nested boundary's span
+            # is a child; the OUTERMOST boundary with no active trace
+            # auto-roots a one-op trace (tracing.op_span policy).
+            if tracing.is_enabled():
+                with tracing.op_span(name):
+                    return scoped()
+            return scoped()
 
         return wrapper
 

@@ -274,8 +274,30 @@ declare("SRJT_METRICS_LOG", "str", None,
         "append one JSON object per runtime event to this path "
         "(line-atomic, shareable across worker + client)")
 declare("SRJT_TRACE_ENABLED", "bool", False,
-        "arm jax named-scope/TraceAnnotation ranges on every op "
-        "boundary (the NVTX-range analog; visible in XProf)")
+        "arm distributed per-query tracing (srjt-trace spans with "
+        "cross-process propagation) plus the jax named-scope/"
+        "TraceAnnotation ranges on every op boundary (the NVTX-range "
+        "analog; visible in XProf)")
+
+# distributed tracing + flight recorder (utils/tracing.py /
+# utils/trace_sink.py, ISSUE 12)
+declare("SRJT_TRACE_LOG", "str", None,
+        "span-log base path: each process appends its finished spans "
+        "(and flushed trace trees) to <base>.<pid>.jsonl — the "
+        "analysis.tracemerge join input")
+declare("SRJT_TRACE_SAMPLE", "float", 1.0,
+        "fraction of root traces sampled (0 disables roots entirely; "
+        "unsampled queries cost one RNG draw)")
+declare("SRJT_SLOW_QUERY_SEC", "float", None,
+        "flight recorder: a completed query slower than this flushes "
+        "its full span tree + metrics delta to SRJT_TRACE_LOG "
+        "(shed/failed queries always flush)", positive=True)
+declare("SRJT_TRACE_RING", "int", 64,
+        "flight recorder ring capacity: completed query traces kept "
+        "in memory for runtime.explain_last()", minimum=1)
+declare("SRJT_TRACE_MAX_SPANS", "int", 4096,
+        "per-trace in-memory span cap (overflow counted; the span LOG "
+        "is never capped)", minimum=16)
 
 # integrity + fault injection (utils/integrity.py / utils/faultinj.py)
 declare("SRJT_INTEGRITY_CHECKS", "bool", True,

@@ -69,6 +69,7 @@ __all__ = [
     "event",
     "record_op",
     "snapshot",
+    "counters_snapshot",
     "fold_worker_counters",
     "reset",
     "enable",
@@ -128,6 +129,16 @@ class Gauge:
     def inc(self, n=1) -> None:
         with self._lock:
             self._value += n
+
+    def set_max(self, v) -> None:
+        """Monotonic high-water update: compare-and-set under the
+        gauge's own lock, so two concurrent observers can never let a
+        smaller value overwrite a larger one (the trace.max_depth
+        contract — an unlocked read-then-set is exactly the
+        check-then-act the race tier polices)."""
+        with self._lock:
+            if v > self._value:
+                self._value = v
 
     @property
     def value(self):
@@ -248,6 +259,9 @@ class _NullMetric:
         pass
 
     def set(self, v) -> None:
+        pass
+
+    def set_max(self, v) -> None:
         pass
 
     def record(self, value) -> None:
@@ -609,6 +623,18 @@ def snapshot() -> dict:
     return _REGISTRY.snapshot()
 
 
+def counters_snapshot() -> Dict[str, int]:
+    """COUNTERS only, as one flat name -> value dict — the cheap
+    before/after pair the flight recorder diffs into a per-query
+    metrics delta (ISSUE 12). Skips gauges and histograms: a delta of
+    last-write-wins or bucketed state is not meaningful, and walking
+    just the counters keeps the per-root-trace cost to one locked list
+    copy plus word reads."""
+    with _REGISTRY._lock:
+        items = list(_REGISTRY._metrics.items())
+    return {name: m.value for name, m in items if isinstance(m, Counter)}
+
+
 def adaptive_timeout_s(hist_name: str, static_s: float):
     """Derive an ADAPTIVE socket deadline from an observed latency
     histogram recorded in MICROSECONDS (ISSUE 9): returns
@@ -738,6 +764,15 @@ def stage_report(stage: str) -> dict:
                 _REGISTRY.value("sidecar.adaptive_timeout_clamps")
                 + _REGISTRY.value("shuffle.tcp.adaptive_timeout_clamps")
             ),
+        },
+        # ISSUE 12 tracing counters: per-stage span volume — bench
+        # drivers pair this with the dedicated {"trace": ...} summary
+        # line (trace_sink.stage_summary) so a BENCH latency regression
+        # can be correlated with the span that grew
+        "trace": {
+            "spans": _REGISTRY.value("trace.spans"),
+            "traces": _REGISTRY.value("trace.traces"),
+            "flushed": _REGISTRY.value("trace.flushed"),
         },
         # ISSUE 8 serving counters: admission outcomes under load — the
         # chaos-under-load artifacts assert sheds surfaced as Overloaded

@@ -407,6 +407,13 @@ def call_with_retry(
                 "retry.backoff", op=op_name, attempt=attempt, cls=cls,
                 delay_ms=round(delay_ms, 3),
             )
+            # srjt-trace (ISSUE 12): the retry history lands ON the
+            # enclosing op span (utils/dispatch.py opens it around the
+            # whole boundary) — attempts-so-far overwrites each round,
+            # so a finished span reads "how many re-runs this op cost"
+            from . import tracing
+
+            tracing.annotate(retry_attempts=attempt + 1, retry_error=cls)
             if delay_ms > 0:
                 pol.sleep(delay_ms / 1000.0)
         finally:
@@ -495,6 +502,18 @@ def retry_with_split(
                 rows=_batch_rows(b),
             )
             lo, hi = split(b)
-            return combine([run(lo, depth + 1), run(hi, depth + 1)])
+
+            # srjt-trace (ISSUE 12): each half is a CHILD span of the
+            # op span (or the parent half's span on deeper recursion),
+            # so a split cascade reads as a tree of shrinking batches
+            def _half(x):
+                from . import tracing
+
+                with tracing.span(
+                    "retry.split", depth=depth + 1, rows=_batch_rows(x)
+                ):
+                    return run(x, depth + 1)
+
+            return combine([_half(lo), _half(hi)])
 
     return run(batch, 0)
