@@ -83,12 +83,15 @@ _HBM_ROOFLINE_GBS = 819.0  # v5e HBM bandwidth; nothing real exceeds it
 
 
 def _report(
-    name: str, rows: int, cols: int, secs: float, nbytes: int, protocol: str = "rawsync"
+    name: str, rows: int, cols: int, secs: float, nbytes: int,
+    protocol: str = "rawsync", **extra,
 ) -> None:
     """protocol: 'chained' = latency-cancelled two-length chain (trusted);
     'rawsync' = block_until_ready wall time — optimistic under remote
     backends that acknowledge before completion. Any rawsync number above
-    the HBM roofline is tagged suspect_rawsync (SURVEY §6 discipline)."""
+    the HBM roofline is tagged suspect_rawsync (SURVEY §6 discipline).
+    ``extra`` fields land verbatim on the row (the kernel-tier axes
+    attach tier/bit_identical/vs_baseline evidence)."""
     rec = {
         "bench": name,
         "rows": rows,
@@ -98,6 +101,7 @@ def _report(
         "gb_per_s": round(nbytes / secs / 1e9, 3),
         "protocol": protocol,
         "fingerprint": _platform_fingerprint(),
+        **extra,
     }
     if protocol != "chained" and rec["gb_per_s"] > _HBM_ROOFLINE_GBS:
         rec["suspect_rawsync"] = True
@@ -430,12 +434,140 @@ def bench_tpch(rows: int, reps: int) -> None:
     _report("tpch_q1_fused_chained", rows, li.num_columns, secs, nbytes, "chained")
 
 
+def _time_spread(fn: Callable[[], object], reps: int):
+    """(median, worst, per-rep list) — the kernel-tier axes gate on the
+    WORST rep (the bench.py vs_baseline_worst discipline: a lucky run
+    must not masquerade as the result)."""
+    _sync(fn())  # warmup + compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), float(max(times)), times
+
+
+def _tier_count(tier: str) -> int:
+    from spark_rapids_jni_tpu.utils import metrics
+
+    return metrics.registry().counter(f"dispatch.tier.{tier}").value
+
+
+def _forced_xla(knob_name: str):
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        # srjt-lint: allow-environ(harness save/restore of a declared knob around the forced-XLA twin measurement; not a config read)
+        prev = os.environ.get(knob_name)
+        os.environ[knob_name] = "0"
+        try:
+            yield
+        finally:
+            if prev is None:
+                del os.environ[knob_name]
+            else:
+                os.environ[knob_name] = prev
+
+    return scope()
+
+
+def bench_join(rows: int, reps: int) -> None:
+    """Paged-kernel join axis (ISSUE 13): ``rows`` probe rows against a
+    16 Ki-row build side (the TPC-DS fact-x-dimension shape the paged
+    tier targets), inner gather maps. Measures the ARMED tier, then the
+    forced-XLA sort-probe formulation in the same process; the tier row
+    carries which kernel actually ran (dispatch.tier counters), the
+    bit-identity verdict, and vs_baseline(_worst) = XLA median over the
+    tier's median (worst) rep — the premerge kernel-tier gate's
+    evidence."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops import join as join_ops
+
+    build = 1 << 14
+    rng = np.random.default_rng(42)
+    rk = rng.integers(0, build, build).astype(np.int64)
+    lk = rng.integers(0, 2 * build, rows).astype(np.int64)  # ~half match
+    lt = Table([Column(dt.INT64, data=jnp.asarray(lk))], ["k"])
+    rt = Table([Column(dt.INT64, data=jnp.asarray(rk))], ["k"])
+    nbytes = rows * 8 + build * 8
+
+    p0 = _tier_count("pallas")
+    tier_med, tier_worst, _ = _time_spread(
+        lambda: join_ops.join_gather_maps(lt, rt, "inner"), reps
+    )
+    engaged = "pallas" if _tier_count("pallas") > p0 else "xla"
+    got = join_ops.join_gather_maps(lt, rt, "inner")
+    with _forced_xla("SRJT_PALLAS_JOIN"):
+        xla_med, _, _ = _time_spread(
+            lambda: join_ops.join_gather_maps(lt, rt, "inner"), reps
+        )
+        want = join_ops.join_gather_maps(lt, rt, "inner")
+    bit_identical = bool(
+        np.array_equal(np.asarray(got[0]), np.asarray(want[0]))
+        and np.array_equal(np.asarray(got[1]), np.asarray(want[1]))
+    )
+    _report(
+        "join_inner_paged", rows, 1, tier_med, nbytes,
+        tier=engaged, bit_identical=bit_identical,
+        xla_secs=round(xla_med, 6),
+        vs_baseline=round(xla_med / tier_med, 3),
+        vs_baseline_worst=round(xla_med / tier_worst, 3),
+    )
+
+
+def bench_ragged_decode(rows: int, reps: int) -> None:
+    """Fused ragged-decode axis (ISSUE 13): ``rows`` strings of 0-32
+    bytes compacted out of a row-blob-shaped pool (inter-row gaps, the
+    convert_from_rows source layout). Same tier-vs-forced-XLA protocol
+    and row evidence as bench_join."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops.ragged_bytes import (
+        ragged_compact, ragged_compact_tiered,
+    )
+
+    rng = np.random.default_rng(42)
+    lens = rng.integers(0, 33, rows).astype(np.int64)
+    gaps = np.full(rows, 120, np.int64)  # the fixed-section stride analog
+    base = np.cumsum(np.concatenate([[0], (lens + gaps)[:-1]]))
+    pool = jnp.asarray(
+        rng.integers(0, 255, int(base[-1] + lens[-1]) + 128).astype(np.uint8)
+    )
+    basej = jnp.asarray(base)
+    offs = jnp.asarray(np.concatenate([[0], np.cumsum(lens)]))
+    total = int(offs[-1])
+
+    p0 = _tier_count("pallas")
+    tier_med, tier_worst, _ = _time_spread(
+        lambda: ragged_compact_tiered(pool, basej, offs, total), reps
+    )
+    engaged = "pallas" if _tier_count("pallas") > p0 else "xla"
+    got = np.asarray(ragged_compact_tiered(pool, basej, offs, total))
+    # the XLA twin is timed DIRECTLY (ragged_compact never consults the
+    # knob), so no forcing scope is needed on this axis
+    xla_med, _, _ = _time_spread(
+        lambda: ragged_compact(pool, basej, offs, total), reps
+    )
+    want = np.asarray(ragged_compact(pool, basej, offs, total))
+    _report(
+        "ragged_decode_fused", rows, 1, tier_med, total,
+        tier=engaged, bit_identical=bool(np.array_equal(got, want)),
+        xla_secs=round(xla_med, 6),
+        vs_baseline=round(xla_med / tier_med, 3),
+        vs_baseline_worst=round(xla_med / tier_worst, 3),
+    )
+
+
 _BENCHES = {
     "row_conversion_fixed": bench_row_conversion_fixed,
     "row_conversion_mixed": bench_row_conversion_mixed,
     "cast_string": bench_cast_string,
     "groupby": bench_groupby,
     "tpch": bench_tpch,
+    "join": bench_join,
+    "ragged_decode": bench_ragged_decode,
 }
 
 

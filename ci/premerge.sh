@@ -403,6 +403,51 @@ print(f"pool scaling {ratio:.2f}x (1={pool[1]:.1f}, 2={pool[2]:.1f} ops/s), "
       f"exchange {exch[0]['value']} MB/s -> artifacts/bench_pool.jsonl")
 EOF
 
+# kernel tier (ISSUE 13): the join/decode parity suite re-runs with
+# the Pallas tier FORCED through the interpreter (the exact kernel
+# bodies the chip runs, hermetic on CPU) and the event log armed, then
+# both kernel-tier microbench axes run env-armed. The gate is
+# artifact-based: the dispatch.tier events and BENCH-row tier fields
+# must PROVE the pallas path actually engaged (a silently-dead tier
+# that falls back everywhere passes tests but fails here), every row
+# must be bit-identical to its XLA twin, and vs_baseline_worst must
+# not regress — informational (> 0, recorded) on CPU where the
+# interpreter is the executor, and >= 2.0x on a real TPU backend (the
+# ISSUE 13 acceptance bar, enforced by the same gate when premerge
+# runs on-chip).
+rm -f artifacts/kernel_tier_metrics.jsonl artifacts/bench_kernel_tier.jsonl
+SRJT_PALLAS_INTERPRET=1 SRJT_METRICS_ENABLED=1 \
+  SRJT_METRICS_LOG=artifacts/kernel_tier_metrics.jsonl \
+  python -m pytest tests/test_pallas_kernels.py -q
+SRJT_PALLAS_INTERPRET=1 SRJT_RESULTS=artifacts/bench_kernel_tier.jsonl \
+  python benchmarks/microbench.py --bench join --rows 20000 --reps 2
+SRJT_PALLAS_INTERPRET=1 SRJT_RESULTS=artifacts/bench_kernel_tier.jsonl \
+  python benchmarks/microbench.py --bench ragged_decode --rows 20000 --reps 2
+python - <<'EOF'
+import json
+events = [json.loads(s) for s in open("artifacts/kernel_tier_metrics.jsonl")]
+tiers = [r for r in events if r["event"] == "dispatch.tier"]
+assert any(r.get("tier") == "pallas" for r in tiers), \
+    "parity suite ran but no dispatch served from the pallas tier"
+assert any(r.get("tier") == "xla" for r in tiers), \
+    "forced-fallback tests recorded no xla-tier dispatch"
+rows = [json.loads(s) for s in open("artifacts/bench_kernel_tier.jsonl")]
+by = {r["bench"]: r for r in rows if "bench" in r}
+for name in ("join_inner_paged", "ragged_decode_fused"):
+    b = by.get(name)
+    assert b, f"no {name} BENCH row emitted"
+    assert b["tier"] == "pallas", f"{name}: pallas tier did not engage ({b['tier']})"
+    assert b["bit_identical"], f"{name}: kernel result diverged from the XLA twin"
+    assert b["vs_baseline_worst"] > 0, b
+    if b["fingerprint"]["backend"] == "tpu":
+        assert b["vs_baseline_worst"] >= 2.0, (
+            f"{name}: on-chip kernel tier regressed below the 2x acceptance "
+            f"bar (vs_baseline_worst={b['vs_baseline_worst']})")
+print("kernel tier: pallas engaged in parity suite; " + "; ".join(
+    f"{n} {by[n]['vs_baseline']}x vs XLA (worst {by[n]['vs_baseline_worst']}x, "
+    f"bit-identical)" for n in ("join_inner_paged", "ragged_decode_fused")))
+EOF
+
 # (the disabled-mode overhead guard —
 # tests/test_metrics.py::test_disabled_mode_is_noop — runs in the fast
 # tier above with SRJT_METRICS_ENABLED unset, i.e. exactly the

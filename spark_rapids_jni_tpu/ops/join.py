@@ -17,6 +17,19 @@ survive with null right side).
 
 Returns cudf-style gather maps; ``inner_join``/``left_join`` build the
 joined Table via ops.copying.gather with NULLIFY bounds.
+
+KERNEL TIER (ISSUE 13): single int-key inner/left joins dispatch to
+the paged hash-table Pallas kernels (pallas_kernels.build_paged_table /
+pallas_probe_paged — the RPA page discipline) instead of the sort-probe
+formulation: the build side pages ONCE at build-side scale and the
+probe emits each row's match range in one fused pass, skipping the
+(nl + nr)-row concatenated sort entirely. Gather maps are BIT-IDENTICAL
+to the XLA path (both orders tie-break equal keys by original build
+row). Gate: ``SRJT_PALLAS_JOIN`` + backend (see kernel_tier_mode);
+unsupported dtypes/shapes, over-cap build sides, and ANY kernel-tier
+exception fall back to the XLA formulation silently — a kernel-tier
+failure must degrade, never error. The serving tier lands on the op
+span and the ``dispatch.tier.*`` counters (utils/dispatch.note_tier).
 """
 
 from __future__ import annotations
@@ -28,7 +41,8 @@ import numpy as np
 
 from ..columnar import Column, Table
 from ..columnar.dtype import TypeId
-from ..utils.dispatch import op_boundary
+from ..utils import metrics
+from ..utils.dispatch import note_tier, op_boundary
 from .aggregate import _segment_ids
 from .copying import concatenate, gather, gather_column
 from .sort import sorted_order
@@ -77,6 +91,66 @@ def _expand_rows(counts: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.nda
     return row, pair - cum[row], cum
 
 
+# key TypeIds the paged kernel understands: plain integers (the
+# order-map/limb machinery is integer-width based; decimals, floats,
+# strings, and timestamps keep the XLA formulation)
+_PALLAS_KEY_IDS = frozenset(
+    {
+        TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
+        TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64,
+    }
+)
+
+
+def _pallas_join_maps(
+    left_keys: Table, right_keys: Table, how: str, interpret: bool
+) -> Optional[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Paged-kernel gather maps, or None when the build side gates out
+    (empty/all-null/over-cap page table). Bit-identity with the XLA
+    path: the probe returns each row's contiguous match range over the
+    (bucket, key, row)-sorted build order, and equal keys list original
+    build rows in order on both paths."""
+    from .pallas_kernels import build_paged_table, pallas_probe_paged
+
+    nl, nr = left_keys.num_rows, right_keys.num_rows
+    if nl == 0 or nr == 0:
+        return None  # degenerate shapes: the XLA path's early returns apply
+    rcol = right_keys.columns[0]
+    lcol = left_keys.columns[0]
+    table = build_paged_table(rcol.data, rcol.validity)
+    if table is None:
+        return None
+    lo, eq = pallas_probe_paged(lcol.data, lcol.validity, table, interpret)
+
+    counts = eq if how == "inner" else jnp.maximum(eq, 1)
+    lrow, within, _cum = _expand_rows(counts)
+    if lrow.shape[0] == 0:
+        return lrow, within
+    matched = eq[lrow] > 0
+    rpos = jnp.where(matched, lo[lrow] + within, jnp.int32(-1))
+    rrow = jnp.where(
+        rpos >= 0,
+        table.r_order[jnp.clip(rpos, 0, table.nm - 1)],
+        jnp.int32(-1),
+    )
+    return lrow, rrow
+
+
+def _pallas_join_usable(left_keys: Table, right_keys: Table, how: str) -> str:
+    """The kernel-tier mode for this join shape ('' = keep XLA)."""
+    if how not in ("inner", "left"):
+        return ""
+    if left_keys.num_columns != 1 or right_keys.num_columns != 1:
+        return ""
+    if left_keys.columns[0].dtype.id not in _PALLAS_KEY_IDS:
+        return ""
+    if right_keys.columns[0].dtype.id != left_keys.columns[0].dtype.id:
+        return ""
+    from .pallas_kernels import kernel_tier_mode
+
+    return kernel_tier_mode("SRJT_PALLAS_JOIN")
+
+
 def join_gather_maps(
     left_keys: Table, right_keys: Table, how: str = "inner"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -85,6 +159,20 @@ def join_gather_maps(
     sentinel discipline)."""
     if how not in ("inner", "left", "full"):
         raise ValueError(f"unsupported join type {how!r}")
+    mode = _pallas_join_usable(left_keys, right_keys, how)
+    if mode:
+        try:
+            maps = _pallas_join_maps(
+                left_keys, right_keys, how, mode == "interpret"
+            )
+        except Exception:  # srjt-lint: allow-broad-except(kernel-tier contract: any probe/build failure degrades to the XLA formulation, never errors the join)
+            maps = None
+            metrics.event("dispatch.tier_degrade", op="join", tier=mode)
+            note_tier("degrade", "join_gather_maps")
+        if maps is not None:
+            note_tier("pallas", "join_gather_maps")
+            return maps
+    note_tier("xla", "join_gather_maps")
     nl, nr = left_keys.num_rows, right_keys.num_rows
     lid, rid = _factorize(left_keys, right_keys)
 

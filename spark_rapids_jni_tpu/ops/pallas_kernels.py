@@ -1,27 +1,46 @@
-"""Pallas TPU kernels for hot ops.
+"""Pallas TPU kernel tier for hot ops (ISSUE 13).
 
-First resident: the shuffle partitioner — murmur3(key) pmod P fused in
-one VMEM pass. XLA already fuses the jnp formulation well; the Pallas
-version exists to (a) pin the fused single-pass HBM->VMEM->HBM shape so
-no pipeline rematerializes the hash, and (b) carry the kernel
-infrastructure (tiling, padding, interpret-mode testing) that later
-byte-movement kernels build on.
+Residents:
 
-Bit-exact with ops/hashing.murmur3_raw / hash_partition_map for int32
-and int64 keys (tests cross-check in interpret mode on CPU).
+- the shuffle partitioner — murmur3(key) pmod P fused in one VMEM pass,
+- the bounded-domain GROUP BY SUM MXU kernels (one-hot / outer-product),
+- the PAGED HASH JOIN build/probe pair (``build_paged_table`` /
+  ``pallas_probe_paged``): the Ragged-Paged-Attention page discipline
+  (arxiv 2604.15464) applied to equi-joins — build partitions keys into
+  fixed 128-slot pages with contiguous overflow chaining, probe streams
+  the probe side through the VMEM-resident page table in one fused pass
+  emitting per-row match ranges,
+- the FUSED RAGGED DECODE kernel (``pallas_ragged_compact``): the
+  Mosaic escalation NOTES_ROUND5 named for the 1M x 155 decode axis —
+  offset walk (owner resolution), windowed byte gather, boundary
+  masking, and head merge in ONE pass over a scalar-prefetched pool
+  window, replacing the XLA formulation's three N-row scatter passes
+  and per-column HBM intermediates.
 
-Layout: [N] keys are split host-side into u32 lane planes and padded to
-(8, 128)-aligned 2-D tiles (the VPU shape); the kernel is gridded over
-row blocks.
+Every kernel keeps an interpret-mode path (``interpret=True``) so the
+hermetic CPU test tier exercises the same kernel bodies, and every
+caller dispatches through ``kernel_tier_mode`` with the XLA formulation
+as automatic fallback — a kernel-tier failure must degrade, never
+error (see utils/dispatch.note_tier for the tier observability).
+
+Bit-exactness: the partitioner matches ops/hashing.murmur3_raw, the
+join pair matches ops/join.join_gather_maps, the decode kernel matches
+ops/ragged_bytes.ragged_compact (tests cross-check all three in
+interpret mode on CPU).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 from jax.experimental import pallas as pl
+
+from ..utils import knobs
 
 try:  # pltpu import fails on builds without the TPU plugin; interpret mode still works
     from jax.experimental.pallas import tpu as pltpu
@@ -36,14 +55,75 @@ __all__ = [
     "pallas_groupby_sum_bounded",
     "pallas_groupby_sum_outer",
     "pallas_available",
+    "on_tpu",
+    "kernel_tier_mode",
+    "PagedHashTable",
+    "build_paged_table",
+    "pallas_probe_paged",
+    "pallas_decode_probe",
+    "pallas_ragged_compact",
 ]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # 512x128 u32 block = 256KB/input plane in VMEM
 
 
+def _pow2_ceil(v: int) -> int:
+    p = 1
+    while p < v:
+        p *= 2
+    return p
+
+
+# Memoized availability/backend probes (the memory.device_memory_budget
+# pattern): both sit on the per-dispatch hot path of every tiered op,
+# and ``jax.default_backend()`` re-walks the backend registry on every
+# call. The backend cannot change within a process, so one probe each
+# is sound; ``_reset_probe_cache`` is the test hook.
+_AVAILABLE: "bool | None" = None
+_ON_TPU: "bool | None" = None
+
+
 def pallas_available() -> bool:
-    return _VMEM is not None
+    """True when the Pallas TPU plugin surface imported (memoized)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        _AVAILABLE = _VMEM is not None
+    return _AVAILABLE
+
+
+def on_tpu() -> bool:
+    """True when the default jax backend is a real TPU (memoized)."""
+    global _ON_TPU
+    if _ON_TPU is None:
+        _ON_TPU = jax.default_backend() == "tpu"
+    return _ON_TPU
+
+
+def _reset_probe_cache() -> None:
+    global _AVAILABLE, _ON_TPU
+    _AVAILABLE = None
+    _ON_TPU = None
+
+
+def kernel_tier_mode(knob_name: str) -> str:
+    """Per-op kernel-tier dispatch decision, shared by every tiered op.
+
+    Returns ``"tpu"`` (compiled kernels), ``"interpret"`` (forced
+    through the Pallas interpreter off-TPU — the hermetic CI posture,
+    ``SRJT_PALLAS_INTERPRET=1``), or ``""`` (XLA formulation). The
+    per-op knob (``SRJT_PALLAS_JOIN`` / ``SRJT_PALLAS_DECODE``) is read
+    LIVE (the knob-registry test/operator contract); the backend probes
+    are memoized."""
+    if not knobs.get_bool(knob_name):
+        return ""
+    if not pallas_available():
+        return ""
+    if on_tpu():
+        return "tpu"
+    if knobs.get_bool("SRJT_PALLAS_INTERPRET"):
+        return "interpret"
+    return ""
 
 
 def _mix_k(k):
@@ -409,3 +489,559 @@ def pallas_groupby_sum_bounded(
     if num_keys > 4096:
         raise ValueError("pallas_groupby_sum_bounded supports num_keys <= 4096 (VMEM tile)")
     return _groupby_impl(keys, vals, int(num_keys), bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# paged hash-table JOIN build/probe (the RPA page discipline)
+# ---------------------------------------------------------------------------
+#
+# XLA has no device hash table, so ops/join.py's formulation sorts the
+# CONCATENATED key tables (nl + nr rows, multi-pass) per join. Ragged
+# Paged Attention's answer to ragged lookups on TPU is fixed-size
+# on-chip pages with overflow chaining; applied to an equi-join:
+#
+# BUILD (XLA prep, build-side scale only): bucket = mix(key) & (B-1);
+# build rows sort by (bucket, key) — two stable single-key argsorts,
+# not the probe-side multi-column sort — and fill fixed 128-slot pages
+# allocated CONTIGUOUSLY per bucket, so a bucket's overflow chain is
+# page_first[b] .. page_first[b] + chain_len[b) (the chain pointer is
+# the implicit +1). Because slots within a bucket are (key, row)-
+# sorted, a probe's matches are one CONTIGUOUS slot range.
+#
+# PROBE (the Pallas kernel): the whole page table lives in VMEM as u8
+# LIMB PLANES in bf16 ([nlimb * n_pages, 128]; 0..255 and the empty
+# sentinel 320 are bf16-exact, so one-hot MXU products are exact). Per
+# (probe block, chain step) the kernel builds the [BLK, n_pages] page
+# one-hot, gathers the chain page's limbs with nlimb MXU contractions,
+# and accumulates per-row counts of slots with key < probe (lt) and
+# key == probe (eq) via a lexicographic limb compare — so each probe
+# row leaves the kernel with its match range [start[bucket] + lt,
+# start[bucket] + lt + eq) over the page-sorted build order, and the
+# shared join expansion emits gather maps BIT-IDENTICAL to the XLA
+# formulation (stable sorts tie-break equal keys by original row on
+# both paths).
+#
+# Work shape: one chain step costs nlimb [BLK, n_pages] x [n_pages,
+# 128] bf16 matmuls — the one-hot gather's N_probe x n_pages work
+# amplification means the tier targets DIMENSION-TABLE builds (the
+# TPC-DS star shape): n_pages is capped, and pathological skew (every
+# key in one bucket) stays correct but pays chain_len grid steps.
+
+_PJ_PAGE = _LANES  # slots per page: one lane row
+_PJ_BLK = 256  # probe rows per grid step
+_PJ_MAX_BUILD = 1 << 16  # build rows the page table will hold
+_PJ_MAX_PAGES = 2048  # VMEM cap: 8 limb planes x 2048 pages x 128 x 2B = 4MB
+_PJ_BUCKET_TARGET = 64  # average build rows per bucket
+_PJ_MAX_BUCKETS = 2048
+_PJ_EMPTY = 320.0  # empty-slot sentinel limb: > any u8 limb, bf16-exact
+
+
+class PagedHashTable(NamedTuple):
+    """Build-side page table (see the module comment for the layout)."""
+
+    limbs: jnp.ndarray  # [nlimb * n_pages, 128] bf16 u8-limb planes, MS limb first
+    meta: jnp.ndarray  # [B] i64 packed (page_first << 44 | chain_len << 24 | slot_start)
+    r_order: jnp.ndarray  # [nm] i32: page-sorted rank -> original build row
+    num_buckets: int
+    n_pages: int
+    nlimb: int
+    c_max: int  # longest overflow chain, rounded up to a power of two
+    nm: int  # matchable (non-null) build rows
+
+
+def _order_map_u(keys: jnp.ndarray) -> jnp.ndarray:
+    """[N] integer keys -> order-preserving unsigned words (u32 for
+    widths <= 4, u64 for 8): unsigned compare in limb space must agree
+    with the key dtype's native order."""
+    dt_ = keys.dtype
+    signed = jnp.issubdtype(dt_, jnp.signedinteger)
+    if dt_.itemsize < 4:
+        keys = keys.astype(jnp.int32 if signed else jnp.uint32)
+        dt_ = keys.dtype
+    if dt_.itemsize == 4:
+        u = lax.bitcast_convert_type(keys, jnp.uint32)
+        return u ^ jnp.uint32(0x80000000) if signed else u
+    u = lax.bitcast_convert_type(keys, jnp.uint64)
+    return u ^ jnp.uint64(1 << 63) if signed else u
+
+
+def _bucket_of(u: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """[N] order words -> [N] i32 bucket ids in [0, B). Identical on
+    the build and probe sides by construction (same function)."""
+    if u.dtype == jnp.uint64:
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        h = _fmix(lo ^ _fmix(hi))
+    else:
+        h = _fmix(u)
+    return (h & jnp.uint32(num_buckets - 1)).astype(jnp.int32)
+
+
+def _limb_val(u: jnp.ndarray, l: int, nlimb: int) -> jnp.ndarray:
+    """Most-significant-first u8 limb ``l`` of the order words."""
+    sh = 8 * (nlimb - 1 - l)
+    one = jnp.uint64(sh) if u.dtype == jnp.uint64 else jnp.uint32(sh)
+    mask = jnp.uint64(0xFF) if u.dtype == jnp.uint64 else jnp.uint32(0xFF)
+    return (u >> one) & mask
+
+
+def build_paged_table(
+    keys: jnp.ndarray, valid: Optional[jnp.ndarray] = None
+) -> Optional[PagedHashTable]:
+    """Partition build-side keys into fixed 128-slot pages with
+    contiguous overflow chaining. Returns None when the build side is
+    empty, all-null, or over the page-table caps — the caller's signal
+    to keep the XLA formulation (degrade, never error). Eager-context
+    only (ONE stacked host sync: matchable rows, page count, longest
+    chain)."""
+    n = int(keys.shape[0])
+    if n == 0 or n > _PJ_MAX_BUILD:
+        return None
+    u = _order_map_u(keys)
+    nlimb = 8 if u.dtype == jnp.uint64 else 4
+    # bucket sizing uses n (nm is still on-device here): at most one
+    # doubling of oversize when the build side is null-heavy — empty
+    # buckets cost a metadata row, never a page
+    num_buckets = 16
+    while num_buckets * _PJ_BUCKET_TARGET < n and num_buckets < _PJ_MAX_BUCKETS:
+        num_buckets *= 2
+    bucket = _bucket_of(u, num_buckets)
+    if valid is not None:
+        # null build keys never match: park them past the last bucket
+        bucket = jnp.where(valid, bucket, jnp.int32(num_buckets))
+    # (bucket, key, row) total order from two stable argsorts: sort by
+    # key first, then stably by bucket — equal (bucket, key) ties keep
+    # original row order, the property the bit-identity proof needs
+    perm1 = jnp.argsort(u, stable=True).astype(jnp.int32)
+    perm = perm1[jnp.argsort(bucket[perm1], stable=True)].astype(jnp.int32)
+    bs_full = bucket[perm]  # nulls parked at bucket B sort LAST, so
+    # per-bucket counts over the full array already exclude them
+
+    bids = jnp.arange(num_buckets, dtype=jnp.int32)
+    starts = jnp.searchsorted(bs_full, bids, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(bs_full, bids, side="right").astype(jnp.int32)
+    cnt = ends - starts
+    pages_b = (cnt + _PJ_PAGE - 1) // _PJ_PAGE
+    page_first = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pages_b, dtype=jnp.int32)]
+    )
+    # ONE stacked host sync for every scalar the build needs (matchable
+    # rows, table allocation size, longest chain) — three separate
+    # pulls cost three tunnel round-trips on remote backends
+    nm_dev = (
+        jnp.int32(n) if valid is None else jnp.sum(valid, dtype=jnp.int32)
+    )
+    nm, n_pages, c_max = (
+        int(x)
+        for x in np.asarray(jnp.stack([nm_dev, page_first[-1], jnp.max(pages_b)]))
+    )
+    if nm == 0 or n_pages == 0 or n_pages > _PJ_MAX_PAGES:
+        return None
+    cp = _pow2_ceil(max(c_max, 1))  # pow2 chain grid keeps the probe
+    # compile cache stable
+    r_order = perm[:nm]
+    bs = bs_full[:nm]
+    u_sorted = u[perm][:nm]
+
+    rank = jnp.arange(nm, dtype=jnp.int32) - starts[bs]
+    slot = (page_first[bs] + rank // _PJ_PAGE) * _PJ_PAGE + rank % _PJ_PAGE
+    planes = []
+    for l in range(nlimb):
+        init = _PJ_EMPTY if l == 0 else 0.0
+        plane = (
+            jnp.full((n_pages * _PJ_PAGE,), init, jnp.bfloat16)
+            .at[slot]
+            .set(_limb_val(u_sorted, l, nlimb).astype(jnp.bfloat16))
+        )
+        planes.append(plane.reshape(n_pages, _PJ_PAGE))
+    limbs = jnp.concatenate(planes, axis=0)
+    meta = (
+        (page_first[:num_buckets].astype(jnp.int64) << 44)
+        | (pages_b.astype(jnp.int64) << 24)
+        | starts.astype(jnp.int64)
+    )
+    return PagedHashTable(limbs, meta, r_order, num_buckets, n_pages, nlimb, cp, nm)
+
+
+def _probe_kernel(fp_ref, cl_ref, *rest, n_pages: int, nlimb: int, blk: int):
+    pls = rest[:nlimb]
+    tab_ref = rest[nlimb]
+    o_ref = rest[nlimb + 1]
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    fp = fp_ref[0].reshape(-1, 1)  # [1, BLK] -> [BLK, 1] (the _scal relayout)
+    cl = cl_ref[0].reshape(-1, 1)
+    pid = fp + c
+    iota_p = lax.broadcasted_iota(jnp.int32, (blk, n_pages), 1)
+    vmask = c < cl  # [BLK, 1]: rows whose chain still has a page at step c
+    # single bool->bf16 consumer (the _outer_kernel Mosaic discipline);
+    # one-hot entries are 0/1 and limbs <= 320, all bf16-exact, and each
+    # one-hot row selects at most one page, so every MXU product and the
+    # length-n_pages sum are exact in any precision
+    oh = ((pid == iota_p) & vmask).astype(jnp.bfloat16)
+    one = jnp.float32(1)
+    zero = jnp.float32(0)
+    lt = eq = None
+    for l in range(nlimb):
+        tl = tab_ref[l * n_pages : (l + 1) * n_pages, :]
+        gl = lax.dot_general(
+            oh, tl, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BLK, 128]: chain page l-limbs per probe row
+        pv = pls[l][0].reshape(-1, 1)  # [BLK, 1] f32 probe limb
+        ltk = jnp.where(gl < pv, one, zero)
+        eqk = jnp.where(gl == pv, one, zero)
+        if l == 0:
+            lt, eq = ltk, eqk
+        else:
+            lt = lt + eq * ltk  # lexicographic: strictly-less at limb l
+            eq = eq * eqk  # breaks any earlier all-equal prefix
+    # invalid chain steps gathered all-zero limbs, which can spuriously
+    # equal an all-zero probe key: mask by chain validity before summing
+    lt_n = jnp.sum(jnp.where(vmask, lt, zero), axis=1, keepdims=True)
+    eq_n = jnp.sum(jnp.where(vmask, eq, zero), axis=1, keepdims=True)
+    upd = jnp.concatenate(
+        [lt_n.reshape(1, 1, -1), eq_n.reshape(1, 1, -1)], axis=1
+    )  # [1, 2, BLK]
+    o_ref[...] += upd
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+def _probe_impl(
+    u, lvalid, limbs, meta, num_buckets: int, n_pages: int, nlimb: int,
+    c_grid: int, interpret: bool,
+):
+    n = u.shape[0]
+    bucket = jnp.clip(_bucket_of(u, num_buckets), 0, num_buckets - 1)
+    m = meta[bucket]  # ONE [N]-from-[B] element gather for all three fields
+    fp = (m >> 44).astype(jnp.int32)
+    cl = ((m >> 24) & 0xFFFFF).astype(jnp.int32)
+    st = (m & 0xFFFFFF).astype(jnp.int32)
+    cl = jnp.where(lvalid, cl, 0)  # null probe keys visit no pages
+
+    g = max((n + _PJ_BLK - 1) // _PJ_BLK, 1)
+    total = g * _PJ_BLK
+
+    def pack_i(a):
+        return (
+            jnp.zeros((total,), jnp.int32).at[:n].set(a).reshape(g, 1, _PJ_BLK)
+        )
+
+    def pack_f(a):
+        return (
+            jnp.zeros((total,), jnp.float32).at[:n].set(a).reshape(g, 1, _PJ_BLK)
+        )
+
+    limb_ops = [
+        pack_f(_limb_val(u, l, nlimb).astype(jnp.float32)) for l in range(nlimb)
+    ]
+    scal_spec = pl.BlockSpec(
+        (1, 1, _PJ_BLK),
+        lambda i, c: (i, jnp.int32(0), jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    tab_spec = pl.BlockSpec(
+        (nlimb * n_pages, _PJ_PAGE),
+        lambda i, c: (jnp.int32(0), jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    out_spec = pl.BlockSpec(
+        (1, 2, _PJ_BLK),
+        lambda i, c: (i, jnp.int32(0), jnp.int32(0)),
+        memory_space=_VMEM if not interpret else None,
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _probe_kernel, n_pages=n_pages, nlimb=nlimb, blk=_PJ_BLK
+        ),
+        out_shape=jax.ShapeDtypeStruct((g, 2, _PJ_BLK), jnp.float32),
+        grid=(g, c_grid),
+        in_specs=[scal_spec] * (2 + nlimb) + [tab_spec],
+        out_specs=out_spec,
+        interpret=interpret,
+    )(pack_i(fp), pack_i(cl), *limb_ops, limbs)
+    lt = out[:, 0, :].reshape(-1)[:n].astype(jnp.int32)
+    eq = out[:, 1, :].reshape(-1)[:n].astype(jnp.int32)
+    return st + lt, eq
+
+
+def pallas_probe_paged(
+    keys: jnp.ndarray,
+    valid: Optional[jnp.ndarray],
+    table: PagedHashTable,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stream probe keys through the page table: one fused pass per
+    chain step. Returns ``(lo, eq)`` — probe row i matches build ranks
+    ``r_order[lo[i] : lo[i] + eq[i]]`` (page-sorted order; equal keys
+    keep original build-row order, matching the XLA join's stable
+    argsort)."""
+    u = _order_map_u(keys)
+    nlimb = 8 if u.dtype == jnp.uint64 else 4
+    if nlimb != table.nlimb:
+        raise ValueError("probe key width does not match the build table")
+    lvalid = (
+        jnp.ones(keys.shape, bool) if valid is None else valid.astype(bool)
+    )
+    return _probe_impl(
+        u, lvalid, table.limbs, table.meta, table.num_buckets, table.n_pages,
+        table.nlimb, table.c_max, bool(interpret),
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused ragged DECODE (ragged_compact as one Mosaic kernel)
+# ---------------------------------------------------------------------------
+#
+# ops/ragged_bytes.ragged_compact is the pure-XLA floor NOTES_ROUND5
+# measured at ~2.7 s on the 1M x 155 mixed decode axis: per string
+# column it pays THREE N-row scatter passes (~40 ns/element each: the
+# owner shift c_w, the boundary mask nb, the head-chunk add) plus two
+# element gathers per output word, materializing every stage in HBM.
+# This kernel is the escalation those notes named: per OUTPUT BLOCK of
+# _PD_BLKW u32 words it holds the overlapping ROW WINDOW's metadata and
+# a scalar-prefetched two-block POOL WINDOW in VMEM and resolves
+# everything on-chip —
+#
+# - OWNER (the offset walk): c_w[w] = max c_row over window rows with
+#   wfirst <= w — a dense masked max over [row_chunk, BLKW] tiles
+#   (brute-force compare beats an HBM scatter; the owner row of every
+#   word in the block provably lies inside the window),
+# - BOUNDARY: nb[w] = min in-word boundary position, same dense min,
+# - HEAD: sub-word head chunks of rows starting in the block, dense
+#   masked sum (disjoint byte lanes by the dense-offsets contract),
+# - FETCH: source words via two in-window dynamic gathers + a 4-way
+#   funnel select (constant u32 shifts: no in-kernel i32<->u32
+#   conversion, the Mosaic recursion hazard ragged_bytes documents).
+#
+# The pool window rides pltpu.PrefetchScalarGridSpec: block g fetches
+# pool blocks [b_g, b_g + 2) of WINW words each, b_g data-dependent via
+# the scalar-prefetched block vector — the RPA paged-fetch shape. WINW
+# and the row-window size RW are probed per call (G-scale reduces, one
+# host sync — or batched by the caller via ``hint``); inputs whose
+# windows exceed the VMEM caps return None and the caller keeps the
+# XLA formulation. Zero-length rows (null strings' validity) own no
+# bytes and are masked out of all three resolutions.
+
+_PD_BLKW = 512  # output u32 words per grid step (2 KB of output bytes)
+_PD_ROW_CHUNK = 128  # row-window rows reduced per unrolled step
+_PD_MAX_RW = 1024  # row-window cap (VMEM: [128, 512] i32 tiles per step)
+_PD_MAX_WIN = 1 << 17  # pool-window cap in words (2 x 512 KB blocks in VMEM)
+_PD_BIG = 0x3FFFFFFF  # parked word index: matches no real output word
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def pallas_decode_probe(base, offs, total: int):
+    """Static-shape probe for ``pallas_ragged_compact``: [2] i32 of
+    (max rows overlapping any output block, max pool-window words any
+    block needs). G-scale reduces only; callers batch several columns'
+    probes into one host sync."""
+    n = base.shape[0]
+    nw = (total + 3) // 4
+    g = max((nw + _PD_BLKW - 1) // _PD_BLKW, 1)
+    w0 = jnp.arange(g, dtype=jnp.int64) * (_PD_BLKW * 4)
+    rfirst = jnp.clip(
+        jnp.searchsorted(offs[1:], w0, side="right"), 0, n - 1
+    ).astype(jnp.int32)
+    rlast = jnp.clip(
+        jnp.searchsorted(offs[:-1], w0 + 4 * _PD_BLKW - 1, side="right") - 1,
+        0, n - 1,
+    ).astype(jnp.int32)
+    rlast = jnp.maximum(rlast, rfirst)
+    rw = jnp.max(rlast - rfirst + 1)
+    b_rf = base[rfirst]
+    wl = jnp.clip(b_rf - 4, 0, None) >> 2
+    c_rl = base[rlast] - offs[rlast]
+    span = ((c_rl + w0 + 4 * _PD_BLKW + 8) >> 2) - wl + 2
+    return jnp.stack([rw.astype(jnp.int32), jnp.max(span).astype(jnp.int32)])
+
+
+def _pd_kernel(
+    bvec_ref, cr_ref, wf_ref, bw_ref, bp_ref, hw_ref, hc_ref, p0_ref, p1_ref,
+    o_ref, *, blkw: int, rw: int, winw: int, rc_chunk: int,
+):
+    g = pl.program_id(0)
+    wb = bvec_ref[g] * winw
+    w = g * blkw + lax.broadcasted_iota(jnp.int32, (1, blkw), 1)
+    crm = cr_ref[:]
+    wfm = wf_ref[:]
+    bwm = bw_ref[:]
+    bpm = bp_ref[:]
+    hwm = hw_ref[:]
+    hcm = hc_ref[:]
+    acc_c = jnp.zeros((1, blkw), jnp.int32)
+    acc_nb = jnp.full((1, blkw), 4, jnp.int32)
+    acc_h = jnp.zeros((1, blkw), jnp.uint32)
+    # chunked row reduction (the _vacc_kernel VMEM discipline: each
+    # [rc_chunk, blkw] tile's temps die before the next chunk)
+    for k in range(rw // rc_chunk):
+        sl = slice(k * rc_chunk, (k + 1) * rc_chunk)
+        wfk = wfm[:, sl].reshape(-1, 1)  # [RC, 1] (the _scal relayout)
+        crk = crm[:, sl].reshape(-1, 1)
+        acc_c = jnp.maximum(
+            acc_c,
+            jnp.max(jnp.where(wfk <= w, crk, 0), axis=0, keepdims=True),
+        )
+        bwk = bwm[:, sl].reshape(-1, 1)
+        bpk = bpm[:, sl].reshape(-1, 1)
+        acc_nb = jnp.minimum(
+            acc_nb,
+            jnp.min(jnp.where(bwk == w, bpk, 4), axis=0, keepdims=True),
+        )
+        hwk = hwm[:, sl].reshape(-1, 1)
+        hck = hcm[:, sl].reshape(-1, 1)
+        acc_h = acc_h + jnp.sum(
+            jnp.where(hwk == w, hck, jnp.uint32(0)),
+            axis=0, keepdims=True, dtype=jnp.uint32,  # x64 would promote
+        )
+    s = acc_c + w * 4  # owner source byte address per output word
+    lw = jnp.clip((s >> 2) - wb, 0, 2 * winw - 2)
+    w2 = jnp.concatenate([p0_ref[:], p1_ref[:]], axis=1)  # [1, 2*WINW]
+    g0 = jnp.take_along_axis(w2, lw, axis=1)
+    g1 = jnp.take_along_axis(w2, lw + 1, axis=1)
+    # 4-way funnel select on constant u32 shifts: no i32<->u32 astype
+    # in-kernel (the Mosaic convert-lowering recursion ragged_bytes hit)
+    c1 = (g0 >> jnp.uint32(8)) | (g1 << jnp.uint32(24))
+    c2 = (g0 >> jnp.uint32(16)) | (g1 << jnp.uint32(16))
+    c3 = (g0 >> jnp.uint32(24)) | (g1 << jnp.uint32(8))
+    rbsel = s & 3
+    word = jnp.where(
+        rbsel == 0, g0, jnp.where(rbsel == 1, c1, jnp.where(rbsel == 2, c2, c3))
+    )
+    keep = jnp.where(
+        acc_nb >= 4,
+        ~jnp.uint32(0),
+        jnp.where(
+            acc_nb == 1,
+            jnp.uint32(0xFF),
+            jnp.where(acc_nb == 2, jnp.uint32(0xFFFF), jnp.uint32(0xFFFFFF)),
+        ),
+    )
+    o_ref[:] = (word & keep) | acc_h
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def _pd_impl(
+    pool32, base, offs, total: int, plen: int, rw: int, winw: int,
+    interpret: bool,
+):
+    from .ragged_bytes import _funnel_u32, u32_rows_to_u8_flat
+
+    n = base.shape[0]
+    nw = (total + 3) // 4
+    g = max((nw + _PD_BLKW - 1) // _PD_BLKW, 1)
+    pw = pool32.shape[0]
+    pb = pw // winw + 2
+    pool2d = (
+        jnp.zeros((pb * winw,), jnp.uint32).at[:pw].set(pool32).reshape(pb, winw)
+    )
+
+    w0 = jnp.arange(g, dtype=jnp.int64) * (_PD_BLKW * 4)
+    rfirst = jnp.clip(
+        jnp.searchsorted(offs[1:], w0, side="right"), 0, n - 1
+    ).astype(jnp.int32)
+    ridx = rfirst[:, None] + jnp.arange(rw, dtype=jnp.int32)[None, :]
+    inb = ridx < n
+    rc = jnp.clip(ridx, 0, n - 1)
+    o_r = offs[rc].astype(jnp.int32)  # addresses < 2^31 (cudf size_type)
+    e_r = offs[rc + 1].astype(jnp.int32)
+    b_r = base[rc].astype(jnp.int32)
+    valid = inb & (e_r > o_r)
+    cr = jnp.where(valid, b_r - o_r, 0)
+    wf = jnp.where(valid, (o_r + 3) >> 2, _PD_BIG)
+    bpos = e_r & 3
+    bw = jnp.where(inb & (bpos > 0), e_r >> 2, _PD_BIG)
+    bp = bpos
+    xa = (o_r + 3) & ~jnp.int32(3)
+    chunk = jnp.clip(jnp.minimum(e_r, xa) - o_r, 0, 3)
+    has = valid & (chunk > 0)
+    hsrc = _funnel_u32(pool32, jnp.clip(b_r, 0, plen))
+    hmask = (jnp.uint32(1) << (chunk.astype(jnp.uint32) * 8)) - jnp.uint32(1)
+    hc = jnp.where(
+        has,
+        (hsrc & hmask) << ((o_r & 3).astype(jnp.uint32) * 8),
+        jnp.uint32(0),
+    )
+    hw = jnp.where(has, o_r >> 2, _PD_BIG)
+
+    b_rf = base[rfirst].astype(jnp.int32)
+    wl = jnp.clip(b_rf - 4, 0, None) >> 2
+    bvec = jnp.clip(wl // winw, 0, pb - 2).astype(jnp.int32)
+
+    def _meta_spec():
+        return pl.BlockSpec(
+            (1, rw),
+            lambda i, b: (i, jnp.int32(0)),
+            memory_space=_VMEM if not interpret else None,
+        )
+
+    def _pool_spec(step: int):
+        return pl.BlockSpec(
+            (1, winw),
+            lambda i, b, _s=step: (b[i] + _s, jnp.int32(0)),
+            memory_space=_VMEM if not interpret else None,
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(g,),
+        in_specs=[_meta_spec() for _ in range(6)]
+        + [_pool_spec(0), _pool_spec(1)],
+        out_specs=pl.BlockSpec(
+            (1, _PD_BLKW),
+            lambda i, b: (i, jnp.int32(0)),
+            memory_space=_VMEM if not interpret else None,
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _pd_kernel, blkw=_PD_BLKW, rw=rw, winw=winw,
+            rc_chunk=min(rw, _PD_ROW_CHUNK),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g, _PD_BLKW), jnp.uint32),
+        interpret=interpret,
+    )(bvec, cr, wf, bw, bp, hw, hc, pool2d, pool2d)
+    return u32_rows_to_u8_flat(out)[:total]
+
+
+def pallas_ragged_compact(
+    pool: jnp.ndarray,
+    base: jnp.ndarray,
+    offs: jnp.ndarray,
+    total: int,
+    pool32: jnp.ndarray = None,
+    interpret: bool = False,
+    hint=None,
+):
+    """Fused-kernel twin of ``ops.ragged_bytes.ragged_compact`` (same
+    contract: dense ``offs``, nondecreasing non-overlapping ``base``,
+    addresses < 2^31). Returns the [total] u8 blob BIT-IDENTICAL to the
+    XLA formulation, or None when the probed row/pool windows exceed
+    the VMEM caps — the caller's keep-XLA signal. ``hint`` short-cuts
+    the probe with precomputed (rw_max, span_max) so multi-column
+    callers pay ONE host sync for all columns. Eager-context only."""
+    total = int(total)
+    n = int(base.shape[0])
+    if total == 0 or n == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    if hint is None:
+        rw_max, span_max = (
+            int(x) for x in np.asarray(pallas_decode_probe(base, offs, total))
+        )
+    else:
+        rw_max, span_max = int(hint[0]), int(hint[1])
+    rw = _pow2_ceil(max(rw_max, 8))
+    winw = _pow2_ceil(max(span_max, _LANES))
+    if rw > _PD_MAX_RW or winw > _PD_MAX_WIN:
+        return None
+    if pool32 is None:
+        from .ragged_bytes import build_pool32
+
+        pool32 = build_pool32(pool)
+    return _pd_impl(
+        pool32, base, offs, total, int(pool.shape[0]), rw, winw, bool(interpret)
+    )

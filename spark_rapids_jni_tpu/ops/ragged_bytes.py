@@ -48,11 +48,19 @@ __all__ = [
     "flat_u8_to_u32",
     "build_pool32",
     "ragged_compact",
+    "ragged_compact_tiered",
 ]
 
 
+from .pallas_kernels import on_tpu as _on_tpu  # noqa: E402  (memoized probes)
+from .pallas_kernels import pallas_available as _pallas_available  # noqa: E402
+
+
 def _use_pallas() -> bool:
-    return _VMEM is not None and jax.default_backend() == "tpu"
+    # memoized probes (pallas_kernels): this gate sits on every ragged
+    # helper's hot path and jax.default_backend() re-walks the backend
+    # registry per call (ISSUE 13 satellite)
+    return _pallas_available() and _on_tpu()
 
 
 def _pow2_ceil(v: int) -> int:
@@ -705,6 +713,43 @@ def ragged_compact(
     rows = (nw + lanes - 1) // lanes
     w32p = jnp.zeros((rows * lanes,), jnp.uint32).at[:nw].set(words)
     return u32_rows_to_u8_flat(w32p.reshape(rows, lanes))[:total]
+
+
+def ragged_compact_tiered(
+    pool: jnp.ndarray,
+    base: jnp.ndarray,
+    offs: jnp.ndarray,
+    total: int,
+    pool32: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """EAGER kernel-tier dispatcher for ``ragged_compact`` (ISSUE 13):
+    the fused Pallas decode kernel when ``SRJT_PALLAS_DECODE`` arms and
+    the probed windows fit (pallas_kernels.pallas_ragged_compact), the
+    XLA formulation otherwise — bit-identical either way, and ANY
+    kernel-tier failure degrades silently. Host-syncs the window probe,
+    so inside-jit callers (the fused multi-column decode program) keep
+    calling ``ragged_compact`` directly; row_conversion batches its
+    per-column probes through the ``hint`` path instead."""
+    from ..utils import metrics
+    from ..utils.dispatch import note_tier
+    from .pallas_kernels import kernel_tier_mode, pallas_ragged_compact
+
+    mode = kernel_tier_mode("SRJT_PALLAS_DECODE")
+    if mode and int(total) > 0:
+        try:
+            out = pallas_ragged_compact(
+                pool, base, offs, int(total), pool32=pool32,
+                interpret=mode == "interpret",
+            )
+        except Exception:  # srjt-lint: allow-broad-except(kernel-tier contract: any kernel failure degrades to the XLA formulation, never errors the decode)
+            out = None
+            metrics.event("dispatch.tier_degrade", op="ragged_compact", tier=mode)
+            note_tier("degrade", "ragged_compact")
+        if out is not None:
+            note_tier("pallas", "ragged_compact")
+            return out
+    note_tier("xla", "ragged_compact")
+    return ragged_compact(pool, base, offs, int(total), pool32=pool32)
 
 
 _ASSEMBLE_BLOCK_TILES = 1 << 16  # dst tiles per lax.map block when the

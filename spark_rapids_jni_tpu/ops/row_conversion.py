@@ -920,17 +920,73 @@ def _jit_string_chars(
     return tuple(outs)
 
 
+def _pallas_string_chars(totals, blob, starts, in_offs, offs, mode):
+    """Kernel-tier string decode (ISSUE 13): every string column's
+    chars through the FUSED pallas_ragged_compact kernel — the offset
+    walk, windowed byte gather, boundary masking, and head merge run
+    in-VMEM instead of materializing the XLA formulation's per-column
+    scatter/gather intermediates in HBM. The per-column window probes
+    batch into ONE host sync (the _jit_string_offsets discipline: 16
+    per-column syncs dominated the mixed decode through a remote
+    tunnel). Returns None when any column's probed windows exceed the
+    kernel caps — the caller keeps the fused XLA program."""
+    from .pallas_kernels import pallas_decode_probe, pallas_ragged_compact
+    from .ragged_bytes import build_pool32
+
+    live = [k for k, t in enumerate(totals) if t > 0]
+    bases = {}
+    offs64 = {}
+    probes = []
+    for k in live:
+        bases[k] = starts + in_offs[k]
+        offs64[k] = offs[k].astype(jnp.int64)
+        probes.append(pallas_decode_probe(bases[k], offs64[k], totals[k]))
+    if not live:
+        return tuple(jnp.zeros((0,), jnp.uint8) for _ in totals)
+    hints = np.asarray(jnp.stack(probes))  # ONE host sync for all columns
+    pool32 = build_pool32(blob)  # ONCE per blob
+    outs = [jnp.zeros((0,), jnp.uint8)] * len(totals)
+    for j, k in enumerate(live):
+        out = pallas_ragged_compact(
+            blob, bases[k], offs64[k], totals[k], pool32=pool32,
+            interpret=mode == "interpret", hint=hints[j],
+        )
+        if out is None:
+            return None
+        outs[k] = out
+    return tuple(outs)
+
+
 def _assemble_from_rows(dtypes, col_datas, valid_cols, blob, starts, n) -> Table:
+    from ..utils import metrics
+    from ..utils.dispatch import note_tier
+    from .pallas_kernels import kernel_tier_mode
+
     str_idx = [i for i, d in enumerate(dtypes) if d.id == TypeId.STRING]
     prebuilt = {}
     if str_idx and n > 0:
         lns = tuple(col_datas[i][1].astype(jnp.int32) for i in str_idx)
         offs, totals_dev = _jit_string_offsets(lns)
         totals = tuple(int(t) for t in np.asarray(totals_dev))  # ONE host sync
-        chars = _jit_string_chars(
-            totals, blob, starts,
-            tuple(col_datas[i][0].astype(jnp.int64) for i in str_idx), offs,
-        )
+        in_offs = tuple(col_datas[i][0].astype(jnp.int64) for i in str_idx)
+        chars = None
+        mode = kernel_tier_mode("SRJT_PALLAS_DECODE")
+        if mode:
+            try:
+                chars = _pallas_string_chars(
+                    totals, blob, starts, in_offs, offs, mode
+                )
+            except Exception:  # srjt-lint: allow-broad-except(kernel-tier contract: any kernel failure degrades to the fused XLA decode, never errors the op)
+                chars = None
+                metrics.event(
+                    "dispatch.tier_degrade", op="string_decode", tier=mode
+                )
+                note_tier("degrade", "string_decode")
+        if chars is not None:
+            note_tier("pallas", "string_decode")
+        else:
+            note_tier("xla", "string_decode")
+            chars = _jit_string_chars(totals, blob, starts, in_offs, offs)
         for k, i in enumerate(str_idx):
             prebuilt[i] = Column(
                 dtypes[i], validity=valid_cols[i], offsets=offs[k], chars=chars[k]

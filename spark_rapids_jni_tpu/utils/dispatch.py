@@ -13,13 +13,46 @@ RetryableError, all in one decorator applied to public ops.
 from __future__ import annotations
 
 import functools
+import threading
 import time
+from typing import Dict, Optional
 
 from . import deadline, faultinj, metrics, tracing
 from .errors import DeviceError, classify
 from .. import memgov
 
-__all__ = ["op_boundary"]
+__all__ = ["op_boundary", "note_tier"]
+
+
+# Kernel-tier observability (ISSUE 13): tiered ops report which
+# formulation actually served a dispatch — ``pallas`` (kernel tier),
+# ``xla`` (the fallback formulation), or ``host`` (host-engine
+# degrade). Counted REGISTRY-DIRECT (the memory.split_retries
+# discipline: durable bookkeeping, independent of the
+# SRJT_METRICS_ENABLED hot-path gate) so BENCH drivers and the premerge
+# kernel-tier gate can prove the pallas path engaged; with tracing
+# armed the tier also lands as an annotation on the active op span, so
+# flight-recorder output shows which kernel a slow query ran. Handles
+# are cached (the record_op idiom): one dict read per note after the
+# first dispatch of a tier.
+_tier_handles: Dict[str, object] = {}
+_tier_handles_lock = threading.Lock()
+
+
+def note_tier(tier: str, op: Optional[str] = None) -> None:
+    """Record the serving tier of the current dispatch (see above)."""
+    c = _tier_handles.get(tier)
+    if c is None:
+        with _tier_handles_lock:
+            c = _tier_handles.get(tier)
+            if c is None:
+                c = metrics.registry().counter(f"dispatch.tier.{tier}")
+                _tier_handles[tier] = c
+    c.inc()
+    if metrics.is_enabled() and op is not None:
+        metrics.event("dispatch.tier", op=op, tier=tier)
+    if tracing.is_enabled():
+        tracing.annotate(tier=tier)
 
 
 def _run_boundary(attempt, name: str):

@@ -460,6 +460,20 @@ declare("SRJT_SERVE_RETRY_AFTER_SEC", "float", 0.25,
         "default retry_after_s backoff hint carried by a shed's "
         "Overloaded error", positive=True)
 
+# Pallas kernel tier (ops/pallas_kernels.py, ISSUE 13)
+declare("SRJT_PALLAS_JOIN", "bool", True,
+        "arm the paged-hash-table Pallas join tier for single int-key "
+        "inner/left joins (0 forces the XLA sort-probe formulation; "
+        "unsupported shapes/dtypes fall back automatically either way)")
+declare("SRJT_PALLAS_DECODE", "bool", True,
+        "arm the fused ragged-decode Pallas kernel for string-column "
+        "row decode (0 forces the XLA scatter/funnel formulation; "
+        "over-cap windows fall back automatically either way)")
+declare("SRJT_PALLAS_INTERPRET", "bool", False,
+        "run kernel-tier Pallas paths through the Pallas interpreter "
+        "off-TPU (hermetic CI parity of the exact kernel bodies; "
+        "production CPU keeps the XLA formulations)")
+
 # runtime / harness
 declare("SRJT_NATIVE_LIB", "str", None,
         "explicit libsrjt.so path (before the packaged / dev-build "
