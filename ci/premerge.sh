@@ -349,6 +349,65 @@ print(f"trace tier: {len(rep['traces'])} traces, 0 orphans, "
       "chain present -> artifacts/trace_merged.json / trace_perfetto.json")
 EOF
 
+# plan-compiler tier (ISSUE 14): the srjt-plan suite — IR/rewrite unit
+# tier plus EVERY green plan query against its pandas oracle, the two
+# hand-built greens (q3/q55) re-expressed as plans and asserted
+# bit-identical to their fused originals, rewrite idempotence, and the
+# schema contract (inferred dtypes == executed dtypes) — runs env-armed
+# with the MEMORY GOVERNOR ON (a generous budget: the point is that
+# admission runs, not that it starves) and the per-query report knob
+# set. The merge gate is artifact-based: artifacts/plan_compile.jsonl
+# must carry every registry query with node counts and rewrites fired,
+# ZERO estimate-vs-actual peak-byte blowups over 4x, and the metrics
+# log must PROVE memgov admission consumed nonzero plan-derived
+# estimates (the ISSUE 14 acceptance assertion). SRJT_LOCKDEP/RACE
+# ride along and feed the merged zero-cycle gate below.
+rm -f artifacts/plan_compile.jsonl artifacts/plan_metrics.jsonl
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 \
+  SRJT_DEVICE_MEMORY_BUDGET=268435456 SRJT_SPILL_ENABLED=1 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/plan_metrics.jsonl \
+  SRJT_PLAN_REPORT=artifacts/plan_compile.jsonl \
+  python -m pytest tests/test_plan.py tests/test_plan_queries.py -q
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/plan_compile.jsonl")]
+assert rows, "compiler tier produced no plan reports"
+by = {}
+for r in rows:
+    by[r["query"]] = r  # last execution per query wins
+from spark_rapids_jni_tpu.models.tpcds_plans import PLAN_QUERIES
+missing = sorted(set(PLAN_QUERIES) - set(by))
+assert not missing, f"green plan queries missing from the report: {missing}"
+assert len(PLAN_QUERIES) >= 10, "fewer than 10 compiler-green queries"
+for name in ("q3", "q55"):
+    assert name in by, f"re-expressed green {name} not exercised"
+blowups = {}
+for q, r in by.items():
+    assert r["nodes_raw"] > 0 and r["nodes_optimized"] > 0, r
+    assert isinstance(r["rewrites"], dict), r
+    assert r["est_peak_bytes"] > 0, r
+    if r["peak_blowup"] is not None and r["peak_blowup"] > 4.0:
+        blowups[q] = r["peak_blowup"]
+assert not blowups, f"estimate-vs-actual peak blowups > 4x: {blowups}"
+fired = {}
+for q in PLAN_QUERIES:
+    for rule, n in by[q]["rewrites"].items():
+        fired[rule] = fired.get(rule, 0) + n
+for rule in ("decorrelate_scalar_agg", "expand_grouping_sets",
+             "setop_to_joins", "exists_to_semijoin", "having_to_filter"):
+    assert fired.get(rule), f"rewrite {rule} never fired across the greens"
+fused = sum(by[q]["fused_stages"] for q in PLAN_QUERIES)
+assert fused > 0, "no query lowered through the fused pipeline tier"
+events = [json.loads(s) for s in open("artifacts/plan_metrics.jsonl")]
+admits = [e for e in events if e["event"] == "plan.admit"]
+assert admits and all(e["nbytes"] > 0 for e in admits), \
+    "memgov admission saw no nonzero plan-derived estimates"
+print(f"plan tier: {len(PLAN_QUERIES)} compiler-green queries "
+      f"({fused} fused stages), rewrites {fired}, "
+      f"{len(admits)} plan-derived admissions, 0 blowups "
+      "-> artifacts/plan_compile.jsonl")
+EOF
+
 # lockdep + race gate (ISSUEs 7 + 11, layer 2): merge every
 # per-process report the armed tiers above dropped (fast tier + the
 # chaos tiers + the serve and gray tiers, incl. spawned

@@ -96,8 +96,8 @@ _TAXONOMY = {
 }
 
 # rule scopes, as path fragments relative to the package root
-_RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "serve/", "sidecar.py",
-                   "sidecar_pool.py")
+_RAISE_GOVERNED = ("ops/", "memgov/", "parallel/", "serve/", "plan/",
+                   "sidecar.py", "sidecar_pool.py")
 _BLOCKING_GOVERNED = ("sidecar.py", "sidecar_pool.py", "parallel/",
                       "memgov/", "serve/", "utils/retry.py",
                       "utils/faultinj.py", "utils/tracing.py",

@@ -88,6 +88,10 @@ RACE_GOVERNED = (
     # slot threads) and carry their own locks worth proving
     "utils/tracing.py",
     "utils/trace_sink.py",
+    # ISSUE 14: the plan compiler — CompiledPlan objects are submitted
+    # to the concurrent serving runtime, so their state discipline
+    # (per-run contexts, no shared mutable caches) is worth proving
+    "plan/",
 )
 
 _SUPPRESS_RE = re.compile(

@@ -178,6 +178,48 @@ def gen_store_wide(num_sales: int, seed: int = 42) -> Dict[str, Table]:
             "ss_coupon_amt", "ss_sales_price", "ss_ext_sales_price",
         ],
     )
+    # srjt-plan (ISSUE 14) star extensions — every new random column /
+    # table is drawn AFTER all pre-existing draws (the q42 pattern
+    # above), so the original columns' random sequences are untouched
+    # and the earlier oracle tests stay bit-identical.
+    n_hdemo, n_times = 100, 1440
+    store = Table(
+        list(store.columns) + [_int_col(rng.integers(0, 10, n_store))],  # s_state (code)
+        list(store.names) + ["s_state"],
+    )
+    store_sales = Table(
+        list(store_sales.columns) + [
+            _int_col(rng.integers(0, max(num_sales // 8, 1), num_sales)),  # ss_ticket_number
+            _int_col(rng.integers(0, n_hdemo, num_sales)),  # ss_hdemo_sk
+            _int_col(rng.integers(0, n_times, num_sales)),  # ss_sold_time_sk
+        ],
+        list(store_sales.names) + ["ss_ticket_number", "ss_hdemo_sk", "ss_sold_time_sk"],
+    )
+    customer = Table(
+        list(customer.columns) + [_int_col(rng.permutation(n_cust))],  # c_customer_id
+        list(customer.names) + ["c_customer_id"],
+    )
+    household_demographics = Table(
+        [
+            _int_col(np.arange(n_hdemo)),  # hd_demo_sk
+            _int_col(rng.integers(0, 10, n_hdemo)),  # hd_dep_count
+            _int_col(rng.integers(0, 5, n_hdemo)),  # hd_vehicle_count
+            _int_col(rng.integers(0, 6, n_hdemo)),  # hd_buy_potential (code)
+        ],
+        ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count", "hd_buy_potential"],
+    )
+    time_dim = Table(  # one row per minute (deterministic, no rng cost)
+        [
+            _int_col(np.arange(n_times)),  # t_time_sk
+            _int_col(np.arange(n_times) // 60),  # t_hour
+            _int_col(np.arange(n_times) % 60),  # t_minute
+        ],
+        ["t_time_sk", "t_hour", "t_minute"],
+    )
+    date_dim = Table(  # derived day-of-week lane (deterministic)
+        list(date_dim.columns) + [_int_col(np.arange(n_dates) % 7)],
+        list(date_dim.names) + ["d_dow"],
+    )
     return {
         "store_sales": store_sales,
         "date_dim": date_dim,
@@ -187,6 +229,8 @@ def gen_store_wide(num_sales: int, seed: int = 42) -> Dict[str, Table]:
         "customer": customer,
         "customer_address": customer_address,
         "store": store,
+        "household_demographics": household_demographics,
+        "time_dim": time_dim,
     }
 
 
@@ -744,7 +788,26 @@ def gen_web(num_sales: int, seed: int = 7) -> Dict[str, Table]:
     returned = rng.choice(n_orders, size=max(n_orders // 10, 1), replace=False)
     web_returns = Table([_int_col(returned)], ["wr_order_number"])
     date_dim = Table([_int_col(np.arange(n_dates))], ["d_date_sk"])
-    return {"web_sales": web_sales, "web_returns": web_returns, "date_dim": date_dim}
+    # srjt-plan (ISSUE 14) extensions for the q92 family — drawn AFTER
+    # every pre-existing column, keeping the q94/q95 sequences intact
+    n_items = 200
+    web_sales = Table(
+        list(web_sales.columns) + [
+            _int_col(rng.integers(0, n_dates, num_sales)),  # ws_sold_date_sk
+            _int_col(rng.integers(0, n_items, num_sales)),  # ws_item_sk
+            _f64_col(rng.uniform(0, 100, num_sales).round(2)),  # ws_ext_discount_amt
+        ],
+        list(web_sales.names) + ["ws_sold_date_sk", "ws_item_sk", "ws_ext_discount_amt"],
+    )
+    item = Table(
+        [
+            _int_col(np.arange(n_items)),  # i_item_sk
+            _int_col(rng.integers(1, 100, n_items)),  # i_manufact_id
+        ],
+        ["i_item_sk", "i_manufact_id"],
+    )
+    return {"web_sales": web_sales, "web_returns": web_returns,
+            "date_dim": date_dim, "item": item}
 
 
 def q98(tables: Dict[str, Table], month: int = 11, year: int = 2000) -> Table:

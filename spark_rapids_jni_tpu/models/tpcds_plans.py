@@ -1,0 +1,763 @@
+"""TPC-DS queries expressed as LOGICAL PLANS (srjt-plan, ISSUE 14).
+
+Every query here was a QUERIES.md "lowers" entry — operator surface
+present, assembly missing — and goes green through the plan compiler
+ALONE: the function builds an IR tree that transliterates the SQL, and
+``plan.compile_ir`` performs the rewrites (decorrelation, ROLLUP
+expansion, set-op/EXISTS/HAVING lowering, pushdown) plus the fused
+``CompiledPipeline`` lowering that the hand-built greens in
+``models/tpcds.py`` encode by hand. Dictionary-coded int lanes stand in
+for string dimension values, as everywhere in this tier; parameter
+defaults are calibrated to the generators here, not to the spec's
+literals — the RELATIONAL SHAPE (which joins, which rewrites, which
+aggregates) is the part under test against pandas oracles.
+
+Two hand-built greens (q3, q55) are also re-expressed as plans
+(``q3_plan`` / ``q55_plan``): the compiler must reproduce their fused
+pipelines' outputs BIT-identically (tests/test_plan_queries.py pins
+it), which is the evidence the mechanical lowering matches the
+hand-fused originals.
+
+``PLAN_QUERIES`` is the registry the tests, the ledger, and the
+ci/premerge.sh compiler tier iterate: name -> (generator, plan builder,
+runner, default rows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+import numpy as np
+
+from .. import plan as P
+from ..columnar import Table
+from ..columnar import dtype as dt
+from .tpcds import _f64_col, _int_col, gen_store_wide, gen_web
+
+__all__ = [
+    "gen_store_returns", "gen_catalog", "gen_channels",
+    "q1", "q20", "q26", "q27", "q38", "q43", "q69", "q73", "q87", "q88",
+    "q92", "q96", "q3_plan", "q55_plan", "PLAN_QUERIES", "PlanQueryDef",
+]
+
+
+# ---------------------------------------------------------------------------
+# generators (star schemas the gen_store/gen_web family does not cover)
+# ---------------------------------------------------------------------------
+
+
+def gen_store_returns(num_returns: int, seed: int = 21) -> Dict[str, Table]:
+    """store_returns + date_dim + store + customer for the q1 family
+    (per-customer return totals vs the per-store average)."""
+    rng = np.random.default_rng(seed)
+    n_dates, n_store, n_cust = 365 * 3, 12, 1500
+    date_dim = Table(
+        [_int_col(np.arange(n_dates)), _int_col(1998 + np.arange(n_dates) // 365)],
+        ["d_date_sk", "d_year"],
+    )
+    store = Table(
+        [_int_col(np.arange(n_store)), _int_col(rng.integers(0, 8, n_store))],
+        ["s_store_sk", "s_state"],
+    )
+    customer = Table(
+        [_int_col(np.arange(n_cust)), _int_col(rng.permutation(n_cust))],
+        ["c_customer_sk", "c_customer_id"],
+    )
+    store_returns = Table(
+        [
+            _int_col(rng.integers(0, n_dates, num_returns)),  # sr_returned_date_sk
+            _int_col(rng.integers(0, n_cust, num_returns)),  # sr_customer_sk
+            _int_col(rng.integers(0, n_store, num_returns)),  # sr_store_sk
+            _f64_col(rng.uniform(1, 500, num_returns).round(2)),  # sr_return_amt
+        ],
+        ["sr_returned_date_sk", "sr_customer_sk", "sr_store_sk", "sr_return_amt"],
+    )
+    return {"store_returns": store_returns, "date_dim": date_dim,
+            "store": store, "customer": customer}
+
+
+def gen_catalog(num_sales: int, seed: int = 23) -> Dict[str, Table]:
+    """catalog_sales star for the q26 (q7 catalog twin) and q20
+    (partition-ratio reporting) shapes."""
+    rng = np.random.default_rng(seed)
+    n_dates, n_items, n_cdemo, n_promo = 365 * 5, 800, 150, 40
+    date_dim = Table(
+        [
+            _int_col(np.arange(n_dates)),
+            _int_col(1998 + np.arange(n_dates) // 365),
+            _int_col(1 + (np.arange(n_dates) % 365) // 31),
+        ],
+        ["d_date_sk", "d_year", "d_moy"],
+    )
+    item = Table(
+        [
+            _int_col(np.arange(n_items)),  # i_item_sk
+            _int_col(rng.permutation(n_items)),  # i_item_id
+            _int_col(rng.integers(1, 11, n_items)),  # i_category_id
+            _int_col(rng.integers(1, 30, n_items)),  # i_class_id
+        ],
+        ["i_item_sk", "i_item_id", "i_category_id", "i_class_id"],
+    )
+    customer_demographics = Table(
+        [
+            _int_col(np.arange(n_cdemo)),
+            _int_col(rng.integers(0, 2, n_cdemo)),  # cd_gender
+            _int_col(rng.integers(0, 5, n_cdemo)),  # cd_marital_status
+            _int_col(rng.integers(0, 7, n_cdemo)),  # cd_education_status
+        ],
+        ["cd_demo_sk", "cd_gender", "cd_marital_status", "cd_education_status"],
+    )
+    promotion = Table(
+        [
+            _int_col(np.arange(n_promo)),
+            _int_col(rng.integers(0, 2, n_promo)),  # p_channel_email
+            _int_col(rng.integers(0, 2, n_promo)),  # p_channel_event
+        ],
+        ["p_promo_sk", "p_channel_email", "p_channel_event"],
+    )
+    catalog_sales = Table(
+        [
+            _int_col(rng.integers(0, n_dates, num_sales)),  # cs_sold_date_sk
+            _int_col(rng.integers(0, n_items, num_sales)),  # cs_item_sk
+            _int_col(rng.integers(0, n_cdemo, num_sales)),  # cs_bill_cdemo_sk
+            _int_col(rng.integers(0, n_promo, num_sales)),  # cs_promo_sk
+            _int_col(rng.integers(1, 100, num_sales)),  # cs_quantity
+            _f64_col(rng.uniform(1, 200, num_sales).round(2)),  # cs_list_price
+            _f64_col(rng.uniform(0, 50, num_sales).round(2)),  # cs_coupon_amt
+            _f64_col(rng.uniform(1, 150, num_sales).round(2)),  # cs_sales_price
+            _f64_col(rng.uniform(1, 1000, num_sales).round(2)),  # cs_ext_sales_price
+        ],
+        [
+            "cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk", "cs_promo_sk",
+            "cs_quantity", "cs_list_price", "cs_coupon_amt", "cs_sales_price",
+            "cs_ext_sales_price",
+        ],
+    )
+    return {"catalog_sales": catalog_sales, "date_dim": date_dim, "item": item,
+            "customer_demographics": customer_demographics, "promotion": promotion}
+
+
+def gen_channels(num_rows: int, seed: int = 29) -> Dict[str, Table]:
+    """Three sales channels sharing one customer population — the
+    INTERSECT/EXCEPT (q38/q87) and EXISTS/NOT-EXISTS (q69) families."""
+    rng = np.random.default_rng(seed)
+    n_dates, n_cust, n_cdemo, n_addr = 365 * 3, 1200, 120, 300
+    date_dim = Table(
+        [
+            _int_col(np.arange(n_dates)),
+            _int_col(1998 + np.arange(n_dates) // 365),
+            _int_col(1 + (np.arange(n_dates) % 365) // 31),
+        ],
+        ["d_date_sk", "d_year", "d_moy"],
+    )
+    customer = Table(
+        [
+            _int_col(np.arange(n_cust)),
+            _int_col(rng.permutation(n_cust)),  # c_customer_id
+            _int_col(rng.integers(0, n_cdemo, n_cust)),  # c_current_cdemo_sk
+            _int_col(rng.integers(0, n_addr, n_cust)),  # c_current_addr_sk
+        ],
+        ["c_customer_sk", "c_customer_id", "c_current_cdemo_sk", "c_current_addr_sk"],
+    )
+    customer_address = Table(
+        [_int_col(np.arange(n_addr)), _int_col(rng.integers(0, 10, n_addr))],
+        ["ca_address_sk", "ca_state"],
+    )
+    customer_demographics = Table(
+        [
+            _int_col(np.arange(n_cdemo)),
+            _int_col(rng.integers(0, 2, n_cdemo)),  # cd_gender
+            _int_col(rng.integers(0, 5, n_cdemo)),  # cd_marital_status
+            _int_col(rng.integers(0, 7, n_cdemo)),  # cd_education_status
+        ],
+        ["cd_demo_sk", "cd_gender", "cd_marital_status", "cd_education_status"],
+    )
+
+    def fact(cust_col: str, date_col: str, n: int) -> Table:
+        return Table(
+            [_int_col(rng.integers(0, n_cust, n)), _int_col(rng.integers(0, n_dates, n))],
+            [cust_col, date_col],
+        )
+
+    return {
+        "date_dim": date_dim,
+        "customer": customer,
+        "customer_address": customer_address,
+        "customer_demographics": customer_demographics,
+        "store_sales": fact("ss_customer_sk", "ss_sold_date_sk", num_rows),
+        "web_sales": fact("ws_bill_customer_sk", "ws_sold_date_sk", max(num_rows // 2, 1)),
+        "catalog_sales": fact("cs_ship_customer_sk", "cs_sold_date_sk", max(num_rows // 2, 1)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# plan builders + runners
+# ---------------------------------------------------------------------------
+
+
+def _run(plan: P.Node, tables: Dict[str, Table], name: str) -> Table:
+    return P.compile_ir(plan, tables, name=name)()
+
+
+def q1_plan(year: int = 1998, state: int = 3) -> P.Node:
+    """TPC-DS q1 — the flagship decorrelation shape. SQL:
+
+        WITH customer_total_return AS (
+          SELECT sr_customer_sk, sr_store_sk,
+                 sum(sr_return_amt) ctr_total_return
+          FROM store_returns, date_dim
+          WHERE sr_returned_date_sk = d_date_sk AND d_year = :yr
+          GROUP BY sr_customer_sk, sr_store_sk)
+        SELECT c_customer_id
+        FROM customer_total_return ctr1, store, customer
+        WHERE ctr1.ctr_total_return >
+              (SELECT avg(ctr_total_return) * 1.2
+               FROM customer_total_return ctr2
+               WHERE ctr1.sr_store_sk = ctr2.sr_store_sk)
+          AND s_store_sk = ctr1.sr_store_sk AND s_state = :state
+          AND ctr1.sr_customer_sk = c_customer_sk
+        ORDER BY c_customer_id LIMIT 100
+
+    The CTE is ONE shared node used twice (the compiler evaluates it
+    once); the correlated average decorrelates to agg + join."""
+    ctr = P.Aggregate(
+        P.Join(
+            P.Scan("store_returns"),
+            P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+            on=(("sr_returned_date_sk", "d_date_sk"),), bounded=True,
+        ),
+        keys=("sr_customer_sk", "sr_store_sk"),
+        aggs=(P.AggSpec("sr_return_amt", "sum", "ctr_total_return"),),
+    )
+    x = P.CorrelatedAggFilter(
+        ctr, ctr, on=("sr_store_sk", "sr_store_sk"),
+        agg=P.AggSpec("ctr_total_return", "mean", "ctr_avg"),
+        predicate=P.pcol("ctr_total_return") > P.pcol("ctr_avg") * P.plit(1.2),
+    )
+    x = P.Join(x, P.Filter(P.Scan("store"), P.pcol("s_state") == P.plit(state)),
+               on=(("sr_store_sk", "s_store_sk"),))
+    x = P.Join(x, P.Scan("customer"), on=(("sr_customer_sk", "c_customer_sk"),))
+    x = P.Project(x, (("c_customer_id", P.pcol("c_customer_id")),))
+    return P.Limit(P.Sort(x, (("c_customer_id", True),)), 100)
+
+
+def q1(tables: Dict[str, Table], year: int = 1998, state: int = 3) -> Table:
+    return _run(q1_plan(year, state), tables, "q1")
+
+
+def q92_plan(manufact: int = 35, lo: int = 200, hi: int = 290) -> P.Node:
+    """TPC-DS q92 (excess discount amount) — decorrelate avg * 1.3. SQL:
+
+        SELECT sum(ws_ext_discount_amt)
+        FROM web_sales, item, date_dim
+        WHERE i_manufact_id = :m AND i_item_sk = ws_item_sk
+          AND d_date_sk = ws_sold_date_sk AND d_date BETWEEN :lo AND :hi
+          AND ws_ext_discount_amt >
+              (SELECT 1.3 * avg(ws_ext_discount_amt)
+               FROM web_sales, date_dim
+               WHERE ws_item_sk = i_item_sk
+                 AND d_date_sk = ws_sold_date_sk
+                 AND d_date BETWEEN :lo AND :hi)
+
+    The date-filtered web_sales is one shared node (fact side AND
+    subquery side); the decorrelated per-item average joins back as a
+    MATERIALIZED build inside the fused final aggregation."""
+    dated = P.Join(
+        P.Scan("web_sales"),
+        P.Filter(P.Scan("date_dim"),
+                 (P.pcol("d_date_sk") >= P.plit(lo))
+                 & (P.pcol("d_date_sk") <= P.plit(hi))),
+        on=(("ws_sold_date_sk", "d_date_sk"),), bounded=True,
+    )
+    main = P.Join(
+        dated,
+        P.Filter(P.Scan("item"), P.pcol("i_manufact_id") == P.plit(manufact)),
+        on=(("ws_item_sk", "i_item_sk"),), bounded=True,
+    )
+    x = P.CorrelatedAggFilter(
+        main, dated, on=("ws_item_sk", "ws_item_sk"),
+        agg=P.AggSpec("ws_ext_discount_amt", "mean", "avg_disc"),
+        predicate=P.pcol("ws_ext_discount_amt") > P.plit(1.3) * P.pcol("avg_disc"),
+    )
+    return P.Aggregate(x, keys=(),
+                       aggs=(P.AggSpec("ws_ext_discount_amt", "sum", "excess"),))
+
+
+def q92(tables, manufact: int = 35, lo: int = 200, hi: int = 290) -> Table:
+    return _run(q92_plan(manufact, lo, hi), tables, "q92")
+
+
+def q26_plan(gender: int = 1, marital: int = 2, education: int = 3,
+             year: int = 2000) -> P.Node:
+    """TPC-DS q26 — q7's catalog-channel twin: 4-way star with exact
+    FLOAT64/int AVG aggregates, fully fused. SQL:
+
+        SELECT i_item_id, avg(cs_quantity), avg(cs_list_price),
+               avg(cs_coupon_amt), avg(cs_sales_price)
+        FROM catalog_sales, customer_demographics, date_dim, item, promotion
+        WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+          AND cs_bill_cdemo_sk = cd_demo_sk AND cs_promo_sk = p_promo_sk
+          AND cd_gender = :g AND cd_marital_status = :m
+          AND cd_education_status = :e
+          AND (p_channel_email = 'N' OR p_channel_event = 'N')
+          AND d_year = :y
+        GROUP BY i_item_id ORDER BY i_item_id
+    """
+    x = P.Scan("catalog_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+               on=(("cs_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(
+        x,
+        P.Filter(P.Scan("customer_demographics"),
+                 (P.pcol("cd_gender") == P.plit(gender))
+                 & (P.pcol("cd_marital_status") == P.plit(marital))
+                 & (P.pcol("cd_education_status") == P.plit(education))),
+        on=(("cs_bill_cdemo_sk", "cd_demo_sk"),), bounded=True,
+    )
+    x = P.Join(
+        x,
+        P.Filter(P.Scan("promotion"),
+                 (P.pcol("p_channel_email") == P.plit(0))
+                 | (P.pcol("p_channel_event") == P.plit(0))),
+        on=(("cs_promo_sk", "p_promo_sk"),), bounded=True,
+    )
+    x = P.Join(x, P.Scan("item"), on=(("cs_item_sk", "i_item_sk"),), bounded=True)
+    agg = P.Aggregate(
+        x, keys=("i_item_id",),
+        aggs=(
+            P.AggSpec("cs_quantity", "mean", "agg1"),
+            P.AggSpec("cs_list_price", "mean", "agg2"),
+            P.AggSpec("cs_coupon_amt", "mean", "agg3"),
+            P.AggSpec("cs_sales_price", "mean", "agg4"),
+        ),
+    )
+    return P.Sort(agg, (("i_item_id", True),))
+
+
+def q26(tables, gender: int = 1, marital: int = 2, education: int = 3,
+        year: int = 2000) -> Table:
+    return _run(q26_plan(gender, marital, education, year), tables, "q26")
+
+
+def q20_plan(cats=(2, 5, 8), lo: int = 700, hi: int = 730) -> P.Node:
+    """TPC-DS q20 — the partition-sum-ratio reporting family (q12/q20/
+    q98) on the catalog channel: class revenue plus each class's share
+    of its category, via the window tier over a fused aggregation. SQL:
+
+        SELECT i_category_id, i_class_id, sum(cs_ext_sales_price) itemrevenue,
+               sum(cs_ext_sales_price) * 100 /
+                 sum(sum(cs_ext_sales_price)) OVER (PARTITION BY i_category_id)
+        FROM catalog_sales, item, date_dim
+        WHERE cs_item_sk = i_item_sk AND i_category_id IN (:a,:b,:c)
+          AND cs_sold_date_sk = d_date_sk AND d_date BETWEEN :lo AND :hi
+        GROUP BY i_category_id, i_class_id
+        ORDER BY i_category_id, revenueratio, i_class_id
+    """
+    in_list = None
+    for c in cats:
+        e = P.pcol("i_category_id") == P.plit(c)
+        in_list = e if in_list is None else (in_list | e)
+    x = P.Scan("catalog_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"),
+                           (P.pcol("d_date_sk") >= P.plit(lo))
+                           & (P.pcol("d_date_sk") <= P.plit(hi))),
+               on=(("cs_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, P.Filter(P.Scan("item"), in_list),
+               on=(("cs_item_sk", "i_item_sk"),), bounded=True)
+    agg = P.Aggregate(x, keys=("i_category_id", "i_class_id"),
+                      aggs=(P.AggSpec("cs_ext_sales_price", "sum", "itemrevenue"),))
+    w = P.Window(agg, partition_by=("i_category_id",), order_by=(),
+                 aggs=(("itemrevenue", "sum", "cat_total"),))
+    proj = P.Project(w, (
+        ("i_category_id", P.pcol("i_category_id")),
+        ("i_class_id", P.pcol("i_class_id")),
+        ("itemrevenue", P.pcol("itemrevenue")),
+        ("revenueratio",
+         (P.pcol("itemrevenue") * P.plit(100.0)) / P.pcol("cat_total")),
+    ))
+    return P.Sort(proj, (("i_category_id", True), ("revenueratio", True),
+                         ("i_class_id", True)))
+
+
+def q20(tables, cats=(2, 5, 8), lo: int = 700, hi: int = 730) -> Table:
+    return _run(q20_plan(cats, lo, hi), tables, "q20")
+
+
+def q27_plan(gender: int = 1, marital: int = 2, education: int = 3,
+             year: int = 2000, states=(1, 4, 7)) -> P.Node:
+    """TPC-DS q27 — ROLLUP over the store star: the optimizer expands
+    ``rollup(i_item_id, s_state)`` into a UnionAll of three fused
+    group-bys with null-filled rolled keys. SQL:
+
+        SELECT i_item_id, s_state, grouping(s_state),
+               avg(ss_quantity), avg(ss_list_price),
+               avg(ss_coupon_amt), avg(ss_sales_price)
+        FROM store_sales, customer_demographics, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+          AND cd_gender = :g AND cd_marital_status = :m
+          AND cd_education_status = :e AND d_year = :y
+          AND s_state IN (:states)
+        GROUP BY ROLLUP(i_item_id, s_state)
+    """
+    in_states = None
+    for s in states:
+        e = P.pcol("s_state") == P.plit(s)
+        in_states = e if in_states is None else (in_states | e)
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(
+        x,
+        P.Filter(P.Scan("customer_demographics"),
+                 (P.pcol("cd_gender") == P.plit(gender))
+                 & (P.pcol("cd_marital_status") == P.plit(marital))
+                 & (P.pcol("cd_education_status") == P.plit(education))),
+        on=(("ss_cdemo_sk", "cd_demo_sk"),), bounded=True,
+    )
+    x = P.Join(x, P.Filter(P.Scan("store"), in_states),
+               on=(("ss_store_sk", "s_store_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("item"), on=(("ss_item_sk", "i_item_sk"),), bounded=True)
+    return P.Aggregate(
+        x, keys=("i_item_id", "s_state"),
+        aggs=(
+            P.AggSpec("ss_quantity", "mean", "agg1"),
+            P.AggSpec("ss_list_price", "mean", "agg2"),
+            P.AggSpec("ss_coupon_amt", "mean", "agg3"),
+            P.AggSpec("ss_sales_price", "mean", "agg4"),
+        ),
+        grouping_sets=P.rollup("i_item_id", "s_state"),
+    )
+
+
+def q27(tables, gender: int = 1, marital: int = 2, education: int = 3,
+        year: int = 2000, states=(1, 4, 7)) -> Table:
+    return _run(q27_plan(gender, marital, education, year, states), tables, "q27")
+
+
+def q43_plan(year: int = 2000) -> P.Node:
+    """TPC-DS q43 — the day-name CASE pivot: per-store weekly sales
+    matrix via seven CASE-WHEN projections summed in ONE fused program.
+    SQL shape:
+
+        SELECT s_store_sk,
+               sum(CASE WHEN d_dow = 0 THEN ss_sales_price END) sun_sales,
+               ... (mon..sat) ...
+        FROM date_dim, store_sales
+        WHERE d_date_sk = ss_sold_date_sk AND d_year = :y
+        GROUP BY s_store_sk(-> ss_store_sk code) ORDER BY s_store_sk
+    """
+    x = P.Join(
+        P.Scan("store_sales"),
+        P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+        on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True,
+    )
+    days = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+    exprs = [("ss_store_sk", P.pcol("ss_store_sk"))]
+    for i, day in enumerate(days):
+        exprs.append((
+            f"{day}_sales",
+            P.pwhen(P.pcol("d_dow") == P.plit(i), P.pcol("ss_sales_price"),
+                    P.plit(None, dt.FLOAT64)),
+        ))
+    proj = P.Project(x, tuple(exprs))
+    agg = P.Aggregate(
+        proj, keys=("ss_store_sk",),
+        aggs=tuple(P.AggSpec(f"{d}_sales", "sum", f"{d}_sales_sum") for d in days),
+    )
+    return P.Sort(agg, (("ss_store_sk", True),))
+
+
+def q43(tables, year: int = 2000) -> Table:
+    return _run(q43_plan(year), tables, "q43")
+
+
+def q88_plan(deps=(2, 7), hours=(8, 9, 10, 11)) -> P.Node:
+    """TPC-DS q88 — eight half-hour time-band store traffic counts, one
+    fused global-count star per band, UNION ALLed into a (band, cnt)
+    report. SQL shape (per band):
+
+        SELECT count(*) FROM store_sales, household_demographics, time_dim
+        WHERE ss_sold_time_sk = t_time_sk AND ss_hdemo_sk = hd_demo_sk
+          AND t_hour = :h AND t_minute [< | >=] 30
+          AND (hd_dep_count = :d1 OR hd_dep_count = :d2)
+    """
+    hd_filter = ((P.pcol("hd_dep_count") == P.plit(deps[0]))
+                 | (P.pcol("hd_dep_count") == P.plit(deps[1])))
+    branches = []
+    band = 0
+    for h in hours:
+        for half in (0, 1):
+            tf = P.pcol("t_hour") == P.plit(h)
+            tf = tf & ((P.pcol("t_minute") < P.plit(30)) if half == 0
+                       else (P.pcol("t_minute") >= P.plit(30)))
+            x = P.Scan("store_sales")
+            x = P.Join(x, P.Filter(P.Scan("time_dim"), tf),
+                       on=(("ss_sold_time_sk", "t_time_sk"),), bounded=True)
+            x = P.Join(x, P.Filter(P.Scan("household_demographics"), hd_filter),
+                       on=(("ss_hdemo_sk", "hd_demo_sk"),), bounded=True)
+            agg = P.Aggregate(x, keys=(), aggs=(P.AggSpec(None, "count_all", "cnt"),))
+            branches.append(P.Project(agg, (
+                ("band", P.plit(np.int32(band))), ("cnt", P.pcol("cnt")),
+            )))
+            band += 1
+    return P.UnionAll(tuple(branches))
+
+
+def q88(tables, deps=(2, 7), hours=(8, 9, 10, 11)) -> Table:
+    return _run(q88_plan(deps, hours), tables, "q88")
+
+
+def q96_plan(hour: int = 20, dep: int = 5) -> P.Node:
+    """TPC-DS q96 — one half-hour demographic count (q88's single-band
+    sibling), a fused global COUNT(*) star. SQL:
+
+        SELECT count(*) FROM store_sales, household_demographics, time_dim
+        WHERE ss_sold_time_sk = t_time_sk AND ss_hdemo_sk = hd_demo_sk
+          AND t_hour = :h AND t_minute >= 30 AND hd_dep_count = :d
+    """
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("time_dim"),
+                           (P.pcol("t_hour") == P.plit(hour))
+                           & (P.pcol("t_minute") >= P.plit(30))),
+               on=(("ss_sold_time_sk", "t_time_sk"),), bounded=True)
+    x = P.Join(x, P.Filter(P.Scan("household_demographics"),
+                           P.pcol("hd_dep_count") == P.plit(dep)),
+               on=(("ss_hdemo_sk", "hd_demo_sk"),), bounded=True)
+    return P.Aggregate(x, keys=(), aggs=(P.AggSpec(None, "count_all", "cnt"),))
+
+
+def q96(tables, hour: int = 20, dep: int = 5) -> Table:
+    return _run(q96_plan(hour, dep), tables, "q96")
+
+
+def _channel_customers(fact: str, cust_col: str, year: int, moy_lo: int,
+                       moy_hi: int) -> P.Node:
+    """Customer ids active on one channel inside a month window — the
+    shared branch of the q38/q87 set-op chains."""
+    x = P.Join(
+        P.Scan(fact),
+        P.Filter(P.Scan("date_dim"),
+                 (P.pcol("d_year") == P.plit(year))
+                 & (P.pcol("d_moy") >= P.plit(moy_lo))
+                 & (P.pcol("d_moy") <= P.plit(moy_hi))),
+        on=((f"{cust_col[:2]}_sold_date_sk", "d_date_sk"),), bounded=True,
+    )
+    x = P.Join(x, P.Scan("customer"), on=((cust_col, "c_customer_sk"),),
+               bounded=True)
+    return P.Project(x, (("c_customer_id", P.pcol("c_customer_id")),))
+
+
+def q38_plan(year: int = 1999, moy_lo: int = 1, moy_hi: int = 7) -> P.Node:
+    """TPC-DS q38 — INTERSECT chain: customers active on ALL THREE
+    channels in the window; the optimizer lowers both INTERSECTs to
+    semi-joins on deduplicated keys. SQL shape:
+
+        SELECT count(*) FROM (
+          SELECT c_customer_id FROM store_sales, date_dim, customer WHERE ...
+          INTERSECT SELECT ... FROM catalog_sales ...
+          INTERSECT SELECT ... FROM web_sales ...) hot_cust
+    """
+    s = _channel_customers("store_sales", "ss_customer_sk", year, moy_lo, moy_hi)
+    c = _channel_customers("catalog_sales", "cs_ship_customer_sk", year, moy_lo, moy_hi)
+    w = _channel_customers("web_sales", "ws_bill_customer_sk", year, moy_lo, moy_hi)
+    chain = P.SetOp(P.SetOp(s, c, "intersect"), w, "intersect")
+    return P.Aggregate(chain, keys=(), aggs=(P.AggSpec(None, "count_all", "cnt"),))
+
+
+def q38(tables, year: int = 1999, moy_lo: int = 1, moy_hi: int = 7) -> Table:
+    return _run(q38_plan(year, moy_lo, moy_hi), tables, "q38")
+
+
+def q87_plan(year: int = 1999, moy_lo: int = 1, moy_hi: int = 7) -> P.Node:
+    """TPC-DS q87 — the EXCEPT twin of q38: store customers with NO
+    catalog and NO web activity in the window (anti-joins on deduped
+    keys)."""
+    s = _channel_customers("store_sales", "ss_customer_sk", year, moy_lo, moy_hi)
+    c = _channel_customers("catalog_sales", "cs_ship_customer_sk", year, moy_lo, moy_hi)
+    w = _channel_customers("web_sales", "ws_bill_customer_sk", year, moy_lo, moy_hi)
+    chain = P.SetOp(P.SetOp(s, c, "except"), w, "except")
+    return P.Aggregate(chain, keys=(), aggs=(P.AggSpec(None, "count_all", "cnt"),))
+
+
+def q87(tables, year: int = 1999, moy_lo: int = 1, moy_hi: int = 7) -> Table:
+    return _run(q87_plan(year, moy_lo, moy_hi), tables, "q87")
+
+
+def q69_plan(states=(2, 5, 8), year: int = 1999, moy_lo: int = 1,
+             moy_hi: int = 3) -> P.Node:
+    """TPC-DS q69 — demographic counts of customers with store activity
+    but NO web/catalog activity in the window: one EXISTS plus two NOT
+    EXISTS, all lowered to semi/anti joins that FUSE into the one
+    compiled program over the customer table (the subquery sides
+    materialize as build tables). SQL shape:
+
+        SELECT cd_gender, cd_marital_status, cd_education_status, count(*)
+        FROM customer c, customer_address ca, customer_demographics
+        WHERE c_current_addr_sk = ca_address_sk AND ca_state IN (:states)
+          AND cd_demo_sk = c_current_cdemo_sk
+          AND EXISTS (SELECT * FROM store_sales, date_dim WHERE ...)
+          AND NOT EXISTS (SELECT * FROM web_sales, date_dim WHERE ...)
+          AND NOT EXISTS (SELECT * FROM catalog_sales, date_dim WHERE ...)
+        GROUP BY cd_gender, cd_marital_status, cd_education_status
+        ORDER BY cd_gender, cd_marital_status, cd_education_status
+    """
+    in_states = None
+    for s in states:
+        e = P.pcol("ca_state") == P.plit(s)
+        in_states = e if in_states is None else (in_states | e)
+    dates = P.Filter(P.Scan("date_dim"),
+                     (P.pcol("d_year") == P.plit(year))
+                     & (P.pcol("d_moy") >= P.plit(moy_lo))
+                     & (P.pcol("d_moy") <= P.plit(moy_hi)))
+
+    def active(fact: str, cust_col: str) -> P.Node:
+        prefix = cust_col.split("_")[0]
+        return P.Join(P.Scan(fact), dates,
+                      on=((f"{prefix}_sold_date_sk", "d_date_sk"),), bounded=True)
+
+    x = P.Join(P.Scan("customer"),
+               P.Filter(P.Scan("customer_address"), in_states),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer_demographics"),
+               on=(("c_current_cdemo_sk", "cd_demo_sk"),), bounded=True)
+    x = P.Exists(x, active("store_sales", "ss_customer_sk"),
+                 on=(("c_customer_sk", "ss_customer_sk"),))
+    x = P.Exists(x, active("web_sales", "ws_bill_customer_sk"),
+                 on=(("c_customer_sk", "ws_bill_customer_sk"),), negated=True)
+    x = P.Exists(x, active("catalog_sales", "cs_ship_customer_sk"),
+                 on=(("c_customer_sk", "cs_ship_customer_sk"),), negated=True)
+    agg = P.Aggregate(
+        x, keys=("cd_gender", "cd_marital_status", "cd_education_status"),
+        aggs=(P.AggSpec(None, "count_all", "cnt"),),
+    )
+    return P.Sort(agg, (("cd_gender", True), ("cd_marital_status", True),
+                        ("cd_education_status", True)))
+
+
+def q69(tables, states=(2, 5, 8), year: int = 1999, moy_lo: int = 1,
+        moy_hi: int = 3) -> Table:
+    return _run(q69_plan(states, year, moy_lo, moy_hi), tables, "q69")
+
+
+def q73_plan(year: int = 2000, buys=(1, 4), lo: int = 1, hi: int = 2) -> P.Node:
+    """TPC-DS q73 — the HAVING count band: per-(ticket, customer) item
+    counts filtered to a band, joined back to customer. The inner
+    aggregation fuses; HAVING lowers to a post-aggregate Filter; the
+    join-back runs on the (small) aggregate output. SQL shape:
+
+        SELECT c_customer_id, cnt FROM (
+          SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+          FROM store_sales, date_dim, household_demographics
+          WHERE ss_sold_date_sk = d_date_sk AND ss_hdemo_sk = hd_demo_sk
+            AND d_year = :y AND hd_buy_potential IN (:b1, :b2)
+          GROUP BY ss_ticket_number, ss_customer_sk) dj, customer
+        WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN :lo AND :hi
+        ORDER BY cnt DESC, c_customer_id
+    """
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(
+        x,
+        P.Filter(P.Scan("household_demographics"),
+                 (P.pcol("hd_buy_potential") == P.plit(buys[0]))
+                 | (P.pcol("hd_buy_potential") == P.plit(buys[1]))),
+        on=(("ss_hdemo_sk", "hd_demo_sk"),), bounded=True,
+    )
+    agg = P.Aggregate(x, keys=("ss_ticket_number", "ss_customer_sk"),
+                      aggs=(P.AggSpec(None, "count_all", "cnt"),))
+    hv = P.Having(agg, (P.pcol("cnt") >= P.plit(lo)) & (P.pcol("cnt") <= P.plit(hi)))
+    j = P.Join(hv, P.Scan("customer"), on=(("ss_customer_sk", "c_customer_sk"),))
+    proj = P.Project(j, (("c_customer_id", P.pcol("c_customer_id")),
+                         ("cnt", P.pcol("cnt"))))
+    return P.Sort(proj, (("cnt", False), ("c_customer_id", True)))
+
+
+def q73(tables, year: int = 2000, buys=(1, 4), lo: int = 1, hi: int = 2) -> Table:
+    return _run(q73_plan(year, buys, lo, hi), tables, "q73")
+
+
+# ---------------------------------------------------------------------------
+# hand-built greens re-expressed as plans (bit-identity contract)
+# ---------------------------------------------------------------------------
+
+
+def q3_plan(manufact_id: int = 128, month: int = 11) -> P.Node:
+    """``models/tpcds.py::q3`` as IR: same dense bounded-domain star
+    joins, same group keys, same ORDER BY — the compiled plan's output
+    must be BIT-identical to the hand-fused original."""
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_moy") == P.plit(month)),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, P.Filter(P.Scan("item"),
+                           P.pcol("i_manufact_id") == P.plit(manufact_id)),
+               on=(("ss_item_sk", "i_item_sk"),), bounded=True)
+    agg = P.Aggregate(
+        x, keys=("d_year", "i_brand_id"),
+        aggs=(P.AggSpec("ss_ext_sales_price", "sum", "ss_ext_sales_price_sum"),),
+    )
+    return P.Sort(agg, (("d_year", True), ("ss_ext_sales_price_sum", False),
+                        ("i_brand_id", True)))
+
+
+def q55_plan(manager_id: int = 28, month: int = 11, year: int = 1999) -> P.Node:
+    """``models/tpcds.py::q55`` as IR: the sort-merge star (no bounded
+    hint, matching the hand pipeline's num_keys=None lowering)."""
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"),
+                           (P.pcol("d_moy") == P.plit(month))
+                           & (P.pcol("d_year") == P.plit(year))),
+               on=(("ss_sold_date_sk", "d_date_sk"),))
+    x = P.Join(x, P.Filter(P.Scan("item"),
+                           P.pcol("i_manager_id") == P.plit(manager_id)),
+               on=(("ss_item_sk", "i_item_sk"),))
+    agg = P.Aggregate(x, keys=("i_brand_id",),
+                      aggs=(P.AggSpec("ss_ext_sales_price", "sum", "ext_price"),))
+    return P.Sort(agg, (("ext_price", False), ("i_brand_id", True)))
+
+
+# ---------------------------------------------------------------------------
+# registry (tests, ledger, and the premerge compiler tier iterate this)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanQueryDef:
+    name: str
+    gen: Callable[[int, int], Dict[str, Table]]
+    plan: Callable[[], "P.Node"]
+    run: Callable[[Dict[str, Table]], Table]
+    rows: int  # default oracle scale
+
+
+PLAN_QUERIES: Dict[str, PlanQueryDef] = {
+    d.name: d
+    for d in (
+        PlanQueryDef("q1", lambda n, s=21: gen_store_returns(n, seed=s),
+                     q1_plan, q1, 8000),
+        PlanQueryDef("q20", lambda n, s=23: gen_catalog(n, seed=s),
+                     q20_plan, q20, 10000),
+        PlanQueryDef("q26", lambda n, s=23: gen_catalog(n, seed=s),
+                     q26_plan, q26, 10000),
+        PlanQueryDef("q27", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q27_plan, q27, 10000),
+        PlanQueryDef("q38", lambda n, s=29: gen_channels(n, seed=s),
+                     q38_plan, q38, 6000),
+        PlanQueryDef("q43", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q43_plan, q43, 10000),
+        PlanQueryDef("q69", lambda n, s=29: gen_channels(n, seed=s),
+                     q69_plan, q69, 6000),
+        PlanQueryDef("q73", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q73_plan, q73, 10000),
+        PlanQueryDef("q87", lambda n, s=29: gen_channels(n, seed=s),
+                     q87_plan, q87, 6000),
+        PlanQueryDef("q88", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q88_plan, q88, 10000),
+        PlanQueryDef("q92", lambda n, s=7: gen_web(n, seed=s),
+                     q92_plan, q92, 8000),
+        PlanQueryDef("q96", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q96_plan, q96, 10000),
+    )
+}

@@ -1,0 +1,69 @@
+"""srjt-plan: logical-plan IR + rewrite passes + compiler (ISSUE 14).
+
+The front-end that turns QUERIES.md "lowers" green mechanically: express
+a TPC-DS query as a small relational-algebra tree (``nodes``), with a
+typed expression layer (``exprs``); the optimizer (``rewrites``) applies
+the standard executor expansions (decorrelation, ROLLUP, set ops,
+EXISTS, HAVING, predicate/projection pushdown); the compiler
+(``compiler``) lowers the optimized plan onto the fused
+``CompiledPipeline`` tier where the grammar allows and the tested
+``ops/`` operators elsewhere, carrying per-stage ``memory_bytes``
+estimates for memgov admission and the serve scheduler.
+
+Quick shape::
+
+    from spark_rapids_jni_tpu import plan as P
+
+    ir = P.Sort(
+        P.Aggregate(
+            P.Join(P.Scan("fact"), P.Filter(P.Scan("dim"),
+                   P.pcol("d_moy") == P.plit(11)),
+                   on=(("f_date_sk", "d_date_sk"),)),
+            keys=("f_key",),
+            aggs=(P.AggSpec("f_price", "sum", "total"),),
+        ),
+        keys=(("total", False),),
+    )
+    out = P.compile_ir(ir, {"fact": fact, "dim": dim}, name="demo")()
+"""
+
+from .compiler import CompiledPlan, compile_ir  # noqa: F401
+from .exprs import (  # noqa: F401
+    PExpr,
+    PlanError,
+    pcol,
+    plike,
+    plit,
+    prlike,
+    pwhen,
+)
+from .nodes import (  # noqa: F401
+    Aggregate,
+    AggSpec,
+    CorrelatedAggFilter,
+    Exists,
+    Filter,
+    Having,
+    Join,
+    Limit,
+    Node,
+    Project,
+    Scan,
+    SetOp,
+    Sort,
+    UnionAll,
+    Window,
+    infer_schema,
+    rollup,
+    structure,
+)
+from .rewrites import RewriteResult, prune_columns, rewrite  # noqa: F401
+
+__all__ = [
+    "CompiledPlan", "compile_ir",
+    "PExpr", "PlanError", "pcol", "plit", "pwhen", "plike", "prlike",
+    "Node", "Scan", "Filter", "Project", "Join", "Aggregate", "AggSpec",
+    "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
+    "CorrelatedAggFilter", "rollup", "infer_schema", "structure",
+    "rewrite", "prune_columns", "RewriteResult",
+]

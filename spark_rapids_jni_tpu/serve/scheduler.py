@@ -398,11 +398,28 @@ class Scheduler:
         query's footprint with the memory governor when it is armed
         (inner op boundaries then skip their own admission, the
         standard nesting discipline)."""
+        plan_node = None
         if not callable(fn):
-            raise TypeError(
-                f"submit() needs a callable or compiled pipeline, "
-                f"got {type(fn).__name__}"
-            )
+            # srjt-plan (ISSUE 14): a logical-plan node is submittable
+            # directly, with the bound tables dict as the sole
+            # positional argument. Only TYPE-validated here — the
+            # compile itself (rewrite fixpoint + host domain scans)
+            # runs after the cheap pre-admission shed checks below, so
+            # a breaker/injected/dead-budget shed never pays it.
+            from ..plan import Node as _PlanNode
+
+            if isinstance(fn, _PlanNode):
+                if len(args) != 1 or not isinstance(args[0], dict):
+                    raise TypeError(
+                        "submitting a logical plan requires the bound "
+                        "tables dict as the only positional argument"
+                    )
+                plan_node = fn
+            else:
+                raise TypeError(
+                    f"submit() needs a callable, a compiled pipeline, or "
+                    f"a logical plan, got {type(fn).__name__}"
+                )
         tenant = str(tenant)
         # srjt-trace (ISSUE 12): the root trace opens AT SUBMIT so the
         # queue wait is inside the query's span tree, and so every shed
@@ -467,6 +484,23 @@ class Scheduler:
                 f"{'cancelled' if outer is not None and outer.cancelled() else 'exhausted'} "
                 "at submit)", "doa_deadline",
             )
+        if plan_node is not None:
+            # compile NOW, after the pre-admission sheds: the plan's
+            # stage estimates must exist before queueing (memgov
+            # pre-admission and the overload controller consume
+            # memory_bytes), so the compile cannot move into the
+            # dispatch slot — but the XLA compile itself is lazy
+            # (first __call__), so the slot still pays that part
+            from ..plan import compile_ir as _compile_ir
+
+            fn = _compile_ir(plan_node, args[0], name=f"serve.{tenant}")
+            args = ()
+        if memory_bytes is None:
+            # plan-derived pre-admission (ROADMAP item-2 follow-up):
+            # compiled plans carry per-stage estimates — the scheduler's
+            # memgov pre-admission and the overload controller see a
+            # real footprint instead of a hand-fed number
+            memory_bytes = getattr(fn, "estimated_memory_bytes", None)
         shed_exc: Optional[Overloaded] = None
         victim: Optional[QueryHandle] = None
         victim_cause: Optional[str] = None

@@ -485,6 +485,13 @@ declare("SRJT_RESULTS", "str", None,
         "bench drivers append BENCH/JSONL result rows to this path",
         scope="harness")
 
+# plan compiler (plan/, ISSUE 14)
+declare("SRJT_PLAN_REPORT", "str", None,
+        "append one JSON line per compiled-plan execution (node counts, "
+        "rewrites fired, per-stage estimate-vs-actual bytes) to this "
+        "path — the ci/premerge.sh compiler tier's artifact source",
+        scope="harness")
+
 # correctness tooling (analysis/, ISSUE 7)
 declare("SRJT_LOCKDEP", "bool", False,
         "arm the runtime lock-order instrumentation "

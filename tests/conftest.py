@@ -181,6 +181,19 @@ _SLOW_TESTS = {
     "test_table_ops.py::test_distributed_join_string_key",
     "test_table_ops.py::test_memory_budget_split_retry",
     "test_table_ops.py::test_q95_distributed_matches_single_chip",
+    # the plan-compiler oracle tier's heavy tail (each test pays one
+    # or more fused-pipeline XLA compiles; the 5-8 s trio rides along
+    # because round 14 measured the fast tier at 842 s of the 870 s
+    # harness ceiling — margin beats calibration purity there);
+    # ci/premerge.sh runs the whole file env-armed in the dedicated
+    # compiler tier (no slow filter there), nightly runs it too
+    "test_plan_queries.py::TestRollupHaving::test_q27_rollup_matches_oracle",
+    "test_plan_queries.py::TestSetOpsExists::test_q38_intersect_chain",
+    "test_plan_queries.py::TestDecorrelation::test_q1_matches_oracle",
+    "test_plan_queries.py::TestFusedStars::test_q43_case_pivot_matches_oracle",
+    "test_plan_queries.py::TestFusedStars::test_q26_matches_exact_oracle",
+    "test_plan_queries.py::TestSetOpsExists::test_q69_exists_chain_matches_oracle",
+    "test_plan_queries.py::TestWindowRatio::test_q20_matches_oracle",
 }
 
 

@@ -1,0 +1,485 @@
+"""srjt-plan acceptance tier: the previously-"lowers" TPC-DS queries in
+models/tpcds_plans.py go green against pandas/Fraction oracles VIA THE
+COMPILER ALONE; the two hand-built greens re-expressed as plans (q3,
+q55) must be BIT-identical to their fused originals; every green plan's
+inferred schema must match its executed dtypes; and every plan's
+rewrite pass must be idempotent (applied twice == applied once)."""
+
+import math
+from fractions import Fraction
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_jni_tpu import plan as P
+from spark_rapids_jni_tpu.models import tpcds
+from spark_rapids_jni_tpu.models import tpcds_plans as tp
+
+
+def _f64(col):
+    return np.asarray(col.data).view(np.float64)
+
+
+def _i(col):
+    return np.asarray(col.data)
+
+
+def _exact_mean(values):
+    vals = list(values)
+    return float(sum(Fraction(v) for v in vals) / len(vals))
+
+
+def _run_checked(name: str, tables):
+    """Compile + run one registry query, asserting the schema contract
+    (inferred dtypes == executed dtypes) and a sane report on the way —
+    the satellite assertions every green plan must carry."""
+    d = tp.PLAN_QUERIES[name]
+    cp = P.compile_ir(d.plan(), tables, name=name)
+    out = cp()
+    got = {n: c.dtype for n, c in zip(out.names, out.columns)}
+    assert got == cp.schema, f"{name}: inferred schema != executed dtypes"
+    rep = cp.last_report
+    assert rep["nodes_raw"] > 0 and rep["nodes_optimized"] > 0
+    assert rep["est_peak_bytes"] > 0
+    assert rep["peak_blowup"] is None or rep["peak_blowup"] <= 4.0, rep
+    return out, cp
+
+
+def test_rewrite_idempotence_every_green_plan():
+    """Each registry plan: rewrite(rewrite(p)) == rewrite(p), and the
+    second pass fires no sugar rules (cheap — no execution)."""
+    for name, d in tp.PLAN_QUERIES.items():
+        tabs = d.gen(64)
+        catalog = {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
+                   for t, tbl in tabs.items()}
+        once = P.rewrite(d.plan(), catalog)
+        twice = P.rewrite(once.plan, catalog)
+        assert P.structure(once.plan) == P.structure(twice.plan), name
+        for sugar in ("decorrelate_scalar_agg", "expand_grouping_sets",
+                      "setop_to_joins", "exists_to_semijoin",
+                      "having_to_filter"):
+            assert not twice.fired.get(sugar), (name, sugar, twice.fired)
+
+
+class TestBitIdentity:
+    """Hand-built greens re-expressed as plans: the compiler must
+    reproduce the fused originals bit for bit."""
+
+    def test_q3_plan_bit_identical_to_hand_fused(self):
+        tabs = tpcds.gen_store(10_000, seed=11)
+        hand = tpcds.q3(tabs)
+        cp = P.compile_ir(tp.q3_plan(), tabs, name="q3")
+        planned = cp()
+        assert planned.names == hand.names
+        assert cp.last_report["fused_stages"] == 1
+        for n in hand.names:
+            np.testing.assert_array_equal(
+                np.asarray(hand.column(n).data), np.asarray(planned.column(n).data),
+                err_msg=f"q3 column {n} diverged from the hand-fused original")
+
+    def test_q55_plan_bit_identical_to_hand_fused(self):
+        tabs = tpcds.gen_store(10_000, seed=12)
+        hand = tpcds.q55(tabs)
+        cp = P.compile_ir(tp.q55_plan(), tabs, name="q55")
+        planned = cp()
+        assert planned.names == hand.names
+        assert cp.last_report["fused_stages"] == 1
+        for n in hand.names:
+            np.testing.assert_array_equal(
+                np.asarray(hand.column(n).data), np.asarray(planned.column(n).data),
+                err_msg=f"q55 column {n} diverged from the hand-fused original")
+
+
+class TestDecorrelation:
+    def test_q1_matches_oracle(self):
+        tabs = tp.gen_store_returns(8000)
+        out, cp = _run_checked("q1", tabs)
+        assert cp.last_report["rewrites"].get("decorrelate_scalar_agg") == 1
+
+        sr = tabs["store_returns"]
+        df = pd.DataFrame({
+            "d": _i(sr.column("sr_returned_date_sk")),
+            "cust": _i(sr.column("sr_customer_sk")),
+            "store": _i(sr.column("sr_store_sk")),
+            "amt": _f64(sr.column("sr_return_amt")),
+        })
+        dd = pd.DataFrame({"d": _i(tabs["date_dim"].column("d_date_sk")),
+                           "y": _i(tabs["date_dim"].column("d_year"))})
+        df = df.merge(dd[dd.y == 1998], on="d")
+        ctr = {}
+        for (c, s), g in df.groupby(["cust", "store"]):
+            ctr[(c, s)] = math.fsum(g.amt.tolist())
+        per_store = {}
+        for (c, s), v in ctr.items():
+            per_store.setdefault(s, []).append(v)
+        avg = {s: _exact_mean(v) for s, v in per_store.items()}
+        st = tabs["store"]
+        states = dict(zip(_i(st.column("s_store_sk")).tolist(),
+                          _i(st.column("s_state")).tolist()))
+        cid = dict(zip(_i(tabs["customer"].column("c_customer_sk")).tolist(),
+                       _i(tabs["customer"].column("c_customer_id")).tolist()))
+        keep = [cid[c] for (c, s), v in ctr.items()
+                if states[s] == 3 and v > avg[s] * 1.2]
+        want = sorted(keep)[:100]
+        assert _i(out.column("c_customer_id")).tolist() == want
+
+    def test_q92_matches_oracle(self):
+        tabs = tpcds.gen_web(8000)
+        out, cp = _run_checked("q92", tabs)
+        assert cp.last_report["rewrites"].get("decorrelate_scalar_agg") == 1
+        assert cp.last_report["fused_stages"] >= 1  # materialized-build fuse
+
+        ws = tabs["web_sales"]
+        df = pd.DataFrame({
+            "d": _i(ws.column("ws_sold_date_sk")),
+            "i": _i(ws.column("ws_item_sk")),
+            "disc": _f64(ws.column("ws_ext_discount_amt")),
+        })
+        dated = df[(df.d >= 200) & (df.d <= 290)]
+        avg = {i: _exact_mean(g.disc.tolist()) for i, g in dated.groupby("i")}
+        it = tabs["item"]
+        manu = dict(zip(_i(it.column("i_item_sk")).tolist(),
+                        _i(it.column("i_manufact_id")).tolist()))
+        kept = [r.disc for r in dated.itertuples()
+                if manu[r.i] == 35 and r.disc > 1.3 * avg[r.i]]
+        want = math.fsum(kept)
+        got = _f64(out.column("excess"))
+        if kept:
+            assert got[0] == want
+        else:
+            assert out.column("excess").validity is not None
+
+
+class TestFusedStars:
+    def test_q26_matches_exact_oracle(self):
+        tabs = tp.gen_catalog(10_000)
+        out, cp = _run_checked("q26", tabs)
+        assert cp.last_report["fused_stages"] == 1
+
+        cs = tabs["catalog_sales"]
+        df = pd.DataFrame({
+            "d": _i(cs.column("cs_sold_date_sk")),
+            "i": _i(cs.column("cs_item_sk")),
+            "cd": _i(cs.column("cs_bill_cdemo_sk")),
+            "pr": _i(cs.column("cs_promo_sk")),
+            "qty": _i(cs.column("cs_quantity")),
+            "list": _f64(cs.column("cs_list_price")),
+            "coup": _f64(cs.column("cs_coupon_amt")),
+            "sales": _f64(cs.column("cs_sales_price")),
+        })
+        dd = tabs["date_dim"]
+        cdt = tabs["customer_demographics"]
+        prt = tabs["promotion"]
+        it = tabs["item"]
+        j = (df.merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                                    "y": _i(dd.column("d_year"))}), on="d")
+             .merge(pd.DataFrame({"cd": _i(cdt.column("cd_demo_sk")),
+                                  "g": _i(cdt.column("cd_gender")),
+                                  "ms": _i(cdt.column("cd_marital_status")),
+                                  "ed": _i(cdt.column("cd_education_status"))}), on="cd")
+             .merge(pd.DataFrame({"pr": _i(prt.column("p_promo_sk")),
+                                  "em": _i(prt.column("p_channel_email")),
+                                  "ev": _i(prt.column("p_channel_event"))}), on="pr")
+             .merge(pd.DataFrame({"i": _i(it.column("i_item_sk")),
+                                  "id": _i(it.column("i_item_id"))}), on="i"))
+        j = j[(j.y == 2000) & (j.g == 1) & (j.ms == 2) & (j.ed == 3)
+              & ((j.em == 0) | (j.ev == 0))]
+        want = j.groupby("id")
+        ids = sorted(want.groups)
+        assert _i(out.column("i_item_id")).tolist() == ids
+        for name, src in (("agg1", "qty"), ("agg2", "list"), ("agg3", "coup"),
+                          ("agg4", "sales")):
+            exp = [_exact_mean(want.get_group(g)[src].tolist()) for g in ids]
+            np.testing.assert_array_equal(_f64(out.column(name)), np.array(exp))
+
+    def test_q43_case_pivot_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q43", tabs)
+        assert cp.last_report["fused_stages"] == 1
+
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "st": _i(ss.column("ss_store_sk")),
+            "p": _f64(ss.column("ss_sales_price")),
+        }).merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                               "y": _i(dd.column("d_year")),
+                               "dow": _i(dd.column("d_dow"))}), on="d")
+        df = df[df.y == 2000]
+        days = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+        stores = _i(out.column("ss_store_sk")).tolist()
+        assert stores == sorted(df.st.unique().tolist())
+        for i, day in enumerate(days):
+            col = out.column(f"{day}_sales_sum")
+            vals = _f64(col)
+            valid = (np.ones(len(vals), bool) if col.validity is None
+                     else np.asarray(col.validity))
+            for row, store in enumerate(stores):
+                sel = df[(df.st == store) & (df.dow == i)]
+                if len(sel):
+                    assert valid[row]
+                    assert vals[row] == math.fsum(sel.p.tolist())
+                else:
+                    assert not valid[row]
+
+    def test_q96_single_band_count(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, _ = _run_checked("q96", tabs)
+        ss = tabs["store_sales"]
+        td = tabs["time_dim"]
+        hd = tabs["household_demographics"]
+        hour = dict(zip(_i(td.column("t_time_sk")).tolist(),
+                        _i(td.column("t_hour")).tolist()))
+        minute = dict(zip(_i(td.column("t_time_sk")).tolist(),
+                          _i(td.column("t_minute")).tolist()))
+        dep = dict(zip(_i(hd.column("hd_demo_sk")).tolist(),
+                       _i(hd.column("hd_dep_count")).tolist()))
+        want = sum(
+            1 for t, h in zip(_i(ss.column("ss_sold_time_sk")).tolist(),
+                              _i(ss.column("ss_hdemo_sk")).tolist())
+            if hour[t] == 20 and minute[t] >= 30 and dep[h] == 5
+        )
+        assert int(_i(out.column("cnt"))[0]) == want
+
+    def test_q88_time_band_counts(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q88", tabs)
+        assert out.num_rows == 8
+        assert cp.last_report["fused_stages"] == 8
+        ss = tabs["store_sales"]
+        td = tabs["time_dim"]
+        hd = tabs["household_demographics"]
+        hour = dict(zip(_i(td.column("t_time_sk")).tolist(),
+                        _i(td.column("t_hour")).tolist()))
+        minute = dict(zip(_i(td.column("t_time_sk")).tolist(),
+                          _i(td.column("t_minute")).tolist()))
+        dep = dict(zip(_i(hd.column("hd_demo_sk")).tolist(),
+                       _i(hd.column("hd_dep_count")).tolist()))
+        rows = list(zip(_i(ss.column("ss_sold_time_sk")).tolist(),
+                        _i(ss.column("ss_hdemo_sk")).tolist()))
+        got = dict(zip(_i(out.column("band")).tolist(),
+                       _i(out.column("cnt")).tolist()))
+        band = 0
+        for h in (8, 9, 10, 11):
+            for half in (0, 1):
+                want = sum(
+                    1 for t, hh in rows
+                    if hour[t] == h
+                    and (minute[t] < 30 if half == 0 else minute[t] >= 30)
+                    and dep[hh] in (2, 7)
+                )
+                assert got[band] == want, (band, got[band], want)
+                band += 1
+
+
+class TestRollupHaving:
+    def test_q27_rollup_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q27", tabs)
+        assert cp.last_report["rewrites"].get("expand_grouping_sets") == 1
+        assert cp.last_report["fused_stages"] == 3  # one per grouping set
+
+        ss = tabs["store_sales"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "i": _i(ss.column("ss_item_sk")),
+            "cd": _i(ss.column("ss_cdemo_sk")),
+            "st": _i(ss.column("ss_store_sk")),
+            "qty": _i(ss.column("ss_quantity")),
+            "list": _f64(ss.column("ss_list_price")),
+            "coup": _f64(ss.column("ss_coupon_amt")),
+            "sales": _f64(ss.column("ss_sales_price")),
+        })
+        dd = tabs["date_dim"]
+        cdt = tabs["customer_demographics"]
+        st = tabs["store"]
+        it = tabs["item"]
+        j = (df.merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                                    "y": _i(dd.column("d_year"))}), on="d")
+             .merge(pd.DataFrame({"cd": _i(cdt.column("cd_demo_sk")),
+                                  "g": _i(cdt.column("cd_gender")),
+                                  "ms": _i(cdt.column("cd_marital_status")),
+                                  "ed": _i(cdt.column("cd_education_status"))}), on="cd")
+             .merge(pd.DataFrame({"st": _i(st.column("s_store_sk")),
+                                  "state": _i(st.column("s_state"))}), on="st")
+             .merge(pd.DataFrame({"i": _i(it.column("i_item_sk")),
+                                  "id": _i(it.column("i_item_id"))}), on="i"))
+        j = j[(j.y == 2000) & (j.g == 1) & (j.ms == 2) & (j.ed == 3)
+              & j.state.isin((1, 4, 7))]
+        want = {}
+        for (iid, state), g in j.groupby(["id", "state"]):
+            want[(iid, state)] = g
+        for iid, g in j.groupby("id"):
+            want[(iid, None)] = g
+        if len(j):
+            want[(None, None)] = j
+        ids = _i(out.column("i_item_id"))
+        id_valid = (np.ones(out.num_rows, bool)
+                    if out.column("i_item_id").validity is None
+                    else np.asarray(out.column("i_item_id").validity))
+        states = _i(out.column("s_state"))
+        st_valid = (np.ones(out.num_rows, bool)
+                    if out.column("s_state").validity is None
+                    else np.asarray(out.column("s_state").validity))
+        assert out.num_rows == len(want)
+        for row in range(out.num_rows):
+            key = (int(ids[row]) if id_valid[row] else None,
+                   int(states[row]) if st_valid[row] else None)
+            g = want[key]
+            for name, src in (("agg1", "qty"), ("agg2", "list"),
+                              ("agg3", "coup"), ("agg4", "sales")):
+                assert _f64(out.column(name))[row] == _exact_mean(g[src].tolist()), \
+                    (key, name)
+
+    def test_q73_having_band_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q73", tabs)
+        assert cp.last_report["rewrites"].get("having_to_filter") == 1
+
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        hd = tabs["household_demographics"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "t": _i(ss.column("ss_ticket_number")),
+            "c": _i(ss.column("ss_customer_sk")),
+            "h": _i(ss.column("ss_hdemo_sk")),
+        }).merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                               "y": _i(dd.column("d_year"))}), on="d") \
+          .merge(pd.DataFrame({"h": _i(hd.column("hd_demo_sk")),
+                               "buy": _i(hd.column("hd_buy_potential"))}), on="h")
+        df = df[(df.y == 2000) & df.buy.isin((1, 4))]
+        cid = dict(zip(_i(tabs["customer"].column("c_customer_sk")).tolist(),
+                       _i(tabs["customer"].column("c_customer_id")).tolist()))
+        rows = []
+        for (t, c), g in df.groupby(["t", "c"]):
+            if 1 <= len(g) <= 2:
+                rows.append((cid[c], len(g)))
+        rows.sort(key=lambda r: (-r[1], r[0]))
+        got = list(zip(_i(out.column("c_customer_id")).tolist(),
+                       _i(out.column("cnt")).tolist()))
+        assert got == rows
+
+
+class TestSetOpsExists:
+    def _sets(self, tabs, year=1999, lo=1, hi=7):
+        dd = tabs["date_dim"]
+        ok = {
+            d for d, y, m in zip(_i(dd.column("d_date_sk")).tolist(),
+                                 _i(dd.column("d_year")).tolist(),
+                                 _i(dd.column("d_moy")).tolist())
+            if y == year and lo <= m <= hi
+        }
+        cid = dict(zip(_i(tabs["customer"].column("c_customer_sk")).tolist(),
+                       _i(tabs["customer"].column("c_customer_id")).tolist()))
+
+        def chan(fact, cust, date):
+            f = tabs[fact]
+            return {cid[c] for c, d in zip(_i(f.column(cust)).tolist(),
+                                           _i(f.column(date)).tolist())
+                    if d in ok}
+
+        s = chan("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+        c = chan("catalog_sales", "cs_ship_customer_sk", "cs_sold_date_sk")
+        w = chan("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+        return s, c, w
+
+    def test_q38_intersect_chain(self):
+        tabs = tp.gen_channels(6000)
+        out, cp = _run_checked("q38", tabs)
+        assert cp.last_report["rewrites"].get("setop_to_joins") == 2
+        s, c, w = self._sets(tabs)
+        assert int(_i(out.column("cnt"))[0]) == len(s & c & w)
+
+    def test_q87_except_chain(self):
+        tabs = tp.gen_channels(6000)
+        out, cp = _run_checked("q87", tabs)
+        assert cp.last_report["rewrites"].get("setop_to_joins") == 2
+        s, c, w = self._sets(tabs)
+        assert int(_i(out.column("cnt"))[0]) == len((s - c) - w)
+
+    def test_q69_exists_chain_matches_oracle(self):
+        tabs = tp.gen_channels(6000)
+        out, cp = _run_checked("q69", tabs)
+        assert cp.last_report["rewrites"].get("exists_to_semijoin") == 3
+        assert cp.last_report["fused_stages"] >= 1  # semi/anti joins fused
+
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        cd = tabs["customer_demographics"]
+        dd = tabs["date_dim"]
+        ok = {
+            d for d, y, m in zip(_i(dd.column("d_date_sk")).tolist(),
+                                 _i(dd.column("d_year")).tolist(),
+                                 _i(dd.column("d_moy")).tolist())
+            if y == 1999 and 1 <= m <= 3
+        }
+
+        def active(fact, cust, date):
+            f = tabs[fact]
+            return {c for c, d in zip(_i(f.column(cust)).tolist(),
+                                      _i(f.column(date)).tolist()) if d in ok}
+
+        s_act = active("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+        w_act = active("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+        c_act = active("catalog_sales", "cs_ship_customer_sk", "cs_sold_date_sk")
+        state = dict(zip(_i(ca.column("ca_address_sk")).tolist(),
+                         _i(ca.column("ca_state")).tolist()))
+        demo = {
+            k: (g, ms, ed)
+            for k, g, ms, ed in zip(_i(cd.column("cd_demo_sk")).tolist(),
+                                    _i(cd.column("cd_gender")).tolist(),
+                                    _i(cd.column("cd_marital_status")).tolist(),
+                                    _i(cd.column("cd_education_status")).tolist())
+        }
+        counts = {}
+        for csk, cdemo, addr in zip(_i(cu.column("c_customer_sk")).tolist(),
+                                    _i(cu.column("c_current_cdemo_sk")).tolist(),
+                                    _i(cu.column("c_current_addr_sk")).tolist()):
+            if state[addr] not in (2, 5, 8):
+                continue
+            if csk not in s_act or csk in w_act or csk in c_act:
+                continue
+            counts[demo[cdemo]] = counts.get(demo[cdemo], 0) + 1
+        got = {}
+        for row in range(out.num_rows):
+            key = (int(_i(out.column("cd_gender"))[row]),
+                   int(_i(out.column("cd_marital_status"))[row]),
+                   int(_i(out.column("cd_education_status"))[row]))
+            got[key] = int(_i(out.column("cnt"))[row])
+        assert got == counts
+        assert sorted(got) == list(got)  # ORDER BY held
+
+
+class TestWindowRatio:
+    def test_q20_matches_oracle(self):
+        tabs = tp.gen_catalog(10_000)
+        out, cp = _run_checked("q20", tabs)
+        assert cp.last_report["fused_stages"] == 1
+
+        cs = tabs["catalog_sales"]
+        it = tabs["item"]
+        df = pd.DataFrame({
+            "d": _i(cs.column("cs_sold_date_sk")),
+            "i": _i(cs.column("cs_item_sk")),
+            "p": _f64(cs.column("cs_ext_sales_price")),
+        }).merge(pd.DataFrame({"i": _i(it.column("i_item_sk")),
+                               "cat": _i(it.column("i_category_id")),
+                               "cls": _i(it.column("i_class_id"))}), on="i")
+        df = df[(df.d >= 700) & (df.d <= 730) & df.cat.isin((2, 5, 8))]
+        rev = {k: math.fsum(g.p.tolist()) for k, g in df.groupby(["cat", "cls"])}
+        cat_tot = {}
+        for (cat, _), v in rev.items():
+            cat_tot.setdefault(cat, []).append(v)
+        cat_tot = {c: math.fsum(v) for c, v in cat_tot.items()}
+        rows = [(cat, cls, v, (v * 100.0) / cat_tot[cat])
+                for (cat, cls), v in rev.items()]
+        rows.sort(key=lambda r: (r[0], r[3], r[1]))
+        assert _i(out.column("i_category_id")).tolist() == [r[0] for r in rows]
+        assert _i(out.column("i_class_id")).tolist() == [r[1] for r in rows]
+        np.testing.assert_array_equal(
+            _f64(out.column("itemrevenue")), np.array([r[2] for r in rows]))
+        np.testing.assert_array_equal(
+            _f64(out.column("revenueratio")), np.array([r[3] for r in rows]))
