@@ -34,6 +34,50 @@ mkdir -p artifacts
 python -m spark_rapids_jni_tpu.analysis.lint --format=sarif --out artifacts/srjt_lint.sarif
 python -m spark_rapids_jni_tpu.analysis.races --format=sarif --out artifacts/srjt_race.sarif
 
+# srjt-plancheck tier (ISSUE 15): the plan-IR verifier over EVERY
+# checked-in plan (well-formedness, every fired rewrite's
+# translation-validation obligation discharged, per-stage estimate
+# monotonicity), then the fixed-seed random-plan differential fuzzer —
+# >= 50 generated plans run rewrite->compile->execute against a
+# direct-plan-interpretation oracle, any mismatch bisected to the
+# first semantics-breaking rewrite. The gate is artifact-based:
+# artifacts/plan_verify.jsonl must carry every registry plan with
+# zero violations AND the fuzz record with zero mismatches;
+# artifacts/plancheck.sarif is archived next to the other SARIF.
+rm -f artifacts/plan_verify.jsonl
+JAX_PLATFORMS=cpu python -m spark_rapids_jni_tpu.analysis.plancheck \
+  --format=sarif --out artifacts/plancheck.sarif \
+  --report artifacts/plan_verify.jsonl
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python -m spark_rapids_jni_tpu.analysis.planfuzz \
+  --report artifacts/plan_verify.jsonl
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/plan_verify.jsonl")]
+plans = {r["query"]: r for r in rows if r["kind"] == "plan"}
+fuzz = [r for r in rows if r["kind"] == "fuzz"]
+from spark_rapids_jni_tpu.models.tpcds_plans import PLAN_QUERIES
+want = set(PLAN_QUERIES) | {"q3", "q55"}
+missing = sorted(want - set(plans))
+assert not missing, f"plans missing from plan_verify.jsonl: {missing}"
+bad = {q: r for q, r in plans.items() if r["violations"]}
+assert not bad, f"plancheck violations: {bad}"
+assert all(r["obligations"] >= 1 for r in plans.values()), \
+    "a checked-in plan emitted no rewrite obligations (prune at minimum)"
+assert fuzz, "no fuzz record archived"
+total = sum(r["plans"] for r in fuzz)
+assert total >= 50, f"fuzz smoke covered only {total} plans (need >= 50)"
+assert all(r["mismatches"] == 0 and r["violations"] == 0 for r in fuzz), fuzz
+fired = {}
+for r in fuzz:
+    for rule, n in r["rewrites"].items():
+        fired[rule] = fired.get(rule, 0) + n
+print(f"plancheck tier: {len(plans)} plans verified "
+      f"({sum(r['obligations'] for r in plans.values())} obligations "
+      f"discharged), {total} fuzzed plans / 0 mismatches, "
+      f"fuzz rewrites {fired} -> artifacts/plan_verify.jsonl")
+EOF
+
 # fast tier: the measured heavy tail (tests/conftest.py _SLOW_TESTS)
 # runs nightly (ci/nightly.sh); this keeps the premerge gate usable on
 # a 1-core box (VERDICT r3 item 9). SRJT_LOCKDEP=1 (ISSUE 7, layer 2)
@@ -358,10 +402,13 @@ EOF
 # admission runs, not that it starves) and the per-query report knob
 # set. The merge gate is artifact-based: artifacts/plan_compile.jsonl
 # must carry every registry query with node counts and rewrites fired,
-# ZERO estimate-vs-actual peak-byte blowups over 4x, and the metrics
-# log must PROVE memgov admission consumed nonzero plan-derived
-# estimates (the ISSUE 14 acceptance assertion). SRJT_LOCKDEP/RACE
-# ride along and feed the merged zero-cycle gate below.
+# ZERO estimate-vs-actual peak-byte blowups over 3x (tightened from 4x
+# in ISSUE 15: the width model gained the per-row validity lane the
+# archived reports showed it missing, and every archived peak blowup
+# sits at or under ~1.0), and the metrics log must PROVE memgov
+# admission consumed nonzero plan-derived estimates (the ISSUE 14
+# acceptance assertion). SRJT_LOCKDEP/RACE ride along and feed the
+# merged zero-cycle gate below.
 rm -f artifacts/plan_compile.jsonl artifacts/plan_metrics.jsonl
 timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RACE=1 \
   SRJT_DEVICE_MEMORY_BUDGET=268435456 SRJT_SPILL_ENABLED=1 \
@@ -378,7 +425,7 @@ for r in rows:
 from spark_rapids_jni_tpu.models.tpcds_plans import PLAN_QUERIES
 missing = sorted(set(PLAN_QUERIES) - set(by))
 assert not missing, f"green plan queries missing from the report: {missing}"
-assert len(PLAN_QUERIES) >= 10, "fewer than 10 compiler-green queries"
+assert len(PLAN_QUERIES) >= 15, "fewer than 15 compiler-green queries"
 for name in ("q3", "q55"):
     assert name in by, f"re-expressed green {name} not exercised"
 blowups = {}
@@ -386,9 +433,9 @@ for q, r in by.items():
     assert r["nodes_raw"] > 0 and r["nodes_optimized"] > 0, r
     assert isinstance(r["rewrites"], dict), r
     assert r["est_peak_bytes"] > 0, r
-    if r["peak_blowup"] is not None and r["peak_blowup"] > 4.0:
+    if r["peak_blowup"] is not None and r["peak_blowup"] > 3.0:
         blowups[q] = r["peak_blowup"]
-assert not blowups, f"estimate-vs-actual peak blowups > 4x: {blowups}"
+assert not blowups, f"estimate-vs-actual peak blowups > 3x: {blowups}"
 fired = {}
 for q in PLAN_QUERIES:
     for rule, n in by[q]["rewrites"].items():

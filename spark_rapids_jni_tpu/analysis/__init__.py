@@ -16,6 +16,18 @@ ANALYSIS.md at the repo root):
   bare — flagging mixed-guard access, check-then-act splits, and bare
   mutable-global mutation. Run as
   ``python -m spark_rapids_jni_tpu.analysis.races``.
+- ``plancheck.py`` / ``planfuzz.py`` — ``srjt-plancheck`` (ISSUE 15):
+  the plan-verification tier's CLIs. plancheck runs the
+  ``plan/verifier.py`` rules (PLAN001-006: well-formedness,
+  per-rewrite translation-validation obligations, estimate
+  consistency) over every checked-in plan in
+  ``models/tpcds_plans.py``; planfuzz generates seeded typed plans
+  over the TPC-DS generator schemas, executes them through
+  rewrite->compile->run against a direct-plan-interpretation oracle,
+  and bisects any mismatch (PLAN007) to the first semantics-breaking
+  rewrite in the chain. Run as
+  ``python -m spark_rapids_jni_tpu.analysis.plancheck`` /
+  ``...planfuzz``.
 - ``lockdep.py`` — opt-in runtime instrumentation over ``threading``:
   ``SRJT_LOCKDEP=1`` records per-thread acquisition stacks, the
   lock-order graph, cycles, and blocking-while-locked events;

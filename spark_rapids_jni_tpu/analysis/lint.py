@@ -49,6 +49,15 @@ rule here machine-checks one of them:
                                  agree both ways: every declared knob
                                  documented, every documented token
                                  declared.
+    SRJT011 unverified-rewrite   every rewrite rule registered in
+                                 plan/rewrites.py (plus prune_columns)
+                                 must have a translation-validation
+                                 discharger in plan/verifier.py's
+                                 OBLIGATION_DISCHARGERS, or carry
+                                 ``# srjt-plan: allow-unverified(<reason>)``
+                                 inside its function body; a
+                                 suppression on a rule that IS
+                                 discharged is stale (SRJT000).
     SRJT000 bad-suppression      a suppression comment with an empty /
                                  missing reason is itself a violation.
 
@@ -83,6 +92,7 @@ __all__ = [
     "main",
     "format_findings",
     "write_findings",
+    "check_rewrite_obligations",
 ]
 
 _KNOB_RE = re.compile(r"SRJT_[A-Z0-9_]*[A-Z0-9]")
@@ -497,6 +507,144 @@ def _discover(pkg_root: str) -> List[str]:
     return out
 
 
+# -- SRJT011: rewrite rules must emit verifiable obligations -----------------
+
+
+_PLAN_SUPPRESS_RE = re.compile(r"#\s*srjt-plan:\s*allow-unverified\s*\((.*)\)")
+
+
+def _registry_value(tree: ast.AST, name: str):
+    """The value expression assigned to module-level ``name`` (plain or
+    annotated assignment), or None."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.value
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == name:
+            return node.value
+    return None
+
+
+def _parse_rules_registry(src: str) -> List[Tuple[str, str]]:
+    """(rule name, function name) pairs off the RULES tuple literal."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    val = _registry_value(tree, "RULES")
+    out: List[Tuple[str, str]] = []
+    for elt in getattr(val, "elts", ()):
+        if (isinstance(elt, (ast.Tuple, ast.List)) and len(elt.elts) == 2
+                and isinstance(elt.elts[0], ast.Constant)
+                and isinstance(elt.elts[1], ast.Name)):
+            out.append((elt.elts[0].value, elt.elts[1].id))
+    return out
+
+
+def _parse_discharger_registry(src: str) -> frozenset:
+    """String keys of the OBLIGATION_DISCHARGERS dict literal."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return frozenset()
+    val = _registry_value(tree, "OBLIGATION_DISCHARGERS")
+    return frozenset(
+        k.value for k in getattr(val, "keys", ())
+        if isinstance(k, ast.Constant) and isinstance(k.value, str))
+
+
+def check_rewrite_obligations(rules=None, dischargers=None,
+                              src: Optional[str] = None,
+                              path: Optional[str] = None) -> List[Violation]:
+    """SRJT011 (ISSUE 15): every rewrite function registered in
+    ``plan/rewrites.py`` (``RULES`` plus ``prune_columns``) must be
+    covered by a translation-validation discharger in
+    ``plan/verifier.py`` — i.e. its firings emit obligations the
+    verifier can actually discharge — or carry a reasoned
+    ``# srjt-plan: allow-unverified(<reason>)`` inside its function
+    body. An empty reason is SRJT000; a suppression on a rule that IS
+    discharged is a stale SRJT000 (the PR 7 audit discipline).
+
+    The default path is PURELY STATIC: both registries are read off the
+    two files' ASTs (``RULES``' literal (name, fn) tuple and
+    ``OBLIGATION_DISCHARGERS``' literal dict keys) — importing the plan
+    package would drag jax into every lint run, and the analysis tier
+    stays import-light by contract. A registry the parse cannot locate
+    is itself a violation, so a refactor that breaks the static read
+    fails loudly instead of silently passing. The parameters exist for
+    fixture injection in tests (``rules`` entries may carry callables
+    or function-name strings)."""
+    if rules is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(pkg, "plan", "rewrites.py")
+        pv_path = os.path.join(pkg, "plan", "verifier.py")
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rules = _parse_rules_registry(src)
+        if not rules:
+            return [Violation(
+                path, 1, "SRJT011",
+                "could not locate the RULES registry literal — the "
+                "SRJT011 static parse needs RULES = ((name, fn), ...) "
+                "at module scope")]
+        rules = rules + [("prune_columns", "prune_columns")]
+        with open(pv_path, encoding="utf-8") as f:
+            pv_src = f.read()
+        dischargers = _parse_discharger_registry(pv_src)
+        if not dischargers:
+            return [Violation(
+                pv_path, 1, "SRJT011",
+                "could not locate the OBLIGATION_DISCHARGERS dict "
+                "literal — the SRJT011 static parse needs its string "
+                "keys at module scope")]
+    dischargers = frozenset(dischargers or ())
+    try:
+        tree = ast.parse(src, filename=path or "<rewrites>")
+    except SyntaxError as e:
+        return [Violation(path or "<rewrites>", e.lineno or 1, "SRJT999",
+                          f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    funcs: Dict[str, Tuple[int, Optional[str], int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reason, rline = None, node.lineno
+            for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                m = _PLAN_SUPPRESS_RE.search(lines[ln - 1])
+                if m:
+                    reason, rline = m.group(1).strip(), ln
+                    break
+            funcs[node.name] = (node.lineno, reason, rline)
+    out: List[Violation] = []
+    where = path or "<rewrites>"
+    for name, fn in rules:
+        fname = fn if isinstance(fn, str) else getattr(fn, "__name__", str(fn))
+        lineno, reason, rline = funcs.get(fname, (1, None, 1))
+        if name in dischargers:
+            if reason is not None:
+                out.append(Violation(
+                    where, rline, "SRJT000",
+                    f"stale suppression allow-unverified on rule {name!r}: "
+                    "a discharger IS registered in plan/verifier.py — "
+                    "delete the comment"))
+            continue
+        if reason is None:
+            out.append(Violation(
+                where, lineno, "SRJT011",
+                f"rewrite rule {name!r} has no translation-validation "
+                "discharger in plan/verifier.py OBLIGATION_DISCHARGERS: "
+                "its firings are unverifiable — register one or carry "
+                "# srjt-plan: allow-unverified(<reason>)"))
+        elif not reason:
+            out.append(Violation(
+                where, rline, "SRJT000",
+                f"suppression allow-unverified() on rule {name!r} needs "
+                "a reason"))
+    return out
+
+
 # -- SRJT007: registry <-> doc-table drift ----------------------------------
 
 
@@ -548,11 +696,17 @@ def check_docs(repo_root: str, knob_names: Optional[frozenset] = None,
 
 def run(pkg_root: Optional[str] = None,
         with_docs: bool = True,
-        with_harness: bool = True) -> List[Violation]:
+        with_harness: bool = True,
+        with_plan: bool = True) -> List[Violation]:
+    real_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if pkg_root is None:
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg_root = real_root
     knob_names, sentinels = _knob_names()
     violations: List[Violation] = []
+    if with_plan and os.path.abspath(pkg_root) == real_root:
+        # SRJT011 is a cross-file check over the REAL plan modules; a
+        # fixture pkg_root must not drag the live tree into its run
+        violations.extend(check_rewrite_obligations())
     for path in _discover(pkg_root):
         violations.extend(lint_file(path, pkg_root, knob_names, sentinels))
     if with_harness:
@@ -676,6 +830,9 @@ def main(argv=None) -> int:
                     help="skip the README/PACKAGING knob-table drift check")
     ap.add_argument("--no-harness", action="store_true",
                     help="skip the tests/ + benchmarks/ knob-rule scan")
+    ap.add_argument("--no-plan", action="store_true",
+                    help="skip the SRJT011 rewrite-obligation coverage "
+                    "check over plan/rewrites.py")
     ap.add_argument("--knob-table", action="store_true",
                     help="print the registry as a markdown table and exit")
     ap.add_argument("--format", default="text",
@@ -692,7 +849,8 @@ def main(argv=None) -> int:
         print(knobs.markdown_table())
         return 0
     violations = run(args.root, with_docs=not args.no_docs,
-                     with_harness=not args.no_harness)
+                     with_harness=not args.no_harness,
+                     with_plan=not args.no_plan)
     return write_findings(violations, args.format, args.out, "srjt-lint")
 
 

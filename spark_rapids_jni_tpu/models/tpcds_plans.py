@@ -37,8 +37,9 @@ from .tpcds import _f64_col, _int_col, gen_store_wide, gen_web
 
 __all__ = [
     "gen_store_returns", "gen_catalog", "gen_channels",
-    "q1", "q20", "q26", "q27", "q38", "q43", "q69", "q73", "q87", "q88",
-    "q92", "q96", "q3_plan", "q55_plan", "PLAN_QUERIES", "PlanQueryDef",
+    "q1", "q13", "q20", "q26", "q27", "q38", "q43", "q48", "q65", "q69",
+    "q73", "q87", "q88", "q92", "q96", "q3_plan", "q55_plan",
+    "PLAN_QUERIES", "PlanQueryDef",
 ]
 
 
@@ -679,6 +680,154 @@ def q73(tables, year: int = 2000, buys=(1, 4), lo: int = 1, hi: int = 2) -> Tabl
     return _run(q73_plan(year, buys, lo, hi), tables, "q73")
 
 
+def q13_plan(year: int = 2000) -> P.Node:
+    """TPC-DS q13 — the OR'ed demographic/price band star over six
+    joined dimensions, global exact averages; the whole chain (six
+    inner joins + the cross-dimension band filter + four aggregates)
+    fuses into ONE compiled program under the new srjt-plancheck
+    verifier. SQL shape:
+
+        SELECT avg(ss_quantity), avg(ss_list_price), avg(ss_coupon_amt),
+               sum(ss_sales_price)
+        FROM store_sales, store, customer_demographics,
+             household_demographics, customer, customer_address, date_dim
+        WHERE d_year = :y AND ss_store_sk = s_store_sk
+          AND ss_cdemo_sk = cd_demo_sk AND ss_hdemo_sk = hd_demo_sk
+          AND ss_customer_sk = c_customer_sk
+          AND c_current_addr_sk = ca_address_sk
+          AND ((cd_marital_status = 'M' AND cd_education_status = ... AND
+                ss_sales_price BETWEEN .. AND hd_dep_count = ..) OR (...))
+          AND (ca_state IN (...) ...)
+
+    Dictionary codes stand in for the string bands (ca_zip5 for the
+    address band), as everywhere in this tier."""
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("store"), on=(("ss_store_sk", "s_store_sk"),),
+               bounded=True)
+    x = P.Join(x, P.Scan("customer_demographics"),
+               on=(("ss_cdemo_sk", "cd_demo_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("household_demographics"),
+               on=(("ss_hdemo_sk", "hd_demo_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer"), on=(("ss_customer_sk", "c_customer_sk"),),
+               bounded=True)
+    x = P.Join(x, P.Scan("customer_address"),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    band1 = ((P.pcol("cd_marital_status") <= P.plit(2))
+             & (P.pcol("cd_education_status") >= P.plit(3))
+             & (P.pcol("ss_sales_price") >= P.plit(50.0))
+             & (P.pcol("hd_dep_count") <= P.plit(5)))
+    band2 = ((P.pcol("cd_marital_status") >= P.plit(3))
+             & (P.pcol("cd_education_status") <= P.plit(2))
+             & (P.pcol("ss_sales_price") <= P.plit(100.0))
+             & (P.pcol("hd_dep_count") >= P.plit(4)))
+    zips = (P.pcol("ca_zip5") < P.plit(120)) | (P.pcol("ca_zip5") >= P.plit(210))
+    x = P.Filter(x, (band1 | band2) & zips)
+    return P.Aggregate(
+        x, keys=(),
+        aggs=(
+            P.AggSpec("ss_quantity", "mean", "avg_qty"),
+            P.AggSpec("ss_list_price", "mean", "avg_list"),
+            P.AggSpec("ss_coupon_amt", "mean", "avg_coupon"),
+            P.AggSpec("ss_sales_price", "sum", "sum_sales"),
+        ),
+    )
+
+
+def q13(tables: Dict[str, Table], year: int = 2000) -> Table:
+    return _run(q13_plan(year), tables, "q13")
+
+
+def q48_plan(year: int = 2000) -> P.Node:
+    """TPC-DS q48 — q13's global-sum sibling: demographic/price bands
+    OR'ed with address bands over the store star, one fused global
+    SUM(ss_quantity). SQL shape:
+
+        SELECT sum(ss_quantity)
+        FROM store_sales, store, customer_demographics, customer,
+             customer_address, date_dim
+        WHERE d_year = :y AND ss_store_sk = s_store_sk AND ...
+          AND ((cd_marital_status = .. AND cd_education_status = .. AND
+                ss_sales_price BETWEEN ..) OR (...))
+          AND ((ca_state IN (..) AND ss_net_profit BETWEEN ..) OR (...))
+    """
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("store"), on=(("ss_store_sk", "s_store_sk"),),
+               bounded=True)
+    x = P.Join(x, P.Scan("customer_demographics"),
+               on=(("ss_cdemo_sk", "cd_demo_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer"), on=(("ss_customer_sk", "c_customer_sk"),),
+               bounded=True)
+    x = P.Join(x, P.Scan("customer_address"),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    demo = (((P.pcol("cd_marital_status") == P.plit(2))
+             & (P.pcol("cd_education_status") == P.plit(3))
+             & (P.pcol("ss_sales_price") >= P.plit(50.0))
+             & (P.pcol("ss_sales_price") <= P.plit(150.0)))
+            | ((P.pcol("cd_marital_status") == P.plit(1))
+               & (P.pcol("cd_education_status") == P.plit(4))
+               & (P.pcol("ss_sales_price") <= P.plit(100.0))))
+    addr = ((P.pcol("ca_zip5") < P.plit(100))
+            | ((P.pcol("ca_zip5") >= P.plit(150)) & (P.pcol("ca_zip5") < P.plit(250))))
+    x = P.Filter(x, demo & addr)
+    return P.Aggregate(x, keys=(),
+                       aggs=(P.AggSpec("ss_quantity", "sum", "qty_sum"),))
+
+
+def q48(tables: Dict[str, Table], year: int = 2000) -> Table:
+    return _run(q48_plan(year), tables, "q48")
+
+
+def q65_plan(lo: int = 400, hi: int = 1100, frac: float = 0.5) -> P.Node:
+    """TPC-DS q65 — low-revenue items per store: per-(store, item)
+    revenue compared against a fraction of the per-store AVERAGE
+    revenue — the correlated scalar subquery decorrelates exactly like
+    q1, and the inner (store, item) revenue aggregate FUSES (both keys
+    dense INT32 domains); the comparison + item join-back run on the
+    small aggregate output. SQL:
+
+        SELECT s_store_sk, i_item_id, revenue FROM store, item,
+          (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+           FROM store_sales, date_dim
+           WHERE ss_sold_date_sk = d_date_sk AND d_date_sk BETWEEN :lo AND :hi
+           GROUP BY ss_store_sk, ss_item_sk) sa
+        WHERE sa.revenue <= :frac *
+              (SELECT avg(revenue) FROM sa sb
+               WHERE sb.ss_store_sk = sa.ss_store_sk)
+          AND ss_item_sk = i_item_sk
+        ORDER BY s_store_sk, i_item_id
+    """
+    sa = P.Aggregate(
+        P.Join(
+            P.Scan("store_sales"),
+            P.Filter(P.Scan("date_dim"),
+                     (P.pcol("d_date_sk") >= P.plit(lo))
+                     & (P.pcol("d_date_sk") <= P.plit(hi))),
+            on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True,
+        ),
+        keys=("ss_store_sk", "ss_item_sk"),
+        aggs=(P.AggSpec("ss_sales_price", "sum", "revenue"),),
+    )
+    x = P.CorrelatedAggFilter(
+        sa, sa, on=("ss_store_sk", "ss_store_sk"),
+        agg=P.AggSpec("revenue", "mean", "ave"),
+        predicate=P.pcol("revenue") <= P.plit(frac) * P.pcol("ave"),
+    )
+    x = P.Join(x, P.Scan("item"), on=(("ss_item_sk", "i_item_sk"),))
+    x = P.Project(x, (("ss_store_sk", P.pcol("ss_store_sk")),
+                      ("i_item_id", P.pcol("i_item_id")),
+                      ("revenue", P.pcol("revenue"))))
+    return P.Sort(x, (("ss_store_sk", True), ("i_item_id", True)))
+
+
+def q65(tables: Dict[str, Table], lo: int = 400, hi: int = 1100,
+        frac: float = 0.5) -> Table:
+    return _run(q65_plan(lo, hi, frac), tables, "q65")
+
+
 # ---------------------------------------------------------------------------
 # hand-built greens re-expressed as plans (bit-identity contract)
 # ---------------------------------------------------------------------------
@@ -737,6 +886,8 @@ PLAN_QUERIES: Dict[str, PlanQueryDef] = {
     for d in (
         PlanQueryDef("q1", lambda n, s=21: gen_store_returns(n, seed=s),
                      q1_plan, q1, 8000),
+        PlanQueryDef("q13", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q13_plan, q13, 10000),
         PlanQueryDef("q20", lambda n, s=23: gen_catalog(n, seed=s),
                      q20_plan, q20, 10000),
         PlanQueryDef("q26", lambda n, s=23: gen_catalog(n, seed=s),
@@ -747,6 +898,10 @@ PLAN_QUERIES: Dict[str, PlanQueryDef] = {
                      q38_plan, q38, 6000),
         PlanQueryDef("q43", lambda n, s=42: gen_store_wide(n, seed=s),
                      q43_plan, q43, 10000),
+        PlanQueryDef("q48", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q48_plan, q48, 10000),
+        PlanQueryDef("q65", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q65_plan, q65, 10000),
         PlanQueryDef("q69", lambda n, s=29: gen_channels(n, seed=s),
                      q69_plan, q69, 6000),
         PlanQueryDef("q73", lambda n, s=42: gen_store_wide(n, seed=s),

@@ -57,7 +57,19 @@ from .nodes import (  # noqa: F401
     rollup,
     structure,
 )
-from .rewrites import RewriteResult, prune_columns, rewrite  # noqa: F401
+from .rewrites import (  # noqa: F401
+    Obligation,
+    RewriteResult,
+    fingerprint,
+    prune_columns,
+    rewrite,
+)
+from .verifier import (  # noqa: F401
+    PlanViolation,
+    verify_estimates,
+    verify_obligations,
+    verify_plan,
+)
 
 __all__ = [
     "CompiledPlan", "compile_ir",
@@ -65,5 +77,7 @@ __all__ = [
     "Node", "Scan", "Filter", "Project", "Join", "Aggregate", "AggSpec",
     "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
     "CorrelatedAggFilter", "rollup", "infer_schema", "structure",
-    "rewrite", "prune_columns", "RewriteResult",
+    "rewrite", "prune_columns", "RewriteResult", "Obligation",
+    "fingerprint", "PlanViolation", "verify_plan", "verify_obligations",
+    "verify_estimates",
 ]

@@ -79,7 +79,13 @@ _MAX_DENSE_GROUPS = 1 << 22
 def _width(schema: Schema) -> int:
     total = 0
     for d in schema.values():
-        total += d.size_bytes if d.is_fixed_width else 16
+        # +1: the per-row validity lane. The archived r6 estimate-vs-
+        # actual reports (artifacts/plan_compile.jsonl) showed the
+        # value-only width UNDERestimating every nullable narrow table
+        # by up to 1.25x (a lone INT32 column is 5 bytes/row with its
+        # bool mask, not 4) — the one systematic drift in the gated
+        # direction, and what let premerge tighten the blowup gate to 3x
+        total += (d.size_bytes if d.is_fixed_width else 16) + 1
     return max(total, 1)
 
 
@@ -737,10 +743,14 @@ class CompiledPlan:
 
     def __init__(self, name: str, root: _Exec, tables: Dict[str, Table],
                  stages: List[_Exec], raw_nodes: int, opt_nodes: int,
-                 rewrites_fired: Dict[str, int], opt_plan: Node):
+                 rewrites_fired: Dict[str, int], opt_plan: Node,
+                 obligations: Optional[list] = None):
         self.name = name
         self.schema = dict(root.schema)
         self.optimized = opt_plan
+        # translation-validation records from the rewrite pass, carried
+        # for srjt-plancheck (plan.verifier.verify_obligations)
+        self.obligations = list(obligations or ())
         self._root = root
         self._tables = tables
         self._stages = stages
@@ -752,6 +762,17 @@ class CompiledPlan:
         )
         self.last_report: Optional[dict] = None
         _durable("plan.compiles").inc()
+
+    @property
+    def stages(self) -> list:
+        """The lowered stage DAG (read-only view) — what
+        ``plan.verifier.verify_estimates`` walks for the per-stage
+        ``memory_bytes`` presence/monotonicity checks."""
+        return list(self._stages)
+
+    @property
+    def rewrites_fired(self) -> Dict[str, int]:
+        return dict(self._rewrites)
 
     def __call__(self) -> Table:
         from .. import memgov
@@ -826,4 +847,5 @@ def compile_ir(plan: Node, tables: Dict[str, Table],
     low = _Lowerer(tables, catalog)
     root = low.lower(res.plan)
     return CompiledPlan(name, root, tables, low.all_execs, raw_nodes,
-                        _count_nodes(res.plan), res.fired, res.plan)
+                        _count_nodes(res.plan), res.fired, res.plan,
+                        obligations=res.obligations)

@@ -32,6 +32,7 @@ applied-twice-equals-applied-once test pins.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import exprs as ex
@@ -55,13 +56,58 @@ from .nodes import (
     infer_schema,
 )
 
-__all__ = ["rewrite", "prune_columns", "RewriteResult", "RULES"]
+__all__ = ["rewrite", "prune_columns", "RewriteResult", "RULES",
+           "Obligation", "fingerprint"]
+
+
+def fingerprint(node: Node) -> str:
+    """Stable structural fingerprint of a subtree (over
+    ``nodes.structure``) — the obligation records and the fuzzer's
+    bisection reports identify subtrees by it."""
+    from .nodes import structure
+
+    return hashlib.sha1(repr(structure(node)).encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class Obligation:
+    """Translation-validation record for ONE rule firing (srjt-plancheck,
+    ISSUE 15): the subtree before, the rule's one-step output (captured
+    BEFORE the engine recursed into the fresh children), structure
+    fingerprints of both, and the preserved-schema witness inferred from
+    the before-subtree. ``plan.verifier.verify_obligations`` discharges
+    these structurally; an undischargeable obligation is a hard PLAN006
+    violation. Records are collected on EVERY rewrite (and retained by
+    ``CompiledPlan``) by design: a plan tree is dozens of nodes and real
+    queries fire a handful of rules, so the witness inference and the
+    pinned subtrees are noise next to the lowering itself — and a
+    production-compiled plan stays verifiable after the fact."""
+
+    rule: str
+    before: Node
+    after: Node
+    before_fp: str
+    after_fp: str
+    schema: Optional[Dict] = None  # name -> DType witness (before-subtree)
+
+
+def _make_obligation(rule: str, before: Node, after: Node,
+                     catalog) -> Obligation:
+    try:
+        schema = infer_schema(before, catalog)
+    except PlanError:
+        # a malformed before-subtree cannot witness a schema; the
+        # discharge still runs its structural checks
+        schema = None
+    return Obligation(rule, before, after, fingerprint(before),
+                      fingerprint(after), schema)
 
 
 @dataclasses.dataclass
 class RewriteResult:
     plan: Node
     fired: Dict[str, int]
+    obligations: List[Obligation] = dataclasses.field(default_factory=list)
 
 
 # each rule: (name, fn(node, catalog, memo) -> Optional[Node]) — a
@@ -209,29 +255,45 @@ _MAX_PASSES = 64  # defensive bound; real plans converge in a handful
 
 
 def _one_pass(node: Node, catalog, fired: Dict[str, int],
-              rebuilt: Dict[int, Node], keepalive: List[Node]) -> Node:
+              rebuilt: Dict[int, Node], keepalive: List[Node],
+              rules: Tuple[Rule, ...],
+              obligations: Optional[List[Obligation]],
+              budget: Optional[List[int]]) -> Node:
     """One bottom-up pass: rewrite children (sharing-preserving via the
     ``rebuilt`` memo), then apply rules at this node until none fires.
     ``keepalive`` pins every memo key's node for the pass so an id()
-    can never be recycled into a stale hit."""
+    can never be recycled into a stale hit. Each fire appends one
+    ``Obligation`` (the before-subtree and the rule's ONE-STEP output,
+    captured before recursing into the fresh children) and spends one
+    unit of ``budget`` when set — the fuzzer's bisection replays the
+    deterministic fire sequence with ``max_fires=k``."""
     key = id(node)
     if key in rebuilt:
         return rebuilt[key]
-    new_inputs = tuple(_one_pass(i, catalog, fired, rebuilt, keepalive)
+    new_inputs = tuple(_one_pass(i, catalog, fired, rebuilt, keepalive,
+                                 rules, obligations, budget)
                        for i in node.inputs())
     cur = node if all(a is b for a, b in zip(new_inputs, node.inputs())) \
         else _with_inputs(node, new_inputs)
     changed = True
     while changed:
         changed = False
-        for name, fn in RULES:
+        if budget is not None and budget[0] <= 0:
+            break
+        for name, fn in rules:
             nxt = fn(cur, catalog, None)
             if nxt is not None:
                 fired[name] = fired.get(name, 0) + 1
+                if budget is not None:
+                    budget[0] -= 1
+                if obligations is not None:
+                    obligations.append(
+                        _make_obligation(name, cur, nxt, catalog))
                 # a rule's output may contain unrewritten children —
                 # recurse over the fresh subtree before retrying rules
                 sub_inputs = tuple(
-                    _one_pass(i, catalog, fired, rebuilt, keepalive)
+                    _one_pass(i, catalog, fired, rebuilt, keepalive,
+                              rules, obligations, budget)
                     for i in nxt.inputs()
                 )
                 cur = nxt if all(a is b for a, b in zip(sub_inputs, nxt.inputs())) \
@@ -276,25 +338,40 @@ def _with_inputs(node: Node, inputs: Tuple[Node, ...]) -> Node:
     raise PlanError(f"unknown plan node {type(node).__name__}")
 
 
-def rewrite(plan: Node, catalog: Dict[str, Dict]) -> RewriteResult:
+def rewrite(plan: Node, catalog: Dict[str, Dict], *,
+            rules: Optional[Tuple[Rule, ...]] = None,
+            max_fires: Optional[int] = None,
+            prune: bool = True) -> RewriteResult:
     """Run the rule set bottom-up to a fixpoint, then prune columns.
     ``catalog`` maps table name -> {column: DType} (rules that split
-    predicates or null-fill rolled keys need schemas)."""
+    predicates or null-fill rolled keys need schemas). Every rule
+    firing emits a translation-validation ``Obligation`` (discharged by
+    ``plan.verifier``); ``rules``/``max_fires``/``prune`` exist for the
+    fuzzer's bisection (replay the first k fires of the deterministic
+    chain) and for seeded broken-rewrite fixtures."""
+    rules = RULES if rules is None else tuple(rules)
     infer_schema(plan, catalog)  # validate before touching anything
     fired: Dict[str, int] = {}
+    obligations: List[Obligation] = []
+    budget = None if max_fires is None else [max_fires]
     from .nodes import structure
 
     cur = plan
     for _ in range(_MAX_PASSES):
         before = structure(cur)
-        cur = _one_pass(cur, catalog, fired, {}, [])
+        cur = _one_pass(cur, catalog, fired, {}, [], rules, obligations,
+                        budget)
         if structure(cur) == before:
             break
     else:
         raise PlanError("rewrite did not converge (rule oscillation?)")
-    cur = prune_columns(cur, catalog)
+    if prune:
+        pre_prune = cur
+        cur = prune_columns(cur, catalog)
+        obligations.append(
+            _make_obligation("prune_columns", pre_prune, cur, catalog))
     infer_schema(cur, catalog)  # the rewritten plan must still validate
-    return RewriteResult(cur, fired)
+    return RewriteResult(cur, fired, obligations)
 
 
 # ---------------------------------------------------------------------------

@@ -492,6 +492,20 @@ declare("SRJT_PLAN_REPORT", "str", None,
         "path — the ci/premerge.sh compiler tier's artifact source",
         scope="harness")
 
+# plan verification + differential fuzzing (plan/verifier.py,
+# analysis/plancheck.py, analysis/planfuzz.py, ISSUE 15)
+declare("SRJT_PLANCHECK_ROWS", "int", 256,
+        "rows bound per generator when the plancheck CLI compiles the "
+        "checked-in plans (compile-only — no execution)",
+        scope="harness", positive=True)
+declare("SRJT_PLANCHECK_FUZZ_SEEDS", "str", "1234",
+        "comma-separated base seeds for the planfuzz differential "
+        "smoke; every generated plan is a pure function of "
+        "(seed, index)", scope="harness")
+declare("SRJT_PLANCHECK_FUZZ_PLANS", "int", 50,
+        "plans generated per base seed by the planfuzz CLI",
+        scope="harness", minimum=1)
+
 # correctness tooling (analysis/, ISSUE 7)
 declare("SRJT_LOCKDEP", "bool", False,
         "arm the runtime lock-order instrumentation "

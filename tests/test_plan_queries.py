@@ -42,7 +42,7 @@ def _run_checked(name: str, tables):
     rep = cp.last_report
     assert rep["nodes_raw"] > 0 and rep["nodes_optimized"] > 0
     assert rep["est_peak_bytes"] > 0
-    assert rep["peak_blowup"] is None or rep["peak_blowup"] <= 4.0, rep
+    assert rep["peak_blowup"] is None or rep["peak_blowup"] <= 3.0, rep
     return out, cp
 
 
@@ -361,6 +361,102 @@ class TestRollupHaving:
         got = list(zip(_i(out.column("c_customer_id")).tolist(),
                        _i(out.column("cnt")).tolist()))
         assert got == rows
+
+
+class TestBandStars:
+    """q13/q48 (ISSUE 15 satellite): OR'ed demographic/price/address
+    bands over the six-way store star, fully fused global aggregates."""
+
+    def _joined(self, tabs):
+        ss = tabs["store_sales"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "cd": _i(ss.column("ss_cdemo_sk")),
+            "cu": _i(ss.column("ss_customer_sk")),
+            "hd": _i(ss.column("ss_hdemo_sk")),
+            "qty": _i(ss.column("ss_quantity")),
+            "list": _f64(ss.column("ss_list_price")),
+            "coup": _f64(ss.column("ss_coupon_amt")),
+            "sales": _f64(ss.column("ss_sales_price")),
+        })
+        dd = tabs["date_dim"]
+        cdt = tabs["customer_demographics"]
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        hd = tabs["household_demographics"]
+        j = (df.merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                                    "y": _i(dd.column("d_year"))}), on="d")
+             .merge(pd.DataFrame({"cd": _i(cdt.column("cd_demo_sk")),
+                                  "ms": _i(cdt.column("cd_marital_status")),
+                                  "ed": _i(cdt.column("cd_education_status"))}),
+                    on="cd")
+             .merge(pd.DataFrame({"hd": _i(hd.column("hd_demo_sk")),
+                                  "dep": _i(hd.column("hd_dep_count"))}),
+                    on="hd")
+             .merge(pd.DataFrame({"cu": _i(cu.column("c_customer_sk")),
+                                  "addr": _i(cu.column("c_current_addr_sk"))}),
+                    on="cu")
+             .merge(pd.DataFrame({"addr": _i(ca.column("ca_address_sk")),
+                                  "zip": _i(ca.column("ca_zip5"))}),
+                    on="addr"))
+        return j[j.y == 2000]
+
+    def test_q13_band_star_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q13", tabs)
+        assert cp.last_report["fused_stages"] == 1
+        j = self._joined(tabs)
+        band1 = (j.ms <= 2) & (j.ed >= 3) & (j.sales >= 50.0) & (j.dep <= 5)
+        band2 = (j.ms >= 3) & (j.ed <= 2) & (j.sales <= 100.0) & (j.dep >= 4)
+        j = j[(band1 | band2) & ((j.zip < 120) | (j.zip >= 210))]
+        assert len(j) > 0  # the bands must select real rows
+        assert out.num_rows == 1
+        for name, src in (("avg_qty", "qty"), ("avg_list", "list"),
+                          ("avg_coupon", "coup")):
+            assert _f64(out.column(name))[0] == _exact_mean(j[src].tolist()), name
+        assert _f64(out.column("sum_sales"))[0] == math.fsum(j.sales.tolist())
+
+    def test_q48_band_sum_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q48", tabs)
+        assert cp.last_report["fused_stages"] == 1
+        j = self._joined(tabs)
+        demo = (((j.ms == 2) & (j.ed == 3) & (j.sales >= 50.0)
+                 & (j.sales <= 150.0))
+                | ((j.ms == 1) & (j.ed == 4) & (j.sales <= 100.0)))
+        addr = (j.zip < 100) | ((j.zip >= 150) & (j.zip < 250))
+        j = j[demo & addr]
+        assert len(j) > 0
+        assert _f64(out.column("qty_sum"))[0] == float(sum(j.qty.tolist()))
+
+    def test_q65_low_revenue_items_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q65", tabs)
+        assert cp.last_report["rewrites"].get("decorrelate_scalar_agg") == 1
+        assert cp.last_report["fused_stages"] >= 1  # the (store,item) agg
+
+        ss = tabs["store_sales"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "st": _i(ss.column("ss_store_sk")),
+            "i": _i(ss.column("ss_item_sk")),
+            "p": _f64(ss.column("ss_sales_price")),
+        })
+        df = df[(df.d >= 400) & (df.d <= 1100)]
+        rev = {k: math.fsum(g.p.tolist()) for k, g in df.groupby(["st", "i"])}
+        per_store = {}
+        for (st, _), v in rev.items():
+            per_store.setdefault(st, []).append(v)
+        ave = {st: _exact_mean(v) for st, v in per_store.items()}
+        iid = dict(zip(_i(tabs["item"].column("i_item_sk")).tolist(),
+                       _i(tabs["item"].column("i_item_id")).tolist()))
+        rows = sorted((st, iid[i], v) for (st, i), v in rev.items()
+                      if v <= 0.5 * ave[st])
+        assert rows  # nonempty under the default fraction
+        assert _i(out.column("ss_store_sk")).tolist() == [r[0] for r in rows]
+        assert _i(out.column("i_item_id")).tolist() == [r[1] for r in rows]
+        np.testing.assert_array_equal(
+            _f64(out.column("revenue")), np.array([r[2] for r in rows]))
 
 
 class TestSetOpsExists:

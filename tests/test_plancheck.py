@@ -1,0 +1,426 @@
+"""srjt-plancheck tier (ISSUE 15): the plan-IR verifier's rule catalog
+(each broken-plan/broken-rewrite fixture fires EXACTLY ONE verifier
+rule), per-rewrite translation validation on the real rule set, the
+SRJT011 lint rule, the differential fuzzer's fixed-seed smoke, and
+bisection of an intentionally wrong rewrite."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from spark_rapids_jni_tpu import plan as P
+from spark_rapids_jni_tpu.analysis import lint as L
+from spark_rapids_jni_tpu.analysis import plancheck, planfuzz
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.plan import nodes as pn
+from spark_rapids_jni_tpu.plan import rewrites as rw
+
+
+def icol(a, d=dt.INT32):
+    return Column(d, data=jnp.asarray(np.asarray(a, np.dtype(d.np_dtype))))
+
+
+def fcol(a):
+    return Column(dt.FLOAT64,
+                  data=jnp.asarray(np.asarray(a, np.float64).view(np.uint64)))
+
+
+@pytest.fixture
+def tabs(rng):
+    n = 300
+    fact = Table(
+        [icol(rng.integers(0, 30, n)), icol(rng.integers(0, 8, n)),
+         fcol(rng.uniform(0, 50, n).round(2)),
+         icol(rng.integers(1, 20, n), dt.INT64)],
+        ["f_dim_sk", "f_key", "f_price", "f_qty"],
+    )
+    dim = Table(
+        [icol(np.arange(30)), icol(1 + np.arange(30) % 12),
+         icol(np.arange(30) % 3)],
+        ["d_sk", "d_moy", "d_cls"],
+    )
+    return {"fact": fact, "dim": dim}
+
+
+def cat_of(tabs):
+    return {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
+            for t, tbl in tabs.items()}
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+class TestWellFormedness:
+    def test_clean_plan_passes_and_cross_checks(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Aggregate(
+            P.Join(P.Scan("fact"),
+                   P.Filter(P.Scan("dim"), P.pcol("d_moy") == P.plit(11)),
+                   on=(("f_dim_sk", "d_sk"),)),
+            keys=("f_key",), aggs=(P.AggSpec("f_price", "sum", "t"),),
+        )
+        assert P.verify_plan(ir, cat) == []
+
+    def test_unresolved_column_fires_plan001_once(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Filter(P.Scan("fact"), P.pcol("zzz") > P.plit(1))
+        # one defect, one finding — no cascade through the parents
+        outer = P.Limit(P.Sort(ir, (("f_key", True),)), 5)
+        assert rules_of(P.verify_plan(outer, cat)) == ["PLAN001"]
+
+    def test_unknown_table_fires_plan001(self, tabs):
+        assert rules_of(P.verify_plan(P.Scan("nope"), cat_of(tabs))) \
+            == ["PLAN001"]
+
+    def test_non_bool_predicate_fires_plan002(self, tabs):
+        ir = P.Filter(P.Scan("fact"), P.pcol("f_key") + P.plit(1))
+        assert rules_of(P.verify_plan(ir, cat_of(tabs))) == ["PLAN002"]
+
+    def test_union_schema_mismatch_fires_plan002(self, tabs):
+        ir = P.UnionAll((P.Scan("fact"), P.Scan("dim")))
+        assert rules_of(P.verify_plan(ir, cat_of(tabs))) == ["PLAN002"]
+
+    def test_join_payload_collision_fires_plan003(self, tabs):
+        ir = P.Join(P.Scan("fact"), P.Scan("fact"), on=(("f_key", "f_key"),))
+        assert rules_of(P.verify_plan(ir, cat_of(tabs))) == ["PLAN003"]
+
+    def test_non_numeric_aggregate_fires_plan002(self, tabs):
+        bad = P.Aggregate(
+            P.Project(P.Scan("fact"),
+                      (("b", P.pcol("f_key") > P.plit(1)),)),
+            keys=(), aggs=(P.AggSpec("b", "sum", "s"),))
+        assert rules_of(P.verify_plan(bad, cat_of(tabs))) == ["PLAN002"]
+
+    def test_sugar_allowed_raw_banned_after_fixpoint(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Exists(P.Scan("fact"), P.Scan("dim"),
+                      on=(("f_dim_sk", "d_sk"),))
+        assert P.verify_plan(ir, cat, desugared=False) == []
+        assert rules_of(P.verify_plan(ir, cat, desugared=True)) == ["PLAN004"]
+
+
+class TestTranslationValidation:
+    """Every REAL rule's obligations discharge; each seeded broken
+    rewrite fires exactly one PLAN006."""
+
+    def _composite(self):
+        src = P.Scan("fact")
+        corr = P.CorrelatedAggFilter(
+            src, src, on=("f_key", "f_key"),
+            agg=P.AggSpec("f_price", "mean", "avg_p"),
+            predicate=P.pcol("f_price") > P.pcol("avg_p"))
+        withdim = P.Filter(
+            P.Join(corr, P.Scan("dim"), on=(("f_dim_sk", "d_sk"),)),
+            P.pcol("d_moy") == P.plit(11))
+        ex = P.Exists(withdim, P.Scan("dim"), on=(("f_dim_sk", "d_sk"),))
+        ru = P.Aggregate(ex, keys=("f_key", "d_cls"),
+                         aggs=(P.AggSpec("f_price", "sum", "s"),),
+                         grouping_sets=P.rollup("f_key", "d_cls"))
+        return P.Having(
+            P.Aggregate(ru, keys=("f_key",),
+                        aggs=(P.AggSpec("s", "count", "c"),)),
+            P.pcol("c") > P.plit(0))
+
+    def test_real_rules_discharge(self, tabs):
+        cat = cat_of(tabs)
+        res = P.rewrite(self._composite(), cat)
+        fired_rules = {ob.rule for ob in res.obligations}
+        assert {"decorrelate_scalar_agg", "exists_to_semijoin",
+                "expand_grouping_sets", "having_to_filter",
+                "push_filter_into_join", "prune_columns"} <= fired_rules
+        assert P.verify_obligations(res.obligations, cat) == []
+        for ob in res.obligations:
+            assert ob.before_fp and ob.after_fp and ob.schema is not None
+
+    def test_setop_union_project_push_discharge(self, tabs):
+        cat = cat_of(tabs)
+        a = P.Project(P.Scan("fact"), (("k", P.pcol("f_key")),))
+        b = P.Project(P.Scan("dim"), (("k", P.pcol("d_cls")),))
+        so = P.Filter(P.SetOp(a, b, "intersect"), P.pcol("k") > P.plit(0))
+        res = P.rewrite(so, cat)
+        assert "setop_to_joins" in res.fired
+        assert P.verify_obligations(res.obligations, cat) == []
+        u = P.Filter(P.UnionAll((P.Scan("fact"), P.Scan("fact"))),
+                     P.pcol("f_key") > P.plit(2))
+        res2 = P.rewrite(u, cat)
+        assert "push_filter_through_union" in res2.fired
+        assert "merge_filters" not in res2.fired
+        assert P.verify_obligations(res2.obligations, cat) == []
+
+    # -- the gate-can-fail fixtures (each: exactly one rule fires) ---------
+
+    def test_schema_dropping_project_fires_one_plan006(self, tabs):
+        cat = cat_of(tabs)
+
+        def drop_last(node, catalog, memo):
+            if isinstance(node, pn.Project) and len(node.exprs) == 2:
+                return pn.Project(node.input, node.exprs[:-1])
+            return None
+
+        ir = P.Project(P.Scan("fact"), (("k", P.pcol("f_key")),
+                                        ("p", P.pcol("f_price"))))
+        res = P.rewrite(ir, cat, rules=(("drop_last_output", drop_last),),
+                        prune=False)
+        assert res.fired == {"drop_last_output": 1}
+        vs = P.verify_obligations(res.obligations, cat)
+        assert rules_of(vs) == ["PLAN006"]
+        # no discharger is registered for the fixture rule, so the
+        # violation names the coverage gap, not a structural check
+        assert "no discharger registered" in vs[0].message
+
+    def test_schema_drop_under_real_rule_name_fires_one_plan006(self, tabs):
+        """A broken rewrite that IS covered by a discharger: the
+        schema-equality witness catches the dropped column."""
+        cat = cat_of(tabs)
+
+        def bad_having(node, catalog, memo):
+            if isinstance(node, pn.Having):
+                # drops the predicate's row-subset AND narrows: rebuild
+                # as a filter over a NARROWED project (schema change)
+                return pn.Project(node.input, (("c", P.pcol("c")),))
+            return None
+
+        ir = P.Having(
+            P.Aggregate(P.Scan("fact"), keys=("f_key",),
+                        aggs=(P.AggSpec(None, "count_all", "c"),)),
+            P.pcol("c") > P.plit(1))
+        res = P.rewrite(ir, cat, rules=(("having_to_filter", bad_having),),
+                        prune=False)
+        vs = P.verify_obligations(res.obligations, cat)
+        assert rules_of(vs) == ["PLAN006"]
+        assert "schema not preserved" in vs[0].message
+
+    def test_filter_pushed_past_incompatible_join_fires_one_plan006(self, tabs):
+        """Pushing a build-side conjunct below a LEFT join (legal only
+        for inner): the discharge's legality check refuses it."""
+        cat = cat_of(tabs)
+
+        def bad_push(node, catalog, memo):
+            from spark_rapids_jni_tpu.plan import exprs as pex
+
+            if not (isinstance(node, pn.Filter)
+                    and isinstance(node.input, pn.Join)):
+                return None
+            j = node.input
+            rs = set(P.infer_schema(j.right, catalog))
+            to_right = [c for c in pex.conjuncts(node.predicate)
+                        if c.refs() <= rs]
+            if not to_right or j.how == "inner":
+                return None
+            return pn.Join(j.left, pn.Filter(j.right, pex.conjoin(to_right)),
+                           on=j.on, how=j.how)
+
+        ir = P.Filter(
+            P.Join(P.Scan("fact"), P.Scan("dim"), on=(("f_dim_sk", "d_sk"),),
+                   how="left"),
+            P.pcol("d_moy") == P.plit(11))
+        res = P.rewrite(ir, cat,
+                        rules=(("push_filter_into_join", bad_push),),
+                        prune=False)
+        assert res.fired == {"push_filter_into_join": 1}
+        vs = P.verify_obligations(res.obligations, cat)
+        assert rules_of(vs) == ["PLAN006"]
+        assert "left join" in vs[0].message
+
+    def test_sugar_left_unresolved_fires_one_plan004(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Exists(P.Scan("fact"), P.Scan("dim"),
+                      on=(("f_dim_sk", "d_sk"),))
+        crippled = tuple(r for r in rw.RULES if r[0] != "exists_to_semijoin")
+        res = P.rewrite(ir, cat, rules=crippled, prune=False)
+        vs = P.verify_plan(res.plan, cat, desugared=True)
+        assert rules_of(vs) == ["PLAN004"]
+
+    def test_estimate_inversion_fires_one_plan005(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Limit(P.Sort(P.Scan("fact"), (("f_key", True),)), 5)
+        cp = P.compile_ir(ir, tabs, name="inv")
+        assert P.verify_estimates(cp) == []
+        limit = next(s for s in cp.stages if s.kind == "limit")
+        limit.est_rows = limit.inputs[0].est_rows + 7  # seeded inversion
+        limit.est_bytes = limit.est_rows * 24  # keep the presence check green
+        vs = P.verify_estimates(cp)
+        assert rules_of(vs) == ["PLAN005"]
+        assert "inversion" in vs[0].message
+
+    def test_peak_disagreement_fires_plan005(self, tabs):
+        cat = cat_of(tabs)
+        ir = P.Aggregate(P.Scan("fact"), keys=("f_key",),
+                         aggs=(P.AggSpec("f_price", "sum", "t"),))
+        cp = P.compile_ir(ir, tabs, name="peak")
+        cp.estimated_memory_bytes += 1
+        vs = P.verify_estimates(cp)
+        assert rules_of(vs) == ["PLAN005"]
+        assert "memgov" in vs[0].message
+
+
+class TestLintSRJT011:
+    SRC = """
+def _rule_a(node, catalog, memo):
+    return None
+
+def _rule_b(node, catalog, memo):
+    # srjt-plan: allow-unverified(cost-only hint; never changes rows)
+    return None
+
+def _rule_c(node, catalog, memo):
+    # srjt-plan: allow-unverified()
+    return None
+"""
+
+    def _check(self, rules, dischargers):
+        fns = {}
+        exec(self.SRC, fns)  # fixture rule functions with real __name__
+        pairs = [(name, fns[f"_rule_{name[-1]}"]) for name in rules]
+        return L.check_rewrite_obligations(
+            rules=pairs, dischargers=dischargers, src=self.SRC,
+            path="fixture_rewrites.py")
+
+    def test_undischarged_rule_fires_srjt011(self):
+        vs = self._check(["rule_a"], dischargers=())
+        assert [v.rule for v in vs] == ["SRJT011"]
+        assert "rule_a" in vs[0].message
+
+    def test_reasoned_suppression_passes(self):
+        assert self._check(["rule_b"], dischargers=()) == []
+
+    def test_empty_reason_is_srjt000(self):
+        vs = self._check(["rule_c"], dischargers=())
+        assert [v.rule for v in vs] == ["SRJT000"]
+
+    def test_stale_suppression_on_discharged_rule_is_srjt000(self):
+        vs = self._check(["rule_b"], dischargers=("rule_b",))
+        assert [v.rule for v in vs] == ["SRJT000"]
+        assert "stale" in vs[0].message
+
+    def test_discharged_rule_clean(self):
+        assert self._check(["rule_a"], dischargers=("rule_a",)) == []
+
+    def test_real_tree_clean_and_total(self):
+        assert L.check_rewrite_obligations() == []
+        # the map really is total: every registered rule has a discharger
+        from spark_rapids_jni_tpu.plan import verifier as pv
+
+        names = {n for n, _ in rw.RULES} | {"prune_columns"}
+        assert names <= set(pv.OBLIGATION_DISCHARGERS)
+
+
+class TestPlancheckCLI:
+    def test_subset_clean_with_report(self, tmp_path):
+        report = tmp_path / "plan_verify.jsonl"
+        violations, records = plancheck.run(
+            rows=128, queries=["q96", "q73", "q3"], report=str(report))
+        assert violations == []
+        rows = [json.loads(s) for s in report.read_text().splitlines()]
+        assert {r["query"] for r in rows} == {"q96", "q73", "q3"}
+        assert all(r["violations"] == 0 and r["obligations"] >= 1
+                   and r["est_peak_bytes"] > 0 for r in rows)
+
+    def test_main_exit_codes_and_format_parity(self, tmp_path):
+        assert plancheck.main(["--rows", "96", "--queries", "q96"]) == 0
+        out = tmp_path / "f.sarif"
+        assert plancheck.main(["--rows", "96", "--queries", "q96",
+                               "--format", "sarif", "--out", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert doc["runs"][0]["results"] == []
+
+    def test_unknown_query_name_fails_loudly(self):
+        with pytest.raises(SystemExit, match="unknown plan name"):
+            plancheck.run(rows=64, queries=["q999"])
+
+    def test_broken_fixture_exits_one_in_every_format(self, tabs, tmp_path,
+                                                      capsys):
+        """The gate-can-fail proof at the CLI contract level: a broken
+        rewrite's PLAN006 drives exit code 1 through the shared
+        emitters, identically across formats."""
+        cat = cat_of(tabs)
+
+        def bad_having(node, catalog, memo):
+            if isinstance(node, pn.Having):
+                return pn.Project(node.input, (("c", P.pcol("c")),))
+            return None
+
+        ir = P.Having(
+            P.Aggregate(P.Scan("fact"), keys=("f_key",),
+                        aggs=(P.AggSpec(None, "count_all", "c"),)),
+            P.pcol("c") > P.plit(1))
+        res = P.rewrite(ir, cat, rules=(("having_to_filter", bad_having),),
+                        prune=False)
+        vs = P.verify_obligations(res.obligations, cat)
+        assert rules_of(vs) == ["PLAN006"]
+        codes = set()
+        for fmt in ("text", "json", "sarif"):
+            codes.add(L.write_findings(
+                vs, fmt, str(tmp_path / f"f.{fmt}"), "srjt-plancheck"))
+        capsys.readouterr()
+        assert codes == {1}
+        assert L.write_findings([], "text", None, "srjt-plancheck") == 0
+        capsys.readouterr()
+
+
+class TestFuzz:
+    def test_fixed_seed_smoke_zero_mismatches(self, tmp_path):
+        report = tmp_path / "fuzz.jsonl"
+        findings, records = planfuzz.run([20260804], 8, rows=96,
+                                         report=str(report))
+        assert findings == []
+        rec = json.loads(report.read_text().splitlines()[0])
+        assert rec["kind"] == "fuzz" and rec["plans"] == 8
+        assert rec["mismatches"] == 0 and rec["violations"] == 0
+        assert sum(rec["templates"].values()) == 8
+
+    def test_generated_plans_deterministic_and_wellformed(self):
+        from spark_rapids_jni_tpu.models.tpcds import gen_store_wide
+
+        tables = gen_store_wide(96, seed=97)
+        cat = plancheck.catalog_of(tables)
+        for i in range(6):
+            rng1 = np.random.default_rng(555 + i)
+            rng2 = np.random.default_rng(555 + i)
+            p1, t1 = planfuzz.gen_plan(rng1)
+            p2, t2 = planfuzz.gen_plan(rng2)
+            assert t1 == t2
+            assert P.structure(p1) == P.structure(p2)  # seed-pure
+            assert P.verify_plan(p1, cat) == []
+
+    def test_oracle_interprets_sugar_natively(self, tabs):
+        rels = {t: planfuzz.rel_of_table(tbl) for t, tbl in tabs.items()}
+        ir = P.Exists(P.Scan("fact"),
+                      P.Filter(P.Scan("dim"), P.pcol("d_cls") == P.plit(0)),
+                      on=(("f_dim_sk", "d_sk"),), negated=True)
+        names, rows = planfuzz.interpret(ir, rels)
+        assert names == ["f_dim_sk", "f_key", "f_price", "f_qty"]
+        # engine agrees (anti join over the filtered dim)
+        cp = P.compile_ir(ir, tabs, name="sugar_oracle")
+        gnames, grows = planfuzz.rel_of_table(cp())
+        assert gnames == names
+        assert planfuzz.canon(grows) == planfuzz.canon(rows)
+
+    def test_bisection_blames_the_broken_rewrite(self, tabs):
+        rels = {t: planfuzz.rel_of_table(tbl) for t, tbl in tabs.items()}
+        cat = cat_of(tabs)
+
+        def broken_merge(node, catalog, memo):
+            if not (isinstance(node, pn.Filter)
+                    and isinstance(node.input, pn.Filter)):
+                return None
+            return pn.Filter(node.input.input, node.predicate)  # inner LOST
+
+        rules = tuple(("merge_filters", broken_merge)
+                      if n == "merge_filters" else (n, f)
+                      for n, f in rw.RULES)
+        ir = P.Aggregate(
+            P.Filter(P.Filter(P.Scan("fact"),
+                              P.pcol("f_qty") > P.plit(10)),
+                     P.pcol("f_key") <= P.plit(3)),
+            keys=(), aggs=(P.AggSpec("f_qty", "sum", "s"),))
+        blame = planfuzz.bisect_mismatch(ir, rels, cat, rules=rules)
+        assert blame["rule"] == "merge_filters"
+        assert blame["first_bad_fire"] == 1
+        # and a clean rule set blames nothing
+        ok = planfuzz.bisect_mismatch(ir, rels, cat)
+        assert ok["first_bad_fire"] is None and ok["rule"] == "lowering"
