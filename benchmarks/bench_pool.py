@@ -1,6 +1,7 @@
-"""Data-plane benchmark: slab-arena pool scaling + 2-process exchange (ISSUE 6).
+"""Data-plane benchmark: slab-arena pool scaling + N-process exchange
+(ISSUE 6, N-rank tier ISSUE 16).
 
-Two stages, each emitting BENCH rows (JSON lines, the bench.py /
+Three stages, each emitting BENCH rows (JSON lines, the bench.py /
 microbench.py discipline; ``SRJT_RESULTS`` appends them to a file):
 
 - **pool**: arena-resident op throughput at pool sizes 1/2/4. Each
@@ -20,17 +21,25 @@ microbench.py discipline; ``SRJT_RESULTS`` appends them to a file):
   (``shuffle.tcp.bytes_in/out``), and the distributed groupby result
   is verified bit-identical to the single-process oracle before the
   row is emitted.
+- **nrank**: the same exchange at world sizes 2 and 4 (weak scaling:
+  rows per rank constant), ranks 1..N-1 spawned as a fleet. Reports
+  AGGREGATE MB/s — rank 0's socket bytes scaled by world (the
+  all-to-all is symmetric). The premerge gate asserts world-4
+  aggregate >= 2.5x world-2: growing the world grows cross-rank
+  volume per rank, so a healthy data plane scales super-linearly.
 
 Usage::
 
-    python benchmarks/bench_pool.py                     # both stages
+    python benchmarks/bench_pool.py                     # all stages
     python benchmarks/bench_pool.py --sizes 1,2 --ops 40 --delay-ms 20
     python benchmarks/bench_pool.py --stage exchange --exchange-rows 500000
+    python benchmarks/bench_pool.py --stage nrank --nrank-worlds 2,4
 """
 
 from __future__ import annotations
 
 import argparse
+import contextvars
 import itertools
 import json
 import os
@@ -212,9 +221,153 @@ def bench_exchange(rows: int, seed: int = 13) -> float:
     return mbps
 
 
+# ---------------------------------------------------------------------------
+# stage 3: N-rank exchange aggregate throughput (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def bench_exchange_nrank(rows_per_rank: int, world: int,
+                         seed: int = 17, delay_ms: int = 900) -> float:
+    """Weak-scaling N-rank exchange: rank 0 here, ranks 1..world-1
+    spawned via ``spawn_exchange_fleet`` (the cluster tier's bring-up
+    path), every rank holding ``rows_per_rank`` rows. Verifies the
+    distributed groupby bit-identical to the single-process oracle
+    FIRST, then reports aggregate MB/s over one steady-state round —
+    rank 0's measured socket bytes scaled by world, valid because the
+    all-to-all is symmetric (every rank moves the same expected
+    volume; the hash is uniform over the demo key space).
+
+    Like the pool stage, a fault-injected latency floor
+    (``delay_ms`` at ``exchange.serve.payload``, every rank) stands in
+    for network latency so the round is LATENCY-dominated and the
+    measurement is transport CONCURRENCY, not host core count: a
+    world-4 rank must overlap its 3 pulls (wall = slowest peer), so
+    with ~equal round walls the 3x cross-rank bytes of world 4 puts
+    aggregate throughput >= 2.5x world 2 — the premerge gate. A data
+    plane that serializes its pulls pays the floor world-1 times
+    sequentially and fails the gate on any host."""
+    from spark_rapids_jni_tpu.columnar import frames as frames_mod
+    from spark_rapids_jni_tpu.utils import faultinj
+
+    rows = rows_per_rank * world
+    full = shuffle._demo_table(rows, seed=seed)
+    ref = shuffle._local_groupby_sum(full)
+    lo, hi = shuffle._shard_bounds(rows, world, 0)
+    shard0 = slice_table(full, lo, hi)
+
+    # compile excluded (bench discipline): warm the exact partition
+    # slices + frame encodes publish() will hit inside the window.
+    # The frames are deterministic, so their sizes ARE the round's
+    # byte accounting — socket counters would race with peer serves
+    # straddling the timed window.
+    parts_w, offs_w = shuffle.hash_partition(shard0, world, ["k"])
+    bounds_w = list(offs_w) + [parts_w.num_rows]
+    out_bytes = 0
+    for p in range(1, world):
+        out_bytes += len(frames_mod.encode_table(
+            slice_table(parts_w, bounds_w[p], bounds_w[p + 1])))
+    in_bytes = 0  # what each peer's shard sends to rank 0 (same data)
+    for r in range(1, world):
+        rlo, rhi = shuffle._shard_bounds(rows, world, r)
+        parts_r, offs_r = shuffle.hash_partition(
+            slice_table(full, rlo, rhi), world, ["k"])
+        bounds_r = list(offs_r) + [parts_r.num_rows]
+        in_bytes += len(frames_mod.encode_table(
+            slice_table(parts_r, bounds_r[0], bounds_r[1])))
+    moved0 = out_bytes + in_bytes
+    delay_cfg = {"faults": {"exchange.serve.payload": {
+        "type": "delay", "percent": 100, "delayMs": int(delay_ms)}}}
+    fd, cfg_path = tempfile.mkstemp(prefix="srjt-nrank-delay-", suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(delay_cfg, f)
+    faultinj.configure(delay_cfg)  # rank 0's serves pay the same floor
+    ex0 = shuffle.TcpExchange(0)
+    procs = {}
+    try:
+        # pin all_to_all on every rank: apples-to-apples across worlds
+        # (auto would switch to tree at world 4), and single-hop pulls
+        # are what aggregate socket throughput should measure
+        rounds = 4
+        procs, peers = shuffle.spawn_exchange_fleet(
+            ex0.address, rows, seed, world=world, rounds=rounds,
+            extra_env_by_rank={
+                r: {"SRJT_CLUSTER_TOPOLOGY": "all_to_all",
+                    "SRJT_FAULTINJ_CONFIG": cfg_path}
+                for r in range(1, world)
+            })
+        peer_map = {r: a for r, a in peers.items() if r != 0}
+        # tight poll schedule: backoff quantization is a fixed cost the
+        # world-4 round pays 3x as often, and it is not throughput
+        with retry.enabled(max_attempts=200, base_delay_ms=10, max_delay_ms=50):
+            # rounds 0-1 warm: data-dependent shapes (received
+            # partitions, the world-way concat) compile once there, so
+            # the timed rounds are steady-state exchange, not jit; two
+            # timed rounds + min() shrugs off a scheduler hiccup
+            secs = None
+            for rnd in range(rounds):
+                t0 = time.perf_counter()
+                local0 = ex0.exchange_table(shard0, ["k"], peer_map,
+                                            epoch=2 * rnd,
+                                            topology="all_to_all")
+                dt = time.perf_counter() - t0
+                if rnd >= rounds - 2:
+                    secs = dt if secs is None else min(secs, dt)
+            res = {0: shuffle._local_groupby_sum(local0)}
+            errs = []
+
+            def _result(r, addr, ctx):
+                try:
+                    got = ctx.run(ex0.fetch, addr, 2 * rounds - 1, r)
+                    res[r] = shuffle.Table(got.columns, ["k", "s", "c"])
+                except Exception as e:  # surfaced after join
+                    errs.append(e)
+
+            ts = [threading.Thread(target=_result,
+                                   args=(r, a, contextvars.copy_context()))
+                  for r, a in peer_map.items()]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+        got = concatenate([res[r] for r in range(world)])
+        order = np.argsort(np.asarray(got.column("k").data))
+        for name in ("k", "s", "c"):
+            assert np.array_equal(
+                np.asarray(got.column(name).data)[order],
+                np.asarray(ref.column(name).data),
+            ), f"{world}-rank distributed groupby diverged ({name})"
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=30)
+                except Exception:
+                    proc.kill()
+        ex0.close()
+        faultinj.disable()
+        os.unlink(cfg_path)
+    aggregate_mbps = moved0 * world / secs / 1e6
+    _emit({
+        "metric": "exchange_nrank_mb_per_s",
+        "value": round(aggregate_mbps, 2),
+        "unit": "MB/s aggregate",
+        "world": world,
+        "rows_per_rank": rows_per_rank,
+        "rank0_bytes_moved": moved0,
+        "secs": round(secs, 4),
+        "injected_delay_ms": int(delay_ms),  # latency floor: the value
+        # is a concurrency ratio carrier, not raw socket speed
+        "bit_identical": True,
+    })
+    return aggregate_mbps
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--stage", choices=["pool", "exchange", "all"], default="all")
+    ap.add_argument("--stage", choices=["pool", "exchange", "nrank", "all"],
+                    default="all")
     ap.add_argument("--sizes", default="1,2,4",
                     help="comma-separated pool sizes (default 1,2,4)")
     ap.add_argument("--ops", type=int, default=60,
@@ -224,6 +377,12 @@ def main() -> int:
                     help="worker-side per-op latency floor (default 10)")
     ap.add_argument("--startup-timeout", type=float, default=180.0)
     ap.add_argument("--exchange-rows", type=int, default=250_000)
+    ap.add_argument("--nrank-worlds", default="2,4",
+                    help="comma-separated world sizes for the nrank stage "
+                         "(default 2,4)")
+    ap.add_argument("--nrank-rows-per-rank", type=int, default=125_000,
+                    help="rows held by each rank in the nrank stage "
+                         "(weak scaling; default 125000)")
     args = ap.parse_args()
 
     if args.stage in ("pool", "all"):
@@ -239,6 +398,9 @@ def main() -> int:
         })
     if args.stage in ("exchange", "all"):
         bench_exchange(args.exchange_rows)
+    if args.stage in ("nrank", "all"):
+        for world in [int(w) for w in args.nrank_worlds.split(",") if w]:
+            bench_exchange_nrank(args.nrank_rows_per_rank, world)
     return 0
 
 

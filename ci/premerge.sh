@@ -57,7 +57,7 @@ rows = [json.loads(s) for s in open("artifacts/plan_verify.jsonl")]
 plans = {r["query"]: r for r in rows if r["kind"] == "plan"}
 fuzz = [r for r in rows if r["kind"] == "fuzz"]
 from spark_rapids_jni_tpu.models.tpcds_plans import PLAN_QUERIES
-want = set(PLAN_QUERIES) | {"q3", "q55"}
+want = set(PLAN_QUERIES) | {"q3", "q55", "q3x4", "q55x4"}
 missing = sorted(want - set(plans))
 assert not missing, f"plans missing from plan_verify.jsonl: {missing}"
 bad = {q: r for q, r in plans.items() if r["violations"]}
@@ -220,6 +220,36 @@ assert "integrity.crc_mismatch" in kinds, "no frame corruption caught"
 assert "exchange.peer_respawn" in kinds, "no peer crash/respawn recorded"
 print(f"archived {len(lines)} data-plane events -> "
       "artifacts/data_plane_metrics.jsonl")
+EOF
+
+# cluster tier (ISSUE 16): the N-rank membership / fencing / recovery
+# suite env-armed under a hard timeout. The 4-process acceptance inside
+# arms ci/chaos_cluster.json in the children: rank 2 SIGKILLs itself
+# mid-frame on its first payload serve, rank 3 rides a transient
+# netsplit, rank 1 serves with latency jitter — and the distributed
+# groupby must stay bit-identical to the single-host oracle with
+# exactly one membership death. The archived event log must PROVE the
+# machinery engaged, not just that tests passed: a cluster.transition
+# into DEAD and a cluster.recovery republish under the bumped
+# generation are the artifact contract.
+rm -f artifacts/cluster_metrics.jsonl
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_RETRY_ENABLED=1 SRJT_RETRY_MAX_ATTEMPTS=10 \
+  SRJT_RETRY_BASE_DELAY_MS=1 SRJT_RETRY_MAX_DELAY_MS=8 SRJT_RETRY_SEED=99 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/cluster_metrics.jsonl \
+  python -m pytest tests/test_cluster.py -q
+python - <<'EOF'
+import json
+lines = [json.loads(s) for s in open("artifacts/cluster_metrics.jsonl")]
+assert lines, "cluster tier produced no events"
+deaths = [r for r in lines
+          if r["event"] == "cluster.transition" and r.get("new") == "dead"]
+assert deaths, "no membership transition into DEAD recorded"
+recoveries = [r for r in lines if r["event"] == "cluster.recovery"]
+assert recoveries, "no lineage recovery republish recorded"
+assert all(r["generation"] >= 2 for r in recoveries), \
+    "a recovery ran under the pre-death generation (fence not bumped)"
+print(f"archived {len(lines)} cluster events ({len(deaths)} deaths, "
+      f"{len(recoveries)} recoveries) -> artifacts/cluster_metrics.jsonl")
 EOF
 
 # serving tier (ISSUE 8): the full serve suite (incl. the slow
@@ -507,6 +537,33 @@ exch = [r for r in rows if r.get("metric") == "exchange_2proc_mb_per_s"]
 assert exch and exch[0].get("bit_identical"), "no verified exchange BENCH row"
 print(f"pool scaling {ratio:.2f}x (1={pool[1]:.1f}, 2={pool[2]:.1f} ops/s), "
       f"exchange {exch[0]['value']} MB/s -> artifacts/bench_pool.jsonl")
+EOF
+
+# N-rank exchange scaling gate (ISSUE 16 acceptance): aggregate
+# exchange MB/s at world 4 must be >= 2.5x world 2 on the nrank stage
+# (REAL spawned peer ranks, an injected per-serve latency floor so the
+# ratio measures pull CONCURRENCY, not socket bandwidth — perfect
+# scaling doubles both the payload and the parallel pulls hiding the
+# floor). Each row is emitted only after the distributed groupby
+# verified bit-identical to the single-host oracle at that world.
+timeout -k 10 600 env SRJT_RESULTS=artifacts/bench_pool.jsonl \
+  python benchmarks/bench_pool.py --stage nrank --nrank-worlds 2,4 \
+  --nrank-rows-per-rank 20000
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/bench_pool.jsonl")]
+nrank = {r["world"]: r for r in rows
+         if r.get("metric") == "exchange_nrank_mb_per_s"}
+assert 2 in nrank and 4 in nrank, f"missing nrank worlds: {sorted(nrank)}"
+assert all(r["bit_identical"] for r in nrank.values()), \
+    "an nrank row was emitted without oracle verification"
+ratio = nrank[4]["value"] / nrank[2]["value"]
+assert ratio >= 2.5, (
+    f"world-4 exchange scaling {ratio:.2f}x < 2.5x over world 2 "
+    f"({nrank[4]['value']} vs {nrank[2]['value']} MB/s): pulls serialized?")
+print(f"nrank exchange scaling {ratio:.2f}x "
+      f"(world2={nrank[2]['value']}, world4={nrank[4]['value']} MB/s) "
+      "-> artifacts/bench_pool.jsonl")
 EOF
 
 # kernel tier (ISSUE 13): the join/decode parity suite re-runs with

@@ -49,7 +49,7 @@ def _targets(rows: int, queries: Optional[List[str]]) -> List[Tuple[str, dict, o
     from ..models import tpcds
     from ..models import tpcds_plans as tp
 
-    known = set(tp.PLAN_QUERIES) | {"q3", "q55"}
+    known = set(tp.PLAN_QUERIES) | {"q3", "q55", "q3x4", "q55x4"}
     unknown = sorted(set(queries or ()) - known)
     if unknown:
         # a typo'd --queries must fail loudly, never verify an empty
@@ -66,6 +66,17 @@ def _targets(rows: int, queries: Optional[List[str]]) -> List[Tuple[str, dict, o
         out.append(("q3", tpcds.gen_store(rows, seed=11), tp.q3_plan()))
     if not queries or "q55" in (queries or ()):
         out.append(("q55", tpcds.gen_store(rows, seed=12), tp.q55_plan()))
+    # the 4-rank distributed variants (ISSUE 16): same plans with
+    # exchange stages inserted, verified like any other stage — the
+    # verifier must accept what the cluster tier actually runs
+    from ..plan.distribute import insert_exchanges
+
+    if not queries or "q3x4" in (queries or ()):
+        out.append(("q3x4", tpcds.gen_store(rows, seed=11),
+                    insert_exchanges(tp.q3_plan(), 4)))
+    if not queries or "q55x4" in (queries or ()):
+        out.append(("q55x4", tpcds.gen_store(rows, seed=12),
+                    insert_exchanges(tp.q55_plan(), 4)))
     return out
 
 

@@ -81,6 +81,11 @@ RACE_GOVERNED = (
     "sidecar.py",
     "memgov/",
     "parallel/shuffle.py",
+    # ISSUE 16: the cluster membership layer — ClusterView's state map,
+    # generation, and recovery-dedup set are written by the heartbeat
+    # thread and read by every exchanging thread; the _lock discipline
+    # is worth proving
+    "parallel/cluster.py",
     "utils/metrics.py",
     "utils/deadline.py",
     # ISSUE 12: the srjt-trace span layer — TraceContext's span buffer

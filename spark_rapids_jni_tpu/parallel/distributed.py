@@ -14,6 +14,15 @@ between stages):
 ``shard_groupby_sum`` is the static-shape groupby usable inside
 ``shard_map`` (the jit-safe sibling of ops.aggregate.groupby_aggregate,
 which host-syncs its group count).
+
+Scope note (ISSUE 16): everything here is the IN-MESH tier — shards of
+ONE runtime, one failure domain, XLA moving the bytes. The
+cross-PROCESS N-rank tier lives in ``shuffle.TcpExchange`` +
+``cluster.ClusterView``: membership, heartbeat liveness, and
+epoch-fenced lineage recovery, where a rank can die mid-query and the
+exchange fails over instead of erroring. A distributed groupby that
+must survive member churn runs THERE (the plan compiler's Exchange
+stage); this module's collective assumes every shard answers.
 """
 
 from __future__ import annotations

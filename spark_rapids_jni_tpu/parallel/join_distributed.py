@@ -11,6 +11,14 @@ right side by key, locate each left row's match run with two
 searchsorted probes, expand runs into (left, right) index pairs bounded
 by ``out_capacity`` with an occupancy mask; run overflow is *detected*
 (flag) like the shuffle's bucket overflow.
+
+Scope note (ISSUE 16): this is the in-mesh join — one runtime, one
+failure domain. The cross-process N-rank equivalent is a plan-compiler
+``Exchange`` stage on the join/group keys over ``TcpExchange`` with a
+``cluster.ClusterView`` attached (membership + epoch-fenced recovery);
+see ``plan/distribute.py``. Small build sides skip the exchange there
+entirely: the shard catalog replicates them per rank (broadcast join),
+so only the fact side's key space ever moves.
 """
 
 from __future__ import annotations

@@ -403,9 +403,35 @@ _EXC_GET = 1
 # boundary. Negotiated per request: untraced peers keep verb 1
 # byte-for-byte.
 _EXC_GET_TRACED = 3
+# srjt-cluster (ISSUE 16): epoch-fenced GETs carry the requester's
+# 4-byte cluster generation right after the header (after the trace
+# blob on the traced variant). The serving peer answers _EXC_STALE on
+# any mismatch — in either direction: a zombie server (older gen) must
+# not serve bytes to a current client, and a zombie CLIENT (older gen)
+# must not be fed partitions it will attribute to a dead world view.
+# A fenced OK response prefixes the SERVER's 4-byte generation before
+# the frame, so the fetcher verifies it before a single payload byte
+# reaches the decoder.
+_EXC_GET_FENCED = 4
+_EXC_GET_FENCED_TRACED = 5
+# liveness probe (parallel/cluster.py heartbeats): request epoch field
+# carries the sender's generation, part field the sender's rank; the
+# response is _EXC_OK with a 4-byte payload = responder's generation.
+_EXC_PING = 6
+_EXC_GEN = struct.Struct("<I")  # the 4-byte generation blob
 _EXC_OK = 0
 _EXC_RETRY = 1  # partition not (yet) published here: retryable
 _EXC_ERR = 2
+_EXC_STALE = 3  # generation fence mismatch: retryable desync
+
+# epoch-namespace strides (ISSUE 16): the binary-tree exchange keys each
+# round's intermediate frames at ``epoch + (round+1) * _TREE_EPOCH_STRIDE``
+# and a recovery republish lands at ``epoch + (dead_rank+1) *
+# _RECOVERY_EPOCH_STRIDE`` — both far above any caller's base-epoch
+# sequence (queries count epochs from 0 upward), so derived keys never
+# collide with a real round or with each other.
+_TREE_EPOCH_STRIDE = 1 << 16
+_RECOVERY_EPOCH_STRIDE = 1 << 24
 
 
 def exchange_mode() -> str:
@@ -421,24 +447,55 @@ def exchange_mode() -> str:
     return knobs.get_str("SRJT_EXCHANGE_MODE")
 
 
-_EXC_BREAKER = None
+_EXC_BREAKERS: Dict[str, object] = {}
 _EXC_BREAKER_LOCK = threading.Lock()
 
 
-def exchange_breaker():
-    """Process-global breaker for the TCP exchange path (mirrors
-    sidecar.breaker()): consecutive fetch failures open it and further
-    fetches fast-fail retryably without paying a dial; a half-open
-    probe after the cooldown restores the path. States land under
-    ``shuffle.exchange.breaker.*``."""
-    global _EXC_BREAKER
-    if _EXC_BREAKER is None:
-        with _EXC_BREAKER_LOCK:
-            if _EXC_BREAKER is None:
-                from ..utils.deadline import CircuitBreaker
+class _AllExchangeBreakers:
+    """No-arg ``exchange_breaker()`` facade: operations fan out to
+    every per-peer breaker (tests and teardown paths reset the whole
+    exchange path in one call, exactly like the old process-global
+    breaker)."""
 
-                _EXC_BREAKER = CircuitBreaker("shuffle.exchange.breaker")
-    return _EXC_BREAKER
+    @staticmethod
+    def _all():
+        with _EXC_BREAKER_LOCK:
+            return list(_EXC_BREAKERS.values())
+
+    def reset(self) -> None:
+        for br in self._all():
+            br.reset()
+
+    def snapshot(self) -> Dict[str, dict]:
+        with _EXC_BREAKER_LOCK:
+            return {addr: br.snapshot() for addr, br in _EXC_BREAKERS.items()}
+
+
+def exchange_breaker(addr: Optional[str] = None):
+    """Breaker for the TCP exchange path (mirrors sidecar.breaker()),
+    PER-PEER (ISSUE 16): each peer address owns its own breaker, so a
+    dead rank fast-fails its own fetches while pulls from healthy
+    peers flow untouched — one dark peer must never dark the whole
+    exchange. Consecutive fetch failures open a peer's breaker and
+    further fetches to it fast-fail retryably without paying a dial; a
+    half-open probe after the cooldown restores the path. States land
+    under ``shuffle.exchange.breaker.<peer>.*``.
+
+    With no ``addr`` the returned facade fans out to every per-peer
+    breaker (``reset()`` / ``snapshot()`` — the teardown surface)."""
+    if addr is None:
+        return _AllExchangeBreakers()
+    with _EXC_BREAKER_LOCK:
+        br = _EXC_BREAKERS.get(addr)
+        if br is None:
+            from ..utils.deadline import CircuitBreaker
+
+            # metric-name-safe peer key: dots and colons would collide
+            # with the metrics namespace separators
+            peer = addr.replace(".", "-").replace(":", "_")
+            br = CircuitBreaker(f"shuffle.exchange.breaker.{peer}")
+            _EXC_BREAKERS[addr] = br
+        return br
 
 
 def _parse_addr(addr: str) -> Tuple[str, int]:
@@ -505,6 +562,11 @@ class TcpExchange:
         self._lock = threading.Lock()
         self._published = threading.Condition(self._lock)
         self._closed = False
+        # srjt-cluster (ISSUE 16): the epoch fence. None = unfenced
+        # (the pre-cluster wire protocol, byte-for-byte); an attached
+        # ClusterView keeps this equal to its membership generation, so
+        # every fetch carries it and every served GET enforces it.
+        self._generation: Optional[int] = None
         host, port = _parse_addr(bind)
         self._srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
         self._srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
@@ -516,6 +578,22 @@ class TcpExchange:
             name=f"srjt-exchange-r{self.rank}",
         )
         self._accept_thread.start()
+
+    # -- the epoch fence (ISSUE 16) ------------------------------------------
+
+    def set_generation(self, generation: Optional[int]) -> None:
+        """Install the cluster membership generation this exchange
+        serves and fetches under (None disarms the fence). The
+        ClusterView calls this on attach and on every bump — a
+        republish after a member death is served under the NEW
+        generation, and any peer still fetching under the old one is
+        answered ``_EXC_STALE`` instead of bytes."""
+        with self._lock:
+            self._generation = None if generation is None else int(generation)
+
+    def generation(self) -> Optional[int]:
+        with self._lock:
+            return self._generation
 
     # -- server side ---------------------------------------------------------
 
@@ -547,15 +625,29 @@ class TcpExchange:
                 magic, verb, epoch, part = _EXC_REQ.unpack(hdr)
                 if magic != _EXC_MAGIC or verb not in (
                     _EXC_GET, _EXC_GET_TRACED,
+                    _EXC_GET_FENCED, _EXC_GET_FENCED_TRACED,
+                    _EXC_PING,
                 ):
                     conn.sendall(_EXC_RESP.pack(_EXC_ERR, 0))
                     return
+                if verb == _EXC_PING:
+                    # liveness probe (ISSUE 16): epoch field = sender
+                    # generation, part = sender rank (observability
+                    # only — a PING never gates on the fence; the
+                    # RESPONSE carries our generation so the prober
+                    # learns about a bump it missed)
+                    own = self.generation()
+                    conn.sendall(
+                        _EXC_RESP.pack(_EXC_OK, _EXC_GEN.size)
+                        + _EXC_GEN.pack(own or 0)
+                    )
+                    continue
                 # srjt-trace (ISSUE 12): a traced GET carries the
                 # 17-byte context right after the header — read it
                 # unconditionally so the stream stays framed even when
                 # tracing is disarmed on this side
                 tctx = None
-                if verb == _EXC_GET_TRACED:
+                if verb in (_EXC_GET_TRACED, _EXC_GET_FENCED_TRACED):
                     try:
                         tb = b""
                         while len(tb) < tracing.TRACE_CTX_LEN:
@@ -566,6 +658,21 @@ class TcpExchange:
                     except (OSError, socket_mod.timeout):
                         return
                     tctx = tracing.decode_wire_context(tb)
+                # srjt-cluster (ISSUE 16): a fenced GET carries the
+                # requester's 4-byte generation after the header (and
+                # trace blob) — read it unconditionally, framing first
+                req_gen = None
+                if verb in (_EXC_GET_FENCED, _EXC_GET_FENCED_TRACED):
+                    try:
+                        gb = b""
+                        while len(gb) < _EXC_GEN.size:
+                            chunk = conn.recv(_EXC_GEN.size - len(gb))
+                            if not chunk:
+                                return
+                            gb += chunk
+                    except (OSError, socket_mod.timeout):
+                        return
+                    (req_gen,) = _EXC_GEN.unpack(gb)
                 if tctx is not None and tracing.is_enabled():
                     # the serving peer's half of the cross-process
                     # trace: the wait-for-publish and the frame send
@@ -575,18 +682,20 @@ class TcpExchange:
                             "exchange.serve", epoch=int(epoch),
                             part=int(part), rank=self.rank,
                         ):
-                            self._answer_get(conn, epoch, part)
+                            self._answer_get(conn, epoch, part, req_gen)
                 else:
-                    self._answer_get(conn, epoch, part)
+                    self._answer_get(conn, epoch, part, req_gen)
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _answer_get(self, conn, epoch: int, part: int) -> None:
-        """Answer one GET: wait (bounded) for the partition to publish,
-        then send it — or a retryable not-yet-published status."""
+    def _answer_get(self, conn, epoch: int, part: int,
+                    req_gen: Optional[int] = None) -> None:
+        """Answer one GET: enforce the epoch fence, wait (bounded) for
+        the partition to publish, then send it — or a retryable
+        not-yet-published / stale-generation status."""
         from ..utils import faultinj, metrics
 
         # chaos choke point: `crash` kills the serving process
@@ -594,6 +703,20 @@ class TcpExchange:
         # retries), `delay` models a slow peer
         if faultinj.is_enabled():
             faultinj.maybe_inject("exchange.serve")
+        own = self.generation()
+        if req_gen is not None and (own is None or own != req_gen):
+            # fence mismatch in EITHER direction: a zombie server must
+            # not feed a current client, and a zombie client must not
+            # be fed — the answer carries our generation so the
+            # requester can resynchronize, and zero payload bytes flow
+            metrics.registry().counter(
+                "cluster.stale_generation_refused"
+            ).inc()
+            conn.sendall(
+                _EXC_RESP.pack(_EXC_STALE, _EXC_GEN.size)
+                + _EXC_GEN.pack(own or 0)
+            )
+            return
         with self._published:
             end = time.monotonic() + self.publish_wait_s
             blob = self._frames.get((epoch, part))
@@ -611,7 +734,22 @@ class TcpExchange:
             # flips bytes AFTER the frame (and its CRCs) was
             # encoded — the fetcher's decode MUST catch it
             wire = faultinj.maybe_corrupt("exchange.frame", blob)
-        conn.sendall(_EXC_RESP.pack(_EXC_OK, len(wire)) + wire)
+        # a fenced OK prefixes the server generation so the fetcher
+        # verifies it BEFORE any payload byte reaches the decoder
+        prefix = b"" if req_gen is None else _EXC_GEN.pack(own)
+        header = _EXC_RESP.pack(_EXC_OK, len(prefix) + len(wire)) + prefix
+        if faultinj.is_enabled():
+            # split the response at the header/payload seam so a
+            # `crash` rule keyed exchange.serve.payload kills this
+            # process exactly between the two writes — the
+            # died-mid-frame chaos the fetch side must classify as
+            # retryable UNAVAILABLE, never DataCorruption. Production
+            # (injector disabled) keeps the single-write path.
+            conn.sendall(header)
+            faultinj.maybe_inject("exchange.serve.payload")
+            conn.sendall(wire)
+        else:
+            conn.sendall(header + wire)
         metrics.counter("shuffle.tcp.bytes_out").inc(len(wire))
 
     def publish(self, epoch: int, partitions: Dict[int, "Table"]) -> None:
@@ -681,24 +819,46 @@ class TcpExchange:
         lat_hist = metrics.registry().histogram("shuffle.tcp.fetch_lat_us")
         host, port = _parse_addr(addr)
         s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        # the epoch fence (ISSUE 16): fenced verbs whenever a cluster
+        # generation is installed; the request carries it and the OK
+        # response must echo the server's — verified below before any
+        # byte reaches the decoder
+        gen = self.generation()
+        phase = "connect"
         try:
             s.settimeout(budget_s)
             # srjt-trace (ISSUE 12): a sampled active context rides the
             # request as the traced GET verb + 17-byte blob, so the
             # peer's serve span parents to this fetch across processes
-            from ..utils import tracing
+            from ..utils import faultinj, tracing
 
             tblob = tracing.wire_context()
-            verb = _EXC_GET if tblob is None else _EXC_GET_TRACED
+            if gen is None:
+                verb = _EXC_GET if tblob is None else _EXC_GET_TRACED
+                gblob = b""
+            else:
+                verb = (_EXC_GET_FENCED if tblob is None
+                        else _EXC_GET_FENCED_TRACED)
+                gblob = _EXC_GEN.pack(gen)
             try:
+                # netsplit chaos choke point (ISSUE 16): a `netsplit`
+                # rule keyed exchange.connect (optionally @r<N>) raises
+                # ConnectionRefusedError HERE, inside the handler that
+                # classifies real refused connects — the partitioned
+                # path is byte-for-byte the production path
+                if faultinj.is_enabled():
+                    faultinj.maybe_inject("exchange.connect")
                 s.connect((host, port))
                 s.sendall(
                     _EXC_REQ.pack(_EXC_MAGIC, verb, epoch, part)
                     + (tblob or b"")
+                    + gblob
                 )
+                phase = "header"
                 status, blen = _EXC_RESP.unpack(
                     _recv_exact_tcp(s, _EXC_RESP.size, deadline)
                 )
+                phase = "payload"
                 blob = _recv_exact_tcp(s, blen, deadline) if blen else b""
             except socket_mod.timeout as e:
                 # record the timed-out elapsed as a latency sample so
@@ -712,12 +872,32 @@ class TcpExchange:
                     f"{budget_s:g}s"
                 ) from e
             except (ConnectionError, OSError) as e:
+                # a peer that died mid-frame (reset/EOF before or while
+                # framing the header or payload) is UNAVAILABLE — the
+                # recovery path's signal, explicitly NOT the corruption
+                # path: no frame was accepted, so there is nothing for
+                # a CRC to vouch for (ISSUE 16 satellite)
                 raise RetryableError(
-                    f"shuffle exchange: UNAVAILABLE: peer {addr} "
-                    f"({e})"
+                    f"shuffle exchange: UNAVAILABLE: peer {addr} reset "
+                    f"before completing frame ({phase}: {e})"
                 ) from e
         finally:
             s.close()
+        if status == _EXC_STALE:
+            # generation fence tripped: the peer lives in a different
+            # membership epoch (we are stale, or it is a zombie). Zero
+            # payload bytes were accepted; retryable desync — the
+            # retry re-reads the installed generation, so a bumped
+            # fence heals the next attempt.
+            peer_gen = _EXC_GEN.unpack(blob)[0] if blob else 0
+            metrics.registry().counter(
+                "cluster.stale_generation_rejects"
+            ).inc()
+            raise RetryableError(
+                f"shuffle exchange: DESYNC: generation fence mismatch "
+                f"with peer {addr} (ours {gen}, peer {peer_gen}) for "
+                f"(epoch {epoch}, part {part})"
+            )
         if status == _EXC_RETRY:
             raise RetryableError(
                 f"shuffle exchange: UNAVAILABLE: peer {addr} has not "
@@ -736,6 +916,28 @@ class TcpExchange:
                 f"{status} (protocol mismatch — wrong service or "
                 "version-skewed peer?)"
             )
+        if gen is not None:
+            # the fenced OK prefixes the SERVER's generation: verify it
+            # against ours before a single payload byte reaches the
+            # decoder — a zombie peer's bytes are rejected here, and
+            # the accept counter below stays zero by construction (the
+            # chaos artifact gate asserts exactly that)
+            if len(blob) < _EXC_GEN.size:
+                raise RetryableError(
+                    f"shuffle exchange: UNAVAILABLE: peer {addr} reset "
+                    f"before completing frame (fence prefix truncated)"
+                )
+            (srv_gen,) = _EXC_GEN.unpack(blob[:_EXC_GEN.size])
+            if srv_gen != gen:
+                metrics.registry().counter(
+                    "cluster.stale_generation_rejects"
+                ).inc()
+                raise RetryableError(
+                    f"shuffle exchange: DESYNC: peer {addr} answered "
+                    f"under generation {srv_gen}, ours is {gen} — "
+                    f"stale bytes rejected undecoded"
+                )
+            blob = blob[_EXC_GEN.size:]
         lat_hist.record((time.monotonic() - t0) * 1e6)
         metrics.counter("shuffle.tcp.bytes_in").inc(len(blob))
         # decode verifies the frame header + every column CRC: a
@@ -762,7 +964,7 @@ class TcpExchange:
         from ..utils import metrics, retry
         from ..utils.errors import DeadlineExceeded, RetryableError
 
-        br = exchange_breaker()
+        br = exchange_breaker(addr)
         if not br.allow():
             raise RetryableError(
                 "shuffle exchange: UNAVAILABLE: exchange breaker open "
@@ -790,18 +992,77 @@ class TcpExchange:
         )
         return table
 
+    def ping(self, addr: str, timeout_s: float) -> int:
+        """One liveness probe (ISSUE 16): PING ``addr`` and return the
+        responder's cluster generation (0 = unfenced). Raises on ANY
+        transport fault — the heartbeat loop counts every raise as one
+        miss; classification beyond alive/not-alive is the
+        ClusterView's job, not the probe's. Runs outside the breaker
+        and retry orchestrator on purpose: a probe must measure the
+        peer, not the recovery machinery."""
+        from ..utils import faultinj
+        from ..utils.errors import RetryableError
+
+        host, port = _parse_addr(addr)
+        deadline = time.monotonic() + max(float(timeout_s), 1e-3)
+        gen = self.generation()
+        s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        try:
+            s.settimeout(max(float(timeout_s), 1e-3))
+            if faultinj.is_enabled():
+                # the same netsplit choke point the fetch path crosses:
+                # a partitioned rank's heartbeats fail exactly like its
+                # fetches do
+                faultinj.maybe_inject("exchange.connect")
+            s.connect((host, port))
+            s.sendall(_EXC_REQ.pack(_EXC_MAGIC, _EXC_PING, gen or 0, self.rank))
+            status, blen = _EXC_RESP.unpack(
+                _recv_exact_tcp(s, _EXC_RESP.size, deadline)
+            )
+            blob = _recv_exact_tcp(s, blen, deadline) if blen else b""
+        finally:
+            s.close()
+        if status != _EXC_OK or len(blob) < _EXC_GEN.size:
+            raise RetryableError(
+                f"shuffle exchange: UNAVAILABLE: malformed PING answer "
+                f"from {addr} (status {status})"
+            )
+        return _EXC_GEN.unpack(blob[:_EXC_GEN.size])[0]
+
     # -- the one-call partition exchange -------------------------------------
 
     def exchange_table(self, table: "Table", key_cols: Sequence[str],
-                       peers: Dict[int, str], epoch: int = 0) -> "Table":
+                       peers: Dict[int, str], epoch: int = 0,
+                       topology: Optional[str] = None,
+                       cluster=None) -> "Table":
         """Hash-repartition ``table`` across this rank and ``peers``
         (rank -> "host:port", this rank excluded): rows of one key all
         land on hash(key) % world, whatever process they started in.
-        Publishes the outgoing partitions, pulls this rank's partition
-        from every peer, and returns the concatenation in rank order —
-        a deterministic row order, so downstream aggregation is
-        reproducible bit for bit."""
-        from ..ops.copying import concatenate, slice_table
+        Returns this rank's incoming partition with a deterministic row
+        order, so downstream aggregation is reproducible bit for bit.
+
+        ``topology`` picks the exchange plan (ISSUE 16); None reads
+        ``SRJT_CLUSTER_TOPOLOGY``:
+
+        - ``all_to_all`` — every rank publishes world-1 partitions and
+          pulls its own from every peer concurrently (the direct plan;
+          any world size);
+        - ``tree`` — the hypercube plan for power-of-two worlds:
+          log2(world) rounds, one partner per round, each rank moving
+          ONE coalesced frame per round instead of world-1 frames
+          total — fewer, larger transfers when world grows;
+        - ``auto`` — tree for power-of-two worlds >= 4, else
+          all_to_all.
+
+        ``cluster`` (a ``parallel.cluster.ClusterView``) arms failover:
+        a pull that exhausts its retries against a peer the cluster has
+        declared DEAD is recomputed from that rank's input lineage and
+        re-published under the bumped generation instead of erroring
+        the query. Recovery needs single-hop lineage — every partition
+        moves source -> destination directly — so an attached cluster
+        pins ``all_to_all``: a tree round forwards OTHER ranks' rows,
+        whose loss would need a whole-world replay to reconstruct."""
+        from ..utils import knobs
 
         world = len(peers) + 1
         ranks = sorted(set(peers) | {self.rank})
@@ -810,6 +1071,37 @@ class TcpExchange:
                 f"exchange peers must cover ranks 0..{world - 1} "
                 f"(got self={self.rank}, peers={sorted(peers)})"
             )
+        if topology is None:
+            topology = knobs.get_str("SRJT_CLUSTER_TOPOLOGY")
+        if topology == "auto":
+            topology = (
+                "tree"
+                if cluster is None and world >= 4 and world & (world - 1) == 0
+                else "all_to_all"
+            )
+        if topology == "tree" and cluster is not None:
+            topology = "all_to_all"  # recovery needs single-hop lineage
+        if topology == "tree":
+            if world < 2 or world & (world - 1):
+                raise ValueError(
+                    f"tree exchange needs a power-of-two world, got {world}"
+                )
+            return self._exchange_tree(table, key_cols, peers, epoch)
+        if topology != "all_to_all":
+            raise ValueError(f"unknown exchange topology {topology!r}")
+        return self._exchange_all_to_all(table, key_cols, peers, epoch, cluster)
+
+    def _exchange_all_to_all(self, table: "Table", key_cols: Sequence[str],
+                             peers: Dict[int, str], epoch: int,
+                             cluster=None) -> "Table":
+        """The direct plan: publish world-1 outgoing partitions, pull
+        this rank's partition from every peer, concatenate in rank
+        order. With ``cluster`` armed, a pull whose peer the cluster
+        declares dead fails over to the lineage-recomputed copy."""
+        from ..ops.copying import concatenate, slice_table
+
+        world = len(peers) + 1
+        ranks = sorted(set(peers) | {self.rank})
         partitioned, offsets = hash_partition(table, world, key_cols)
         bounds = list(offsets) + [partitioned.num_rows]
         parts = {
@@ -832,8 +1124,28 @@ class TcpExchange:
         def _pull(r: int, addr: str, ctx) -> None:
             try:
                 fetched[r] = ctx.run(self.fetch, addr, epoch, self.rank)
+                return
             except BaseException as e:  # srjt-lint: allow-broad-except(thread-exit funnel: the joiner re-raises errs[0] after joining every fetch thread)
-                errs.append(e)
+                if cluster is None:
+                    errs.append(e)
+                    return
+                primary = e
+            # failover (ISSUE 16): only after the retry budget is spent
+            # AND the membership layer agrees the peer is dead does the
+            # pull switch to the recomputed copy — a slow peer keeps
+            # its retryable error, a dead one stops erroring the query
+            try:
+                recovered = ctx.run(
+                    cluster.failover_fetch, r, epoch, list(key_cols),
+                    world, self.rank,
+                )
+            except BaseException as e2:  # srjt-lint: allow-broad-except(thread-exit funnel: the joiner re-raises errs[0] after joining every fetch thread)
+                errs.append(e2)
+                return
+            if recovered is None:
+                errs.append(primary)
+            else:
+                fetched[r] = recovered
 
         pulls = [
             threading.Thread(
@@ -858,6 +1170,49 @@ class TcpExchange:
                 # the caller owns the naming, so re-apply its schema
                 received.append(Table(fetched[r].columns, names))
         return concatenate(received)
+
+    def _exchange_tree(self, table: "Table", key_cols: Sequence[str],
+                       peers: Dict[int, str], epoch: int) -> "Table":
+        """The hypercube plan (power-of-two worlds): log2(world)
+        dimension-ordered rounds; in round j this rank exchanges ONE
+        coalesced frame with ``partner = rank ^ (1 << j)``, handing
+        over every held row whose destination differs from ours in bit
+        j. After round j all held rows agree with this rank on bits
+        0..j, so after the last round every row is home. Intermediate
+        frames are keyed at ``epoch + (j+1) * _TREE_EPOCH_STRIDE`` —
+        a derived namespace a real round never occupies. Round skew
+        between partners is bounded at one (a rank cannot start round
+        j+1 before its partner finishes round j), so the retain-epochs
+        eviction window is never outrun.
+
+        Determinism: each round rebuilds the held table as the rank-
+        ordered kept partitions followed by the partner's frame, so
+        the final row order is a pure function of (table, key_cols,
+        world, rank) — the same bit-for-bit reproducibility contract
+        as the direct plan, though the two plans' row ORDERS differ
+        (order-sensitive consumers must aggregate order-independently,
+        which the exact f64 accumulator and integer sums both are)."""
+        from ..ops.copying import concatenate, slice_table
+
+        world = len(peers) + 1
+        names = list(table.names)
+        held = table
+        rounds = world.bit_length() - 1
+        for j in range(rounds):
+            partner = self.rank ^ (1 << j)
+            sub_epoch = int(epoch) + (j + 1) * _TREE_EPOCH_STRIDE
+            partitioned, offsets = hash_partition(held, world, key_cols)
+            bounds = list(offsets) + [partitioned.num_rows]
+            keep: List["Table"] = []
+            send: List["Table"] = []
+            mine_j = (self.rank >> j) & 1
+            for p in range(world):
+                seg = slice_table(partitioned, bounds[p], bounds[p + 1])
+                ((keep if ((p >> j) & 1) == mine_j else send).append(seg))
+            self.publish(sub_epoch, {partner: concatenate(send)})
+            got = self.fetch(peers[partner], sub_epoch, self.rank)
+            held = concatenate(keep + [Table(got.columns, names)])
+        return held
 
     def close(self) -> None:
         with self._published:
@@ -933,11 +1288,43 @@ def _shard_bounds(rows: int, world: int, rank: int) -> Tuple[int, int]:
     return rows * rank // world, rows * (rank + 1) // world
 
 
+def format_peers(peers: Dict[int, str]) -> str:
+    """``rank=host:port,...`` — the ``--peers`` CLI / stdin-update
+    encoding (one owner, both directions parse through
+    ``parse_peers``)."""
+    return ",".join(f"{r}={a}" for r, a in sorted(peers.items()))
+
+
+def parse_peers(spec: str) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for item in (spec or "").split(","):
+        if not item:
+            continue
+        r, _, addr = item.partition("=")
+        out[int(r)] = addr
+    return out
+
+
+def send_peer_map(proc, peers: Dict[int, str]) -> None:
+    """Second half of the N-rank spawn handshake (ISSUE 16): ranks
+    spawn knowing only rank 0's address (later ranks' ports do not
+    exist yet), so once every READY line is in, the spawner completes
+    each child's world view with one ``EXCHANGE_PEER_MAP`` line on
+    its stdin. A world-2 child already knows its whole world and skips
+    the wait, so the two-process tests keep their close-stdin flow."""
+    proc.stdin.write(f"EXCHANGE_PEER_MAP {format_peers(peers)}\n")
+    proc.stdin.flush()
+
+
 def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
                         rank: int = 1, world: int = 2,
                         extra_env: Optional[dict] = None,
                         ready_timeout_s: float = 180.0,
-                        respawn_of=None):
+                        respawn_of=None,
+                        cluster: bool = False,
+                        query: str = "demo",
+                        epoch: int = 0,
+                        rounds: int = 1):
     """Spawn one ``--exchange-worker`` peer process against
     ``parent_addr`` (rank 0) and wait for its READY handshake; returns
     ``(Popen, peer_address)``. The ONE owner of the spawn/handshake
@@ -945,12 +1332,18 @@ def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
     the CLI flags or the READY line cannot drift between them. The
     child inherits this environment minus any armed fault-injection
     config (pass it back via ``extra_env`` to storm the peer on
-    purpose), with retry armed. ``respawn_of`` is the Popen of a DEAD
-    predecessor being replaced: the harness verifies it exited and
-    emits the ``exchange.peer_respawn`` event itself — the artifact
-    the premerge chaos gate asserts on, so it must come from the
-    machinery that observed the death, never from a test's own
-    assertion."""
+    purpose), with retry armed and ``SRJT_FAULTINJ_RANK=r<rank>``
+    stamped so ``@r<N>``-keyed chaos rules resolve in the right
+    process. For ``world > 2`` the child knows only rank 0 at spawn;
+    complete its peer map with ``send_peer_map`` once every rank's
+    address is known. ``cluster=True`` arms the worker's ClusterView
+    (membership + heartbeats + lineage recovery); ``query`` picks the
+    workload (``demo`` groupby or the ``q55`` plan-compiler run).
+    ``respawn_of`` is the Popen of a DEAD predecessor being replaced:
+    the harness verifies it exited and emits the
+    ``exchange.peer_respawn`` event itself — the artifact the premerge
+    chaos gate asserts on, so it must come from the machinery that
+    observed the death, never from a test's own assertion."""
     import subprocess
     import sys
 
@@ -959,17 +1352,23 @@ def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
     env = dict(os.environ)
     env.pop("SRJT_FAULTINJ_CONFIG", None)
     env["SRJT_RETRY_ENABLED"] = "1"
+    env["SRJT_FAULTINJ_RANK"] = f"r{rank}"
     if extra_env:
         env.update(extra_env)
     runner = (
         "from spark_rapids_jni_tpu.parallel.shuffle import _main; "
         "import sys; sys.exit(_main())"
     )
+    argv = [sys.executable, "-c", runner,
+            "--exchange-worker", "--rank", str(rank), "--world", str(world),
+            "--rows", str(rows), "--seed", str(seed),
+            "--epoch", str(epoch), "--query", query,
+            "--rounds", str(rounds),
+            "--peers", f"0={parent_addr}"]
+    if cluster:
+        argv.append("--cluster")
     proc = subprocess.Popen(
-        [sys.executable, "-c", runner,
-         "--exchange-worker", "--rank", str(rank), "--world", str(world),
-         "--rows", str(rows), "--seed", str(seed),
-         "--peers", f"0={parent_addr}"],
+        argv,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True,
     )
     import select
@@ -1030,16 +1429,111 @@ def spawn_exchange_peer(parent_addr: str, rows: int, seed: int, *,
     )
 
 
+def spawn_exchange_fleet(parent_addr: str, rows: int, seed: int, *,
+                         world: int,
+                         cluster: bool = False,
+                         query: str = "demo",
+                         epoch: int = 0,
+                         rounds: int = 1,
+                         extra_env_by_rank: Optional[dict] = None,
+                         ready_timeout_s: float = 180.0):
+    """Spawn ranks ``1..world-1`` as ``--exchange-worker`` processes
+    (this process is rank 0 at ``parent_addr``), complete every
+    child's peer map once all READY lines are in, and return
+    ``(procs, peers)`` — ``procs[rank] -> Popen``, ``peers[rank] ->
+    address`` for every rank including 0. The one owner of the
+    multi-rank bring-up sequence so the chaos tier, the scaling bench,
+    and the tests cannot drift on the handshake. On any spawn failure
+    the already-started children are killed before the error
+    propagates (no orphan servers squatting on ports)."""
+    procs: Dict[int, object] = {}
+    peers: Dict[int, str] = {0: parent_addr}
+    try:
+        for rank in range(1, world):
+            proc, addr = spawn_exchange_peer(
+                parent_addr, rows, seed, rank=rank, world=world,
+                cluster=cluster, query=query, epoch=epoch, rounds=rounds,
+                ready_timeout_s=ready_timeout_s,
+                extra_env=(extra_env_by_rank or {}).get(rank),
+            )
+            procs[rank] = proc
+            peers[rank] = addr
+        if world > 2:
+            for rank, proc in procs.items():
+                send_peer_map(proc, {r: a for r, a in peers.items()
+                                     if r != rank})
+    except BaseException:
+        for proc in procs.values():
+            proc.kill()
+            proc.wait()
+        raise
+    return procs, peers
+
+
+def _await_peer_map(peers: Dict[int, str], world: int) -> bool:
+    """Block on stdin until the spawner's ``EXCHANGE_PEER_MAP`` line
+    completes the rank→address map (``send_peer_map`` is the sender).
+    Returns False on EOF before the map arrived — the spawner died, so
+    the worker must exit rather than exchange against a partial
+    world."""
+    import sys
+
+    while len(peers) < world - 1:
+        line = sys.stdin.readline()
+        if not line:
+            return False
+        if line.startswith("EXCHANGE_PEER_MAP "):
+            peers.update(parse_peers(line.split(" ", 1)[1].strip()))
+    return True
+
+
+def _worker_run_q55(ex: "TcpExchange", peers: Dict[int, str], cluster,
+                    args) -> Table:
+    """The distributed TPC-DS leg of the worker: compile q55 with
+    exchange stages, run it over this rank's store_sales shard, and
+    return the per-rank partial (concatenating every rank's partial
+    and re-sorting reproduces the single-host answer bit-for-bit —
+    `ops/f64acc` sums are order-independent and the sort keys are a
+    total order)."""
+    from ..models import tpcds
+    from ..models.tpcds_plans import q55_plan
+    from ..ops.copying import slice_table
+    from ..plan import compile_ir
+    from ..plan.distribute import exchange_context, insert_exchanges
+
+    tables = tpcds.gen_store(args.rows, seed=args.seed)
+    world = args.world
+    sales = tables["store_sales"]
+
+    def shard_tables(r: int) -> Dict[str, Table]:
+        lo, hi = _shard_bounds(sales.num_rows, world, r)
+        shards = dict(tables)
+        shards["store_sales"] = slice_table(sales, lo, hi)
+        return shards
+
+    plan = insert_exchanges(q55_plan(), world)
+    compiled = compile_ir(plan, shard_tables(args.rank),
+                          name=f"q55@r{args.rank}")
+    with exchange_context(ex, peers, cluster=cluster,
+                          shard_tables=shard_tables, base_epoch=args.epoch):
+        return compiled()
+
+
 def _exchange_worker_main(args) -> int:
     """Peer-rank process: build the deterministic shard, exchange hash
-    partitions with rank 0, aggregate, publish the result table (epoch
-    ``args.epoch + 1``, part = this rank), then park until stdin
-    closes. Prints ``SRJT_EXCHANGE_READY addr=<host:port>`` once the
-    server is up — the line the parent polls for. The worker IS the
-    cross-process posture, so it defaults ``SRJT_EXCHANGE_MODE`` to
-    ``tcp`` and refuses an explicit ``mesh`` (an operator forcing the
-    in-process mode on a cross-process peer is a config error, not
-    something to ignore)."""
+    partitions with the rest of the world, aggregate, publish the
+    result table (epoch ``args.epoch + 1``, part = this rank), then
+    park until stdin closes. Prints ``SRJT_EXCHANGE_READY
+    addr=<host:port>`` once the server is up — the line the parent
+    polls for; for ``world > 2`` it then blocks until the spawner's
+    ``EXCHANGE_PEER_MAP`` stdin line completes the rank→address map
+    (only rank 0's address exists at spawn time). ``--cluster`` arms a
+    ClusterView (generation fencing + heartbeats + lineage recovery);
+    ``--query q55`` swaps the demo groupby for the plan-compiled
+    distributed TPC-DS q55. The worker IS the cross-process posture,
+    so it defaults ``SRJT_EXCHANGE_MODE`` to ``tcp`` and refuses an
+    explicit ``mesh`` (an operator forcing the in-process mode on a
+    cross-process peer is a config error, not something to ignore)."""
     import sys
 
     from ..ops.copying import slice_table
@@ -1054,24 +1548,72 @@ def _exchange_worker_main(args) -> int:
         )
         return 2
 
-    peers = {}
-    for spec in (args.peers or "").split(","):
-        if not spec:
-            continue
-        r, _, addr = spec.partition("=")
-        peers[int(r)] = addr
+    peers = parse_peers(args.peers)
+    table = shard = None
+    if args.query == "demo":
+        # warm before READY: the demo shard and its partition/groupby
+        # compiles depend only on argv, and the spawner's measurement
+        # window opens at the handshake — compile time is not exchange
+        # throughput, so pay for it here
+        from ..columnar import frames as frames_mod
+
+        table = _demo_table(args.rows, args.seed)
+        lo, hi = _shard_bounds(args.rows, args.world, args.rank)
+        shard = slice_table(table, lo, hi)
+        parts_w, offs_w = hash_partition(shard, args.world, ["k"])
+        bounds_w = list(offs_w) + [parts_w.num_rows]
+        for p in range(args.world):
+            if p != args.rank:  # the exact frames publish() will encode
+                frames_mod.encode_table(
+                    slice_table(parts_w, bounds_w[p], bounds_w[p + 1]))
+        _local_groupby_sum(slice_table(shard, 0, min(shard.num_rows, 1024)))
     ex = TcpExchange(args.rank, bind=args.bind)
     print(f"SRJT_EXCHANGE_READY addr={ex.address}", flush=True)
-    table = _demo_table(args.rows, args.seed)
-    lo, hi = _shard_bounds(args.rows, args.world, args.rank)
-    shard = slice_table(table, lo, hi)
-    with retry.enabled(max_attempts=40, base_delay_ms=25, max_delay_ms=250):
-        local = ex.exchange_table(shard, ["k"], peers, epoch=args.epoch)
-        result = _local_groupby_sum(local)
-        ex.publish(args.epoch + 1, {args.rank: result})
-        # park: serve fetches until the supervisor closes our stdin
-        sys.stdin.read()
-    ex.close()
+    if not _await_peer_map(peers, args.world):
+        print("exchange worker: stdin closed before peer map arrived",
+              file=sys.stderr)
+        ex.close()
+        return 3
+
+    cluster = None
+    if args.cluster:
+        from .cluster import ClusterView
+
+        addresses = dict(peers)
+        addresses[args.rank] = ex.address
+        cluster = ClusterView(args.rank, addresses, ex)
+        cluster.start()
+
+    try:
+        with retry.enabled(max_attempts=40, base_delay_ms=25,
+                           max_delay_ms=250):
+            if args.query == "q55":
+                result = _worker_run_q55(ex, peers, cluster, args)
+                result_epoch = args.epoch + 1
+            else:
+                if cluster is not None:
+                    cluster.set_lineage(lambda r: slice_table(
+                        table, *_shard_bounds(args.rows, args.world, r)))
+                # `--rounds N` repeats the exchange at even epoch
+                # offsets (round i at epoch + 2i) so the scaling bench
+                # can time a steady-state round with every per-shape
+                # compile already paid; rounds=1 keeps the historical
+                # epoch/epoch+1 scheme. Max inter-rank skew is one
+                # round (a rank cannot finish round i before every
+                # rank published it), which retain_epochs=4 outlives.
+                for rnd in range(max(args.rounds, 1)):
+                    local = ex.exchange_table(
+                        shard, ["k"], peers,
+                        epoch=args.epoch + 2 * rnd, cluster=cluster)
+                result = _local_groupby_sum(local)
+                result_epoch = args.epoch + 2 * max(args.rounds, 1) - 1
+            ex.publish(result_epoch, {args.rank: result})
+            # park: serve fetches until the supervisor closes our stdin
+            sys.stdin.read()
+    finally:
+        if cluster is not None:
+            cluster.stop()
+        ex.close()
     return 0
 
 
@@ -1087,6 +1629,13 @@ def _main() -> int:
     ap.add_argument("--epoch", type=int, default=0)
     ap.add_argument("--bind", default="127.0.0.1:0")
     ap.add_argument("--peers", default="", help="rank=host:port,...")
+    ap.add_argument("--cluster", action="store_true",
+                    help="arm ClusterView membership + heartbeats")
+    ap.add_argument("--query", default="demo", choices=("demo", "q55"),
+                    help="workload: demo groupby or plan-compiled q55")
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="demo exchange rounds (round i at epoch + 2i; "
+                         "result published at epoch + 2*rounds - 1)")
     return _exchange_worker_main(ap.parse_args())
 
 

@@ -28,6 +28,10 @@ Quick shape::
 """
 
 from .compiler import CompiledPlan, compile_ir  # noqa: F401
+from .distribute import (  # noqa: F401
+    exchange_context,
+    insert_exchanges,
+)
 from .exprs import (  # noqa: F401
     PExpr,
     PlanError,
@@ -41,6 +45,7 @@ from .nodes import (  # noqa: F401
     Aggregate,
     AggSpec,
     CorrelatedAggFilter,
+    Exchange,
     Exists,
     Filter,
     Having,
@@ -76,8 +81,8 @@ __all__ = [
     "PExpr", "PlanError", "pcol", "plit", "pwhen", "plike", "prlike",
     "Node", "Scan", "Filter", "Project", "Join", "Aggregate", "AggSpec",
     "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
-    "CorrelatedAggFilter", "rollup", "infer_schema", "structure",
-    "rewrite", "prune_columns", "RewriteResult", "Obligation",
+    "CorrelatedAggFilter", "Exchange", "rollup", "infer_schema",
+    "structure", "rewrite", "prune_columns", "RewriteResult", "Obligation",
     "fingerprint", "PlanViolation", "verify_plan", "verify_obligations",
-    "verify_estimates",
+    "verify_estimates", "insert_exchanges", "exchange_context",
 ]

@@ -47,6 +47,7 @@ from ..utils import knobs, metrics
 from .exprs import PExpr, PlanError, conjoin, is_col, is_null_lit
 from .nodes import (
     Aggregate,
+    Exchange,
     Filter,
     Join,
     Limit,
@@ -269,6 +270,45 @@ class _JoinExec(_Exec):
         }[self.how]
         out = fn(left, right, on=lnames)
         return out.select(list(self.schema.keys()))
+
+
+class _ExchangeExec(_Exec):
+    """Hash-repartition across the bound exchange fabric (ISSUE 16).
+    Unbound — no ``plan.distribute.exchange_context`` in scope — or at
+    ``world == 1`` this stage is the identity, so one compiled plan
+    serves both the single-host oracle and every rank of the
+    distributed run. With a cluster + shard catalog bound, the stage
+    installs its child subtree as the dead-rank lineage reproducer
+    right before moving rows: recovery replays exactly the lowered
+    code that produced the lost input."""
+
+    kind = "exchange"
+
+    def __init__(self, node: Exchange, schema: Schema, child: _Exec):
+        super().__init__(schema, child.est_rows, [child])
+        self.keys = node.keys
+        self.world = node.world
+
+    def _run(self, ctx):
+        from .distribute import current_binding
+
+        t = self.inputs[0].run(ctx)
+        binding = current_binding()
+        if binding is None or self.world <= 1:
+            return t
+        if binding.world != self.world:
+            raise PlanError(
+                f"exchange stage compiled for world {self.world} bound to "
+                f"a {binding.world}-rank fabric")
+        if binding.cluster is not None and binding.shard_tables is not None:
+            child = self.inputs[0]
+            shards = binding.shard_tables
+            binding.cluster.set_lineage(
+                lambda r: child.run(_RunContext(shards(r))))
+        return binding.exchange.exchange_table(
+            t, list(self.keys), binding.peers,
+            epoch=binding.stage_epoch(id(self)), cluster=binding.cluster,
+        )
 
 
 class _AggExec(_Exec):
@@ -702,6 +742,8 @@ class _Lowerer:
                 return fused
             _durable("plan.ops_stages").inc()
             return _AggExec(node, schema, self.lower(node.input))
+        if isinstance(node, Exchange):
+            return _ExchangeExec(node, schema, self.lower(node.input))
         if isinstance(node, Window):
             return _WindowExec(node, schema, self.lower(node.input))
         if isinstance(node, Sort):

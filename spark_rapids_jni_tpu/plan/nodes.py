@@ -47,8 +47,8 @@ from .exprs import PExpr, PlanError
 __all__ = [
     "Node", "Scan", "Filter", "Project", "Join", "AggSpec", "Aggregate",
     "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
-    "CorrelatedAggFilter", "rollup", "infer_schema", "structure",
-    "PlanError",
+    "CorrelatedAggFilter", "Exchange", "rollup", "infer_schema",
+    "structure", "PlanError",
 ]
 
 Schema = Dict[str, DType]
@@ -182,6 +182,32 @@ def rollup(*keys: str) -> Tuple[Tuple[str, ...], ...]:
     """ROLLUP(k1, .., kn) -> the n+1 grouping sets (k1..kn), (k1..kn-1),
     ..., () — pass as ``Aggregate(grouping_sets=rollup(...))``."""
     return tuple(tuple(keys[:i]) for i in range(len(keys), -1, -1))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Exchange(Node):
+    """Hash-repartition the input across ``world`` ranks on ``keys``
+    (ISSUE 16): after this stage, all rows of one key value live on
+    hash(key) % world, whatever rank produced them — the distribution
+    guarantee a downstream keyed Aggregate/Join needs to compute its
+    partition of the answer locally. Schema- and (globally)
+    row-preserving: an Exchange moves rows, it never creates, drops,
+    or rewrites one. On a single rank (``world == 1`` or no exchange
+    binding at run time) it lowers to the identity, so a distributed
+    plan compiles and runs unchanged on one host."""
+
+    input: Node
+    keys: Tuple[str, ...]
+    world: int
+
+    def __post_init__(self):
+        if not self.keys:
+            raise PlanError("exchange needs at least one key column")
+        if self.world < 1:
+            raise PlanError(f"exchange world must be >= 1, got {self.world}")
+
+    def inputs(self):
+        return (self.input,)
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -422,6 +448,13 @@ def _infer(node: Node, catalog, memo) -> Schema:
             out[a.name] = _numeric_agg_dtype(s[a.source], a.how, "aggregate")
         return out
 
+    if isinstance(node, Exchange):
+        s = infer_schema(node.input, catalog, memo)
+        for c in node.keys:
+            if c not in s:
+                raise PlanError(f"exchange key {c!r} not in {sorted(s)}")
+        return dict(s)
+
     if isinstance(node, Window):
         s = infer_schema(node.input, catalog, memo)
         for c in node.partition_by:
@@ -530,6 +563,8 @@ def structure(node: Node) -> tuple:
         return ("aggregate", node.keys,
                 tuple((a.source, a.how, a.name) for a in node.aggs),
                 node.grouping_sets, structure(node.input))
+    if isinstance(node, Exchange):
+        return ("exchange", node.keys, node.world, structure(node.input))
     if isinstance(node, Window):
         return ("window", node.partition_by, node.order_by, node.aggs,
                 structure(node.input))

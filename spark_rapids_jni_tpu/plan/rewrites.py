@@ -41,6 +41,7 @@ from .nodes import (
     Aggregate,
     AggSpec,
     CorrelatedAggFilter,
+    Exchange,
     Exists,
     Filter,
     Having,
@@ -318,6 +319,8 @@ def _with_inputs(node: Node, inputs: Tuple[Node, ...]) -> Node:
                          grouping_sets=node.grouping_sets)
     if isinstance(node, Window):
         return Window(inputs[0], node.partition_by, node.order_by, node.aggs)
+    if isinstance(node, Exchange):
+        return Exchange(inputs[0], node.keys, node.world)
     if isinstance(node, Sort):
         return Sort(inputs[0], node.keys)
     if isinstance(node, Limit):
@@ -435,6 +438,10 @@ def prune_columns(plan: Node, catalog: Dict[str, Dict]) -> Node:
             req_in |= {c for c, _ in node.order_by}
             req_in |= {s for s, _, _ in node.aggs}
             need(node.input, req_in)
+        elif isinstance(node, Exchange):
+            # partition keys must survive pruning — the repartition
+            # hashes them even when no consumer reads them back
+            need(node.input, req | set(node.keys))
         elif isinstance(node, Sort):
             need(node.input, req | {c for c, _ in node.keys})
         elif isinstance(node, Limit):

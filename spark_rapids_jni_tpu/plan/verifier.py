@@ -80,6 +80,7 @@ from .exprs import PExpr, PlanError
 from .nodes import (
     Aggregate,
     CorrelatedAggFilter,
+    Exchange,
     Exists,
     Filter,
     Having,
@@ -372,6 +373,21 @@ class _Verifier:
                     return None
                 out[name] = self._window_dtype(s[src], how)
             return out
+
+        if isinstance(node, Exchange):
+            s = self.schema(node.input)
+            if s is None:
+                return None
+            if node.world < 1:
+                self.flag("PLAN003",
+                          f"exchange: world must be >= 1 ({node.world})")
+                return None
+            for c in node.keys:
+                if c not in s:
+                    self.flag("PLAN001",
+                              f"exchange key {c!r} not in {sorted(s)}")
+                    return None
+            return dict(s)
 
         if isinstance(node, Sort):
             s = self.schema(node.input)
@@ -864,7 +880,8 @@ def verify_obligations(obligations, catalog: Dict[str, Schema],
 
 # stage kinds whose output-row estimate must never exceed the (first)
 # child's: subsetting and grouping never grow the row count
-_ROW_MONOTONE_KINDS = ("filter", "limit", "aggregate", "fused_aggregate")
+_ROW_MONOTONE_KINDS = ("filter", "limit", "aggregate", "fused_aggregate",
+                       "exchange")
 
 
 def verify_estimates(cp, where: str = "plan") -> List[PlanViolation]:

@@ -37,6 +37,11 @@ mid-flight kill, never a timeout in disguise):
   the gray-failure detector (sidecar_pool.py) and the query declared
   ``host_eligible=False`` — device-only work is shed instead of
   queueing onto known stragglers; host-eligible work keeps flowing.
+- **cluster_degraded** (ISSUE 16): an attached
+  ``parallel.cluster.ClusterView`` is below quorum — too many exchange
+  ranks DEAD for a distributed query to complete; refused at admission
+  (retryable: quorum returns when replacement ranks join) instead of
+  queued into a fabric that would burn retry budgets mid-exchange.
 - **shutting_down**: ``shutdown()`` was called.
 - **injected**: the fault injector's ``reject`` kind fired at the
   ``serve.admit`` choke point (deterministic shed-path chaos).
@@ -112,7 +117,8 @@ def _shed_trace(qt, cause: str) -> None:
         qt.finish("shed")
 
 SHED_CAUSES = ("queue_full", "pressure", "doa_deadline", "breaker",
-               "quarantine", "shutting_down", "injected")
+               "quarantine", "cluster_degraded", "shutting_down",
+               "injected")
 
 # stride scheduling: pass advance per dispatch for weight 1.0
 _STRIDE1 = float(1 << 20)
@@ -284,6 +290,7 @@ class Scheduler:
         )
         self._queued = 0  # entries in S_QUEUED across all tenant deques
         self._running = 0
+        self._cluster = None  # ClusterView (ISSUE 16): quorum-loss shed
         self._inflight: set = set()
         self._pass_floor = 0.0
         self._open = True
@@ -373,6 +380,16 @@ class Scheduler:
         """Re-weight a tenant's fair share (stride = K / weight)."""
         with self._cond:
             self._tenant_locked(str(tenant), weight)
+
+    def attach_cluster(self, cluster) -> None:
+        """Attach a ``parallel.cluster.ClusterView``: while the cluster
+        is below quorum (``has_quorum()`` false), every submit sheds
+        retryable ``Overloaded(cause="cluster_degraded")`` — a cluster
+        that cannot answer distributed queries correctly must refuse
+        them upfront, not let them queue and fail mid-exchange. Pass
+        None to detach."""
+        with self._cond:
+            self._cluster = cluster
 
     # -- admission (submit + the overload controller) ------------------------
 
@@ -464,6 +481,20 @@ class Scheduler:
                     "failure) and query is not host-engine-eligible",
                     "quarantine",
                 )
+        # cluster-degraded shed (ISSUE 16): below quorum, a distributed
+        # query cannot complete correctly — exchanges to dead ranks
+        # would just burn retry budgets; refuse at admission instead,
+        # retryable (quorum returns when replacements join)
+        with self._cond:
+            cluster = self._cluster
+        if cluster is not None and not cluster.has_quorum():
+            self._count_shed("cluster_degraded")
+            self._shed_event(tenant, "cluster_degraded")
+            _shed_trace(qt, "cluster_degraded")
+            raise self._overloaded(
+                f"cluster below quorum ({len(cluster.alive_ranks())}/"
+                f"{cluster.world} ranks alive)", "cluster_degraded",
+            )
         # dead-on-arrival deadline: fast-fail beats queueing work that
         # must expire (the effective budget inherits + clamps to an
         # ambient scope active at the submit site)

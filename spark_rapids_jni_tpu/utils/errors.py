@@ -59,7 +59,8 @@ class Overloaded(RetryableError):
     submit call. ``retry_after_s`` is the scheduler's backoff hint
     (never a promise); ``cause`` names the shed reason
     (``queue_full`` / ``pressure`` / ``doa_deadline`` / ``breaker`` /
-    ``shutting_down`` / ``injected``). Distinct from DeadlineExceeded
+    ``quarantine`` / ``cluster_degraded`` / ``shutting_down`` /
+    ``injected``). Distinct from DeadlineExceeded
     (the QUERY ran out of time) and MemoryBudgetExceeded (one op's
     footprint cannot fit): Overloaded is about aggregate offered load,
     and a shed must never masquerade as a timeout."""

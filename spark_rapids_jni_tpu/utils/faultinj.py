@@ -17,7 +17,11 @@ sit. Feature parity:
   a process whose ``SRJT_FAULTINJ_WORKER`` tag equals ``<tag>`` — the
   worker pool stamps every spawned worker ``w<slot>``, so ONE gray
   worker can be simulated deterministically while its peers stay
-  clean. Resolution specificity, most-specific first:
+  clean. PER-RANK targeting (ISSUE 16) rides the same suffix:
+  ``@r<N>`` keys match a process whose ``SRJT_FAULTINJ_RANK`` tag
+  equals ``r<N>`` (the exchange-worker harness stamps every spawned
+  rank), so a cluster profile can partition or kill exactly one rank.
+  Resolution specificity, most-specific first:
   ``op@tag`` > ``op`` > longest ``prefix.*@tag`` > longest
   ``prefix.*`` > ``*@tag`` > ``*``,
 - injection types: ``fatal`` (FatalDeviceError — the trap/assert
@@ -49,7 +53,13 @@ sit. Feature parity:
   the serving scheduler (serve/) crosses on every submission, and the
   chaos tier exercises the shed path deterministically without real
   overload; ``delayMs`` doubles as the injected ``retry_after_s`` hint
-  in milliseconds),
+  in milliseconds), ``netsplit`` (raises ``ConnectionRefusedError`` —
+  the dropped/refused-TCP-connect analog for partition chaos; key it
+  ``exchange.connect`` (the choke point every TCP exchange fetch
+  crosses before its socket connect) with an ``@r<N>`` rank tag to
+  partition exactly one rank: the client-side UNAVAILABLE
+  classification and the cluster liveness/recovery machinery see
+  precisely what a real network partition produces),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -128,6 +138,7 @@ class _State:
         self.mtime: float = 0.0
         self.enabled = False
         self.worker_tag: Optional[str] = None  # SRJT_FAULTINJ_WORKER
+        self.rank_tag: Optional[str] = None  # SRJT_FAULTINJ_RANK
 
 
 _state = _State()
@@ -139,11 +150,13 @@ def _parse(cfg: dict) -> None:
     from . import knobs as _k
 
     _state.worker_tag = _k.get_str("SRJT_FAULTINJ_WORKER") or None
+    _state.rank_tag = _k.get_str("SRJT_FAULTINJ_RANK") or None
     _state.rules = {}
     for name, spec in (cfg.get("faults") or {}).items():
         kind = spec.get("type", "retryable")
         if kind not in ("fatal", "retryable", "exception", "delay", "hang",
-                        "spill_fail", "crash", "corrupt", "reject"):
+                        "spill_fail", "crash", "corrupt", "reject",
+                        "netsplit"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -204,24 +217,25 @@ def _reload_if_changed() -> None:
 
 
 def _resolve_rule_locked(op_name: str) -> Optional[_Rule]:
-    """Rule resolution, most-specific first (ISSUE 9): exact with this
-    process's worker tag (``op@w1``) > plain exact > longest
-    tag-suffixed prefix family (``prefix.*@w1``) > longest plain
-    prefix family > tagged wildcard (``*@w1``) > bare ``*``. Keys
-    carrying a FOREIGN tag never match, so one profile can ramp a
-    single gray worker while its pool peers run the same config
-    clean."""
-    tag = _state.worker_tag
-    if tag:
+    """Rule resolution, most-specific first (ISSUE 9): exact with one
+    of this process's tags (``op@w1``, ``op@r2``) > plain exact >
+    longest tag-suffixed prefix family (``prefix.*@w1``) > longest
+    plain prefix family > tagged wildcard (``*@w1``) > bare ``*``.
+    A process may carry BOTH a worker tag (SRJT_FAULTINJ_WORKER) and a
+    rank tag (SRJT_FAULTINJ_RANK, ISSUE 16) — each specificity level
+    tries the worker tag first, then the rank tag. Keys carrying a
+    FOREIGN tag never match, so one profile can ramp a single gray
+    worker — or partition a single exchange rank — while its peers
+    run the same config clean."""
+    tags = [t for t in (_state.worker_tag, _state.rank_tag) if t]
+    for tag in tags:
         rule = _state.rules.get(f"{op_name}@{tag}")
         if rule is not None:
             return rule
     rule = _state.rules.get(op_name)
     if rule is not None:
         return rule
-    for suffix in (f"@{tag}" if tag else None, ""):
-        if suffix is None:
-            continue
+    for suffix in [f"@{t}" for t in tags] + [""]:
         best, best_len = None, -1
         for key, r in _state.rules.items():
             if suffix and not key.endswith(suffix):
@@ -237,7 +251,7 @@ def _resolve_rule_locked(op_name: str) -> Optional[_Rule]:
                 best, best_len = r, len(stem)
         if best is not None:
             return best
-    if tag:
+    for tag in tags:
         rule = _state.rules.get(f"*@{tag}")
         if rule is not None:
             return rule
@@ -314,6 +328,18 @@ def maybe_inject(op_name: str) -> None:
             f"injected admission reject in {op_name}",
             retry_after_s=delay_ms / 1000.0,
             cause="injected",
+        )
+    if kind == "netsplit":
+        # the network-partition chaos (ISSUE 16): model the kernel
+        # refusing the TCP connect to a partitioned peer. Raised as the
+        # REAL OSError subclass so the exchange client's existing
+        # (ConnectionError, OSError) -> retryable-UNAVAILABLE
+        # classification — and everything above it (per-peer breaker,
+        # liveness, epoch-fenced recovery) — exercises exactly the
+        # production path. Key it exchange.connect@r<N> to partition
+        # one rank.
+        raise ConnectionRefusedError(
+            f"injected netsplit in {op_name}: connection refused"
         )
     if kind == "spill_fail":
         # the memory governor's demotion chaos (memgov/catalog.py calls

@@ -313,6 +313,10 @@ declare("SRJT_FAULTINJ_WORKER", "str", None,
         "this process's worker tag (w0, w1, ...) for per-worker fault "
         "rule keys like sidecar.worker.<OP>@w1; the pool sets it on "
         "every spawned worker")
+declare("SRJT_FAULTINJ_RANK", "str", None,
+        "this process's exchange-rank tag (r0, r1, ...) for per-rank "
+        "fault rule keys like exchange.connect@r2; the exchange-worker "
+        "harness sets it on every spawned rank")
 
 # sidecar supervision (sidecar.py, PRs 1/3/5)
 declare("SRJT_SIDECAR_TIMEOUT_SEC", "float", 600.0,
@@ -416,6 +420,30 @@ declare("SRJT_EXCHANGE_TIMEOUT_SEC", "float", 30.0,
 declare("SRJT_EXCHANGE_RETAIN_EPOCHS", "int", 4,
         "published exchange rounds kept servable; older epochs are "
         "evicted on publish", minimum=1)
+
+# cluster membership + liveness (parallel/cluster.py, ISSUE 16)
+declare("SRJT_CLUSTER_HEARTBEAT_SEC", "float", 0.5,
+        "heartbeat cadence: each rank PINGs every peer this often; "
+        "misses drive the alive -> suspect -> dead transitions",
+        positive=True)
+declare("SRJT_CLUSTER_HEARTBEAT_TIMEOUT_SEC", "float", 2.0,
+        "per-PING deadline budget (utils/deadline scope); a PING "
+        "slower than this counts as a miss", positive=True)
+declare("SRJT_CLUSTER_SUSPECT_MISSES", "int", 2,
+        "consecutive heartbeat misses before an ALIVE peer is marked "
+        "SUSPECT (still routable, health-degraded)", minimum=1)
+declare("SRJT_CLUSTER_DEAD_MISSES", "int", 4,
+        "consecutive heartbeat misses before a SUSPECT peer is marked "
+        "DEAD: the generation bumps and recovery engages", minimum=1)
+declare("SRJT_CLUSTER_QUORUM_FRACTION", "float", 0.5,
+        "alive fraction (self included) at or below which the cluster "
+        "is degraded: serving sheds Overloaded(cluster_degraded)",
+        positive=True)
+declare("SRJT_CLUSTER_TOPOLOGY", "str", "auto",
+        "exchange plan over the ClusterView: all_to_all (direct pulls "
+        "from every peer), tree (hypercube rounds, power-of-two "
+        "worlds), or auto (tree iff world is a power of two >= 4)",
+        choices=("auto", "all_to_all", "tree"))
 
 # memory governor (memgov/, PR 4)
 declare("SRJT_DEVICE_MEMORY_BUDGET", "int", None,

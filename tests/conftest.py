@@ -110,6 +110,18 @@ _SLOW_TESTS = {
     "test_ragged_bytes.py::test_padded_vs_scatter_encode_parity",
     "test_data_plane.py::TestTcpExchangeTwoProcess::"
     "test_two_process_groupby_bit_identical_under_chaos",
+    # srjt-cluster (ISSUE 16): the 4-process chaos acceptance, the
+    # world-4 topology bit-identity pair, and the in-process failover
+    # rendezvous all burn heartbeat/retry wall-clock by design;
+    # ci/premerge.sh runs the whole file env-armed in the dedicated
+    # cluster tier (no slow filter there), nightly runs them too
+    "test_cluster.py::TestClusterChaosFourRank::"
+    "test_four_rank_groupby_survives_rank_kill",
+    "test_cluster.py::TestTopology::test_tree_equals_all_to_all_world4",
+    "test_cluster.py::TestDistributedPlanQuery::"
+    "test_q55x4_bit_identical_with_dead_rank",
+    "test_cluster.py::TestRecovery::"
+    "test_exchange_failover_bit_identical_in_process",
     "test_table_ops.py::test_distributed_groupby_table_int_keys",
     # the hang-storm acceptance burns ~6 budget expiries of wall-clock
     # by design; ci/premerge.sh runs it env-armed in the dedicated
