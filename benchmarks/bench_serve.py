@@ -31,10 +31,27 @@ bench_pool.py discipline; ``SRJT_RESULTS`` appends them to a file):
   race, and the hedge volume stayed within its configured budget.
   Exit 1 on any violation — the premerge gray tier's gate.
 
+- **cache** (``--cache``, ISSUE 17): a mixed plan-IR workload (q1/q6
+  shapes over lineitem + a q98-style star over the store tables) with
+  literal values cycling over a few bindings, submitted in duplicate
+  bursts through a cache-armed scheduler TWICE — cold (empty caches)
+  then warm (same submissions again). Every completed query is
+  verified bit-identical to its sequential *uncached* oracle. The
+  ``serve_cached_qps`` BENCH row carries warm QPS, the cold/warm
+  speedup, warm plan-cache hit rate, in-flight shares, and p50/p99 for
+  both passes. Gates (exit 1): zero wrong answers, warm hit rate >=
+  0.8, warm QPS >= 3x cold at equal-or-better p99, ``cache.share`` >
+  0. With ``--chaos`` the ``ci/chaos_cache.json`` eviction/spill/
+  reject storm runs during BOTH passes and only the zero-wrong-answers
+  + evictions-landed gates apply (hit economics are meaningless while
+  entries are being shot down).
+
 Usage::
 
     python benchmarks/bench_serve.py                      # steady BENCH row
     python benchmarks/bench_serve.py --chaos --pool-size 2
+    python benchmarks/bench_serve.py --cache              # cold/warm cache row
+    python benchmarks/bench_serve.py --cache --chaos      # eviction storm
     SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/serve_metrics.jsonl \
         python benchmarks/bench_serve.py --chaos
 """
@@ -69,6 +86,10 @@ _CHAOS_PROFILE = os.path.join(
 _GRAY_PROFILE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "ci", "chaos_gray.json",
+)
+_CACHE_PROFILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ci", "chaos_cache.json",
 )
 
 
@@ -374,6 +395,271 @@ def run_bench(args) -> int:
     return rc
 
 
+_CACHE_COUNTERS = (
+    "cache.hits", "cache.misses", "cache.rebinds", "cache.rebind_fallbacks",
+    "cache.share", "cache.share_fallback", "cache.sub_hits",
+    "cache.sub_misses", "cache.evictions", "cache.sub_evictions",
+    "cache.evict_injected", "cache.insert_verified", "cache.insert_rejected",
+)
+
+
+def _cache_combos(rows: int, seed: int):
+    """The parameterized workload: three plan STRUCTURES, four literal
+    BINDINGS each (12 combos). Within a structure only literal values
+    differ, so after the first full compile the plan cache serves the
+    other three bindings via the rebind path, and a repeat of any combo
+    is an exact-variant hit."""
+    from spark_rapids_jni_tpu import plan as P
+
+    lineitem = {"lineitem": tpch.gen_lineitem(rows, seed=seed)}
+    store = dict(tpcds.gen_store(max(rows // 2, 1000), seed=seed))
+
+    def q1_like(qty):
+        return P.Aggregate(
+            P.Filter(P.Scan("lineitem"),
+                     P.pcol("l_quantity") < P.plit(qty)),
+            keys=("l_returnflag", "l_linestatus"),
+            aggs=(P.AggSpec("l_extendedprice", "sum", "sum_price"),
+                  P.AggSpec("l_quantity", "sum", "sum_qty")),
+        )
+
+    def q6_like(disc):
+        return P.Aggregate(
+            P.Filter(P.Scan("lineitem"),
+                     (P.pcol("l_discount") >= P.plit(0.02))
+                     & (P.pcol("l_discount") <= P.plit(disc))
+                     & (P.pcol("l_quantity") < P.plit(24.0))),
+            keys=(),
+            aggs=(P.AggSpec("l_extendedprice", "sum", "revenue"),),
+        )
+
+    def q98_like(moy):
+        return P.Aggregate(
+            P.Join(
+                P.Join(P.Scan("store_sales"),
+                       P.Filter(P.Scan("date_dim"),
+                                P.pcol("d_moy") == P.plit(moy)),
+                       on=(("ss_sold_date_sk", "d_date_sk"),)),
+                P.Scan("item"),
+                on=(("ss_item_sk", "i_item_sk"),),
+            ),
+            keys=("i_category_id",),
+            aggs=(P.AggSpec("ss_ext_sales_price", "sum", "sales"),),
+        )
+
+    combos = []
+    for qty in (24.0, 25.0, 26.0, 27.0):
+        combos.append(("q1", q1_like(qty), lineitem))
+    for disc in (0.04, 0.05, 0.06, 0.07):
+        combos.append(("q6", q6_like(disc), lineitem))
+    for moy in (1, 2, 3, 4):
+        combos.append(("q98", q98_like(moy), store))
+    return combos
+
+
+def _cache_pass(combos, oracles, dup: int, deadline_s: float,
+                max_concurrent: int, queue_depth: int, label: str):
+    """Submit every combo in a burst of ``dup`` duplicates through a
+    fresh cache-armed scheduler; harvest each handle on its own thread
+    so the recorded latency is submit -> result() return (compile /
+    cache lookup happens inside submit, so a cold compile is charged to
+    the query that paid it). Returns (latencies_ms, wrong, shed,
+    failed, span_s)."""
+    import threading
+
+    sched = serve.Scheduler(max_concurrent=max_concurrent,
+                            queue_depth=queue_depth,
+                            name=f"cache-{label}")
+    lat_ms: list = []
+    wrong: list = []
+    failed: list = []
+    shed = [0]
+    lock = threading.Lock()
+    harvesters = []
+
+    def harvest(h, t_submit, cid):
+        try:
+            got = h.result(deadline_s + 60)
+        except Overloaded:
+            with lock:
+                shed[0] += 1
+            return
+        except Exception as e:
+            with lock:
+                failed.append(f"{cid}: {type(e).__name__}: {e}")
+            return
+        t_done = time.perf_counter()
+        ok = _tables_equal(got, oracles[cid])
+        with lock:
+            lat_ms.append((t_done - t_submit) * 1e3)
+            if not ok:
+                wrong.append(f"{label}/{cid}: diverged from uncached "
+                             f"oracle")
+
+    t0 = time.perf_counter()
+    try:
+        for cid, (kind, node, tables) in enumerate(combos):
+            for d in range(dup):
+                t_submit = time.perf_counter()
+                try:
+                    h = sched.submit(node, tables,
+                                     tenant=f"t{(cid + d) % 3}",
+                                     deadline_s=deadline_s)
+                except Overloaded:
+                    with lock:
+                        shed[0] += 1
+                    continue
+                th = threading.Thread(target=harvest,
+                                      args=(h, t_submit, cid),
+                                      name=f"harvest-{label}-{cid}-{d}")
+                th.start()
+                harvesters.append(th)
+        for th in harvesters:
+            th.join(deadline_s + 120)
+    finally:
+        sched.shutdown(drain=False, timeout_s=60)
+    return lat_ms, wrong, shed[0], failed, max(time.perf_counter() - t0,
+                                               1e-9)
+
+
+def run_cache_bench(args) -> int:
+    """--cache (ISSUE 17): cold/warm serving through the plan +
+    subresult caches, bit-exactness against uncached oracles, and the
+    warm-economics gates (or the storm-survival gates with --chaos)."""
+    os.environ.setdefault("SRJT_PLAN_CACHE", "1")
+    os.environ.setdefault("SRJT_SUBRESULT_CACHE", "1")
+    from spark_rapids_jni_tpu import cache as srjt_cache
+    from spark_rapids_jni_tpu import plan as P
+
+    srjt_cache.reset()
+    combos = _cache_combos(args.rows, args.seed)
+    # uncached sequential oracles FIRST (also warms the XLA compile
+    # cache, so the cold pass measures the cache subsystem's own costs,
+    # not first-touch device compilation)
+    t0 = time.perf_counter()
+    oracles = {
+        cid: P.compile_ir(node, tables, name=f"oracle.{kind}{cid}")()
+        for cid, (kind, node, tables) in enumerate(combos)
+    }
+    print(f"# {len(combos)} uncached oracles in "
+          f"{time.perf_counter() - t0:.1f}s (compile-warm)", flush=True)
+
+    profile = args.profile or _CACHE_PROFILE
+    if args.chaos:
+        faultinj.configure_from_file(profile)
+        if not retry.is_enabled():
+            retry.configure(max_attempts=10, base_delay_ms=2,
+                            max_delay_ms=50, seed=17)
+            retry.enable()
+
+    before = {n: _counter(n) for n in _CACHE_COUNTERS}
+    passes = {}
+    try:
+        for label in ("cold", "warm"):
+            lat, wrong, shed, failed, span = _cache_pass(
+                combos, oracles, args.cache_dup, args.deadline_s,
+                args.max_concurrent, args.queue_depth, label)
+            snap = {n: _counter(n) for n in _CACHE_COUNTERS}
+            delta = {n: snap[n] - before[n] for n in _CACHE_COUNTERS}
+            before = snap
+            passes[label] = {
+                "lat": lat, "wrong": wrong, "shed": shed,
+                "failed": failed, "span": span, "delta": delta,
+            }
+    finally:
+        faultinj.disable()
+
+    cold, warm = passes["cold"], passes["warm"]
+    offered = len(combos) * args.cache_dup
+
+    def pcts(lat):
+        if not lat:
+            return float("nan"), float("nan")
+        p50, p99 = np.percentile(lat, [50, 99])
+        return float(p50), float(p99)
+
+    cold_p50, cold_p99 = pcts(cold["lat"])
+    warm_p50, warm_p99 = pcts(warm["lat"])
+    cold_qps = len(cold["lat"]) / cold["span"]
+    warm_qps = len(warm["lat"]) / warm["span"]
+    wd = warm["delta"]
+    warm_lookups = wd["cache.hits"] + wd["cache.misses"]
+    hit_rate = wd["cache.hits"] / warm_lookups if warm_lookups else 0.0
+    share = (cold["delta"]["cache.share"] + wd["cache.share"])
+    evict_injected = (cold["delta"]["cache.evict_injected"]
+                      + wd["cache.evict_injected"])
+    wrong = cold["wrong"] + warm["wrong"]
+    failed = cold["failed"] + warm["failed"]
+    speedup = warm_qps / cold_qps if cold_qps > 0 else float("inf")
+
+    row = {
+        "metric": "serve_cached_qps",
+        "value": round(warm_qps, 2),
+        "unit": "qps",
+        "cold_qps": round(cold_qps, 2),
+        "speedup": round(speedup, 2),
+        "hit_rate": round(hit_rate, 4),
+        "share": share,
+        "offered_per_pass": offered,
+        "completed_cold": len(cold["lat"]),
+        "completed_warm": len(warm["lat"]),
+        "shed_cold": cold["shed"],
+        "shed_warm": warm["shed"],
+        "wrong_answers": len(wrong),
+        "cold_p50_ms": round(cold_p50, 2),
+        "cold_p99_ms": round(cold_p99, 2),
+        "warm_p50_ms": round(warm_p50, 2),
+        "warm_p99_ms": round(warm_p99, 2),
+        "cold_counters": cold["delta"],
+        "warm_counters": wd,
+        "chaos": bool(args.chaos),
+        "rows": args.rows,
+        "dup": args.cache_dup,
+        "bit_identical": not wrong,
+    }
+    _emit(row)
+    if metrics.is_enabled():
+        _emit({"metrics": metrics.stage_report("serve_cache_bench")})
+
+    rc = 0
+    if wrong:
+        print(f"WRONG ANSWERS ({len(wrong)}): {wrong[:5]}",
+              file=sys.stderr)
+        rc = 1
+    if failed:
+        print(f"unexpected failures ({len(failed)}): {failed[:5]}",
+              file=sys.stderr)
+        rc = 1
+    if not cold["lat"] or not warm["lat"]:
+        print("cache bench completed zero queries in a pass",
+              file=sys.stderr)
+        rc = 1
+    if args.chaos:
+        # storm gates only: the economics gates below are meaningless
+        # while cache_evict is shooting entries down mid-lookup
+        if evict_injected <= 0:
+            print("chaos storm injected no cache eviction "
+                  "(cache.evict_injected == 0)", file=sys.stderr)
+            rc = 1
+    else:
+        if hit_rate < 0.8:
+            print(f"warm hit rate {hit_rate:.2f} < 0.8", file=sys.stderr)
+            rc = 1
+        if warm_qps < 3.0 * cold_qps:
+            print(f"warm {warm_qps:.1f} qps < 3x cold {cold_qps:.1f} qps",
+                  file=sys.stderr)
+            rc = 1
+        if warm_p99 > cold_p99:
+            print(f"warm p99 {warm_p99:.1f} ms worse than cold "
+                  f"{cold_p99:.1f} ms", file=sys.stderr)
+            rc = 1
+        if share <= 0:
+            print("duplicate bursts never shared an in-flight "
+                  "computation (cache.share == 0)", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rows", type=int, default=50_000,
@@ -394,6 +680,14 @@ def main() -> int:
                     help="arm ci/chaos_gray.json (one ramped-slow "
                     "worker) and gate on the tail-tolerance "
                     "invariants: quarantine + reinstate + hedges won")
+    ap.add_argument("--cache", action="store_true",
+                    help="cold/warm cached-serving tier (ISSUE 17): "
+                    "plan + subresult caches armed, duplicate bursts, "
+                    "bit-exactness vs uncached oracles; with --chaos, "
+                    "arms ci/chaos_cache.json instead")
+    ap.add_argument("--cache-dup", type=int, default=4,
+                    help="duplicate submissions per combo burst (the "
+                    "in-flight sharing pressure)")
     ap.add_argument("--gray-wait", type=float, default=45.0,
                     help="max seconds to wait post-workload for the "
                     "quarantined worker's reinstatement")
@@ -408,7 +702,10 @@ def main() -> int:
                     "gray tier raises this so the health scorer sees "
                     "enough samples)")
     ap.add_argument("--startup-timeout", type=float, default=180.0)
-    return run_bench(ap.parse_args())
+    args = ap.parse_args()
+    if args.cache:
+        return run_cache_bench(args)
+    return run_bench(args)
 
 
 if __name__ == "__main__":

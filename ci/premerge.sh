@@ -485,6 +485,62 @@ print(f"plan tier: {len(PLAN_QUERIES)} compiler-green queries "
       "-> artifacts/plan_compile.jsonl")
 EOF
 
+# cache tier (ISSUE 17): the srjt-cache suite with BOTH cache layers
+# armed (plan cache + memgov-governed subresult cache) and the race /
+# lockdep shims riding along — param-fingerprint properties over the
+# planfuzz corpus, single-flight attach/cancel/leader-failure,
+# spill-then-rematerialize bit-exactness, generation-bump
+# invalidation, and the serve integration (bad-estimate normalization,
+# forecast shed, chaos storm). Then bench_serve --cache runs the
+# cold/warm economics gate (its OWN exit code enforces warm hit rate
+# >= 0.8, >= 3x warm QPS at equal-or-better p99, in-flight sharing
+# > 0, and bit-exactness vs uncached oracles) and --cache --chaos
+# re-runs both passes under the ci/chaos_cache.json eviction/spill/
+# reject storm (zero wrong answers while entries are shot down
+# mid-lookup). The merge gate is artifact-based on top of the exit
+# codes: the archived BENCH rows must SHOW the warm hit rate, the
+# sharing, and zero wrong answers, and the metrics log must carry
+# cache events.
+rm -f artifacts/cache_metrics.jsonl artifacts/bench_cache.jsonl
+timeout -k 10 900 env JAX_PLATFORMS=cpu SRJT_LOCKDEP=1 SRJT_RACE=1 \
+  SRJT_PLAN_CACHE=1 SRJT_SUBRESULT_CACHE=1 \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/cache_metrics.jsonl \
+  python -m pytest tests/test_cache.py -q
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/cache_metrics.jsonl \
+  SRJT_RESULTS=artifacts/bench_cache.jsonl \
+  python benchmarks/bench_serve.py --cache --rows 20000
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+  SRJT_METRICS_ENABLED=1 SRJT_METRICS_LOG=artifacts/cache_metrics.jsonl \
+  SRJT_RESULTS=artifacts/bench_cache.jsonl \
+  python benchmarks/bench_serve.py --cache --chaos --rows 20000
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/bench_cache.jsonl")]
+bench = [r for r in rows if r.get("metric") == "serve_cached_qps"]
+plain = [r for r in bench if not r["chaos"]]
+storm = [r for r in bench if r["chaos"]]
+assert plain and storm, f"missing cache BENCH rows: {len(bench)}"
+b = plain[-1]
+assert b["wrong_answers"] == 0 and b["bit_identical"], b
+assert b["hit_rate"] >= 0.8, f"warm hit rate {b['hit_rate']} < 0.8"
+assert b["share"] > 0, "no in-flight sharing recorded (cache.share == 0)"
+assert b["value"] >= 3.0 * b["cold_qps"], \
+    f"warm {b['value']} qps < 3x cold {b['cold_qps']} qps"
+assert b["warm_p99_ms"] <= b["cold_p99_ms"], b
+s = storm[-1]
+assert s["wrong_answers"] == 0 and s["bit_identical"], s
+ev = (s["cold_counters"]["cache.evict_injected"]
+      + s["warm_counters"]["cache.evict_injected"])
+assert ev > 0, "chaos storm injected no cache eviction"
+lines = [json.loads(l) for l in open("artifacts/cache_metrics.jsonl")]
+assert lines, "cache tier produced no metrics events"
+print(f"cache tier: warm {b['value']} qps ({b['speedup']}x cold, "
+      f"hit rate {b['hit_rate']}, {b['share']} shares), storm survived "
+      f"{ev} injected evictions / 0 wrong answers "
+      "-> artifacts/cache_metrics.jsonl")
+EOF
+
 # lockdep + race gate (ISSUEs 7 + 11, layer 2): merge every
 # per-process report the armed tiers above dropped (fast tier + the
 # chaos tiers + the serve and gray tiers, incl. spawned

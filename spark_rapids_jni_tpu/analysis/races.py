@@ -97,6 +97,10 @@ RACE_GOVERNED = (
     # to the concurrent serving runtime, so their state discipline
     # (per-run contexts, no shared mutable caches) is worth proving
     "plan/",
+    # ISSUE 17: the serving-tier caches — the single-flight map, the
+    # plan-cache LRU, and the subresult LRU are crossed by every serve
+    # slot racing on one key; their lock discipline is worth proving
+    "cache/",
 )
 
 _SUPPRESS_RE = re.compile(

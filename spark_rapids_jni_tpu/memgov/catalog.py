@@ -288,6 +288,14 @@ class BufferCatalog:
                 if h.tier == TIER_DEVICE and not h.spillable
             )
 
+    def kind_stats(self, kind: str) -> Tuple[int, int]:
+        """(entries, bytes) of one registration kind — how the
+        subresult cache (srjt-cache, kind="cache") reads its own
+        governed footprint back out of the catalog."""
+        with self._lock:
+            hs = [h for h in self._entries.values() if h.kind == kind]
+            return len(hs), sum(h.nbytes for h in hs)
+
     def _update_gauges_locked(self) -> None:
         reg = _registry()
         reg.gauge("memgov.catalog.entries").set(len(self._entries))
@@ -298,6 +306,13 @@ class BufferCatalog:
         arenas = [h for h in self._entries.values() if h.kind == "arena"]
         reg.gauge("memgov.arenas").set(len(arenas))
         reg.gauge("memgov.arena_bytes").set(sum(h.nbytes for h in arenas))
+        # srjt-cache (ISSUE 17): the subresult cache's governed
+        # footprint — rides the same eviction/spill machinery, visible
+        # as its own pair so squeeze artifacts can tell cache bytes
+        # from working-set bytes
+        cached = [h for h in self._entries.values() if h.kind == "cache"]
+        reg.gauge("memgov.cache_entries").set(len(cached))
+        reg.gauge("memgov.cache_bytes").set(sum(h.nbytes for h in cached))
 
     def snapshot(self) -> dict:
         """JSON-clean shape for runtime.stats_report()."""
@@ -315,6 +330,14 @@ class BufferCatalog:
                 ),
                 "arenas": len(arenas),
                 "arena_bytes": sum(h.nbytes for h in arenas),
+                "cache_entries": sum(
+                    1 for h in self._entries.values() if h.kind == "cache"
+                ),
+                "cache_bytes": sum(
+                    h.nbytes
+                    for h in self._entries.values()
+                    if h.kind == "cache"
+                ),
             }
 
     # -- demotion ------------------------------------------------------------

@@ -27,7 +27,7 @@ Quick shape::
     out = P.compile_ir(ir, {"fact": fact, "dim": dim}, name="demo")()
 """
 
-from .compiler import CompiledPlan, compile_ir  # noqa: F401
+from .compiler import CompiledPlan, compile_ir, lower_ir  # noqa: F401
 from .distribute import (  # noqa: F401
     exchange_context,
     insert_exchanges,
@@ -64,9 +64,12 @@ from .nodes import (  # noqa: F401
 )
 from .rewrites import (  # noqa: F401
     Obligation,
+    ParamFingerprint,
     RewriteResult,
     fingerprint,
+    parameterized_fingerprint,
     prune_columns,
+    rebind_literals,
     rewrite,
 )
 from .verifier import (  # noqa: F401
@@ -77,12 +80,14 @@ from .verifier import (  # noqa: F401
 )
 
 __all__ = [
-    "CompiledPlan", "compile_ir",
+    "CompiledPlan", "compile_ir", "lower_ir",
     "PExpr", "PlanError", "pcol", "plit", "pwhen", "plike", "prlike",
     "Node", "Scan", "Filter", "Project", "Join", "Aggregate", "AggSpec",
     "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
     "CorrelatedAggFilter", "Exchange", "rollup", "infer_schema",
     "structure", "rewrite", "prune_columns", "RewriteResult", "Obligation",
-    "fingerprint", "PlanViolation", "verify_plan", "verify_obligations",
+    "fingerprint", "ParamFingerprint", "parameterized_fingerprint",
+    "rebind_literals",
+    "PlanViolation", "verify_plan", "verify_obligations",
     "verify_estimates", "insert_exchanges", "exchange_context",
 ]

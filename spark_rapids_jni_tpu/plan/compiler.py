@@ -68,7 +68,7 @@ def _durable(name: str):
     event log."""
     return metrics.registry().counter(name)
 
-__all__ = ["CompiledPlan", "compile_ir"]
+__all__ = ["CompiledPlan", "compile_ir", "lower_ir"]
 
 Schema = Dict[str, DType]
 
@@ -155,18 +155,28 @@ class _RunContext:
     be running on several serve slots at once, and per-run state on the
     stage objects would tear the estimate-vs-actual report."""
 
-    __slots__ = ("tables", "cache", "actuals")
+    __slots__ = ("tables", "cache", "actuals", "subcache")
 
-    def __init__(self, tables: Dict[str, Table]):
+    def __init__(self, tables: Dict[str, Table], subcache=None):
         self.tables = tables
         self.cache: Dict[int, Table] = {}
         self.actuals: Dict[int, Tuple[int, int]] = {}  # exec id -> (rows, bytes)
+        # srjt-cache (ISSUE 17): the cross-run subresult cache, or None
+        # when caching is off — stages annotated with a ``cache_key``
+        # consult it before recomputing
+        self.subcache = subcache
 
 
 class _Exec:
     """One lowered stage: knows its schema, estimates, and inputs."""
 
     kind = "?"
+
+    # srjt-cache (ISSUE 17): set once at annotation time (before any
+    # concurrent run) on stages whose subtree result is cacheable; the
+    # key pins (parameterized structure, literal bindings, table
+    # generations), so a stale entry is unreachable by construction
+    cache_key = None
 
     def __init__(self, schema: Schema, est_rows: int, inputs: List["_Exec"]):
         self.schema = schema
@@ -178,7 +188,11 @@ class _Exec:
         key = id(self)
         if key in ctx.cache:
             return ctx.cache[key]
-        out = self._run(ctx)
+        if ctx.subcache is not None and self.cache_key is not None:
+            out = ctx.subcache.lookup_or_compute(
+                self.cache_key, lambda: self._run(ctx))
+        else:
+            out = self._run(ctx)
         ctx.actuals[key] = (out.num_rows, _table_nbytes(out))
         ctx.cache[key] = out
         return out
@@ -786,7 +800,8 @@ class CompiledPlan:
     def __init__(self, name: str, root: _Exec, tables: Dict[str, Table],
                  stages: List[_Exec], raw_nodes: int, opt_nodes: int,
                  rewrites_fired: Dict[str, int], opt_plan: Node,
-                 obligations: Optional[list] = None):
+                 obligations: Optional[list] = None,
+                 node_execs: Optional[Dict[int, _Exec]] = None):
         self.name = name
         self.schema = dict(root.schema)
         self.optimized = opt_plan
@@ -799,11 +814,24 @@ class CompiledPlan:
         self._raw_nodes = raw_nodes
         self._opt_nodes = opt_nodes
         self._rewrites = dict(rewrites_fired)
+        # srjt-cache (ISSUE 17): id(optimized node) -> lowered stage,
+        # so the cache layer can annotate cacheable subtrees with their
+        # keys; and the cross-run subresult cache the run context
+        # consults (None = caching off). Both are set once before the
+        # plan is ever run concurrently.
+        self._node_execs = dict(node_execs or {})
+        self.subcache = None
         self.estimated_memory_bytes = max(
             s.working_set_est() for s in stages
         )
         self.last_report: Optional[dict] = None
         _durable("plan.compiles").inc()
+
+    def exec_for(self, node: Node) -> Optional[_Exec]:
+        """The lowered stage an optimized-plan node became, when it
+        lowered to a stage of its own (fused pipelines consume their
+        inner nodes)."""
+        return self._node_execs.get(id(node))
 
     @property
     def stages(self) -> list:
@@ -827,7 +855,7 @@ class CompiledPlan:
             _durable("plan.admit_bytes").inc(admitted)
             metrics.event("plan.admit", query=self.name, nbytes=admitted)
         try:
-            ctx = _RunContext(self._tables)
+            ctx = _RunContext(self._tables, subcache=self.subcache)
             out = self._root.run(ctx)
         finally:
             if adm is not None:
@@ -890,4 +918,29 @@ def compile_ir(plan: Node, tables: Dict[str, Table],
     root = low.lower(res.plan)
     return CompiledPlan(name, root, tables, low.all_execs, raw_nodes,
                         _count_nodes(res.plan), res.fired, res.plan,
-                        obligations=res.obligations)
+                        obligations=res.obligations, node_execs=low._execs)
+
+
+def lower_ir(opt_plan: Node, tables: Dict[str, Table], name: str = "plan", *,
+             raw_nodes: Optional[int] = None,
+             rewrites_fired: Optional[Dict[str, int]] = None,
+             obligations: Optional[list] = None) -> CompiledPlan:
+    """Lower an ALREADY-OPTIMIZED plan, skipping the rewrite pass — the
+    plan-cache hit path (srjt-cache, ISSUE 17): the cached entry's
+    optimized structure was verifier-green at insert, so binding fresh
+    literals only needs schema inference + lowering. The caller passes
+    through the cached entry's rewrite tallies and obligation ledger so
+    the compiled artifact stays auditable (``verify_obligations`` still
+    discharges the ORIGINAL firings — a literal rebind is
+    structure-preserving by construction)."""
+    catalog = {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
+               for t, tbl in tables.items()}
+    infer_schema(opt_plan, catalog)
+    opt_nodes = _count_nodes(opt_plan)
+    low = _Lowerer(tables, catalog)
+    root = low.lower(opt_plan)
+    _durable("plan.lower_only").inc()
+    return CompiledPlan(name, root, tables, low.all_execs,
+                        raw_nodes if raw_nodes is not None else opt_nodes,
+                        opt_nodes, dict(rewrites_fired or {}), opt_plan,
+                        obligations=obligations, node_execs=low._execs)

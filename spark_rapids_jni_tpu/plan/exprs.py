@@ -32,7 +32,8 @@ from ..columnar import dtype as dt
 from ..columnar.dtype import DType, TypeId
 from ..ops import expressions as rt
 
-__all__ = ["PExpr", "pcol", "plit", "pwhen", "plike", "prlike", "PlanError"]
+__all__ = ["PExpr", "pcol", "plit", "pwhen", "plike", "prlike", "PlanError",
+           "map_literals"]
 
 
 class PlanError(ValueError):
@@ -467,6 +468,31 @@ def substitute(e: PExpr, mapping: Dict[str, str]) -> PExpr:
                       substitute(e.other, mapping))
     if isinstance(e, _PLike):
         return _PLike(substitute(e.a, mapping), e.pattern, e.kind)
+    raise PlanError(f"unknown expression node {type(e).__name__}")
+
+
+def map_literals(e: PExpr, fn) -> PExpr:
+    """Rebuild ``e`` with every literal leaf mapped through ``fn``
+    (``_PLit -> PExpr``) — the literal-rebinding walker the plan cache
+    (srjt-cache) uses to bind fresh parameter values into a cached
+    optimized plan. Non-literal leaves are kept."""
+    if isinstance(e, _PLit):
+        return fn(e)
+    if isinstance(e, _PCol):
+        return e
+    if isinstance(e, _PBin):
+        return _PBin(e.op, map_literals(e.a, fn), map_literals(e.b, fn))
+    if isinstance(e, _PNot):
+        return _PNot(map_literals(e.a, fn))
+    if isinstance(e, _PIsNull):
+        return _PIsNull(map_literals(e.a, fn), e.want_null)
+    if isinstance(e, _PCast):
+        return _PCast(map_literals(e.a, fn), e.d)
+    if isinstance(e, _PWhen):
+        return _PWhen(map_literals(e.cond, fn), map_literals(e.then, fn),
+                      map_literals(e.other, fn))
+    if isinstance(e, _PLike):
+        return _PLike(map_literals(e.a, fn), e.pattern, e.kind)
     raise PlanError(f"unknown expression node {type(e).__name__}")
 
 

@@ -58,7 +58,8 @@ from .nodes import (
 )
 
 __all__ = ["rewrite", "prune_columns", "RewriteResult", "RULES",
-           "Obligation", "fingerprint"]
+           "Obligation", "fingerprint", "ParamFingerprint",
+           "parameterized_fingerprint", "rebind_literals"]
 
 
 def fingerprint(node: Node) -> str:
@@ -68,6 +69,146 @@ def fingerprint(node: Node) -> str:
     from .nodes import structure
 
     return hashlib.sha1(repr(structure(node)).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# parameterized fingerprint (srjt-cache, ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# The plan cache keys on structure with literal VALUES slotted out, so
+# "same dashboard query, different date" maps to one cache entry. Each
+# slot keeps a type tag (and the literal's explicit dtype when one was
+# given): ``plit(1998)`` and ``plit(19.98)`` infer different dtypes, so
+# they must never share a key — a hit must be schema-identical to the
+# cached structure, not merely tree-shaped like it.
+
+
+def _lit_tag(value) -> str:
+    """Type-class tag of a literal value. Two literals are slot-
+    compatible (one may be rebound to the other) iff tags match — the
+    tag pins exactly what ``_PLit.dtype`` infers, so a rebind can never
+    change the plan's schema."""
+    import numpy as np
+
+    if value is None:
+        return "null"
+    if isinstance(value, (bool, np.bool_)):
+        return "bool"
+    if isinstance(value, np.int32):
+        return "i32"
+    if isinstance(value, (int, np.integer)):
+        return "int"
+    if isinstance(value, (float, np.floating)):
+        return "float"
+    return f"other:{type(value).__name__}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamFingerprint:
+    """``key`` hashes the plan structure with literal values replaced by
+    typed slot markers; ``bindings`` are the slotted-out
+    ``(tag, value, dtype_key)`` triples in deterministic traversal
+    order. Two submissions with equal ``key`` are the same query modulo
+    literal values — the compiled-plan cache's identity."""
+
+    key: str
+    bindings: Tuple[Tuple, ...]
+
+    @property
+    def values(self) -> Tuple:
+        return tuple(b[1] for b in self.bindings)
+
+
+def _slot_literals(s, bindings: list):
+    """Recursively replace ``("lit", value, d)`` leaves of a
+    ``nodes.structure`` rendering with positional typed slots,
+    collecting the displaced values. Literal tuples are the only
+    3-tuples whose head is "lit" and whose tail is a dtype key (tuple or
+    None) — agg/window triples carry a string there, so the shape test
+    cannot misfire on them."""
+    if isinstance(s, tuple):
+        if (len(s) == 3 and s[0] == "lit"
+                and (s[2] is None or isinstance(s[2], tuple))):
+            tag = _lit_tag(s[1])
+            if tag.startswith("other"):
+                return s  # untypable literal: keep inline, never slot
+            bindings.append((tag, s[1], s[2]))
+            return ("lit", ("?", len(bindings) - 1, tag), s[2])
+        return tuple(_slot_literals(x, bindings) for x in s)
+    return s
+
+
+def parameterized_fingerprint(node: Node) -> ParamFingerprint:
+    """Structural fingerprint with literals slotted out (srjt-cache):
+    plans differing only in literal values share a ``key``; plans
+    differing in structure, literal type class, or explicit literal
+    dtype never do."""
+    from .nodes import structure
+
+    bindings: list = []
+    slotted = _slot_literals(structure(node), bindings)
+    key = hashlib.sha1(repr(slotted).encode()).hexdigest()[:16]
+    return ParamFingerprint(key, tuple(bindings))
+
+
+def _map_node_exprs(node: Node, f) -> Node:
+    """Rebuild ``node`` (inputs untouched) with its expressions mapped
+    through ``f`` — only Filter/Project/Having/CorrelatedAggFilter
+    carry expressions."""
+    if isinstance(node, Filter):
+        return Filter(node.input, f(node.predicate))
+    if isinstance(node, Project):
+        return Project(node.input, tuple((n, f(e)) for n, e in node.exprs))
+    if isinstance(node, Having):
+        return Having(node.input, f(node.predicate))
+    if isinstance(node, CorrelatedAggFilter):
+        return CorrelatedAggFilter(node.input, node.sub, node.on,
+                                   node.agg, f(node.predicate))
+    return node
+
+
+def rebind_literals(plan: Node, mapping: Dict) -> Node:
+    """Rebuild ``plan`` with literal values substituted through
+    ``mapping`` (``(tag, value, dtype_key) -> new_value``). Literals
+    without a mapping entry — e.g. the null fills grouping-set
+    expansion synthesizes — are kept. Shared subtrees stay shared (the
+    memo is by object identity), so a rebound CTE still lowers to one
+    stage. The caller is responsible for mapping only tag-compatible
+    values; rewrite rules copy and reorder literals but never fold
+    them, which is what makes by-value rebinding sound."""
+    from .exprs import map_literals, plit
+
+    def map_expr(e):
+        def one(lit):
+            d = None if lit.d is None else (int(lit.d.id), lit.d.scale)
+            key = (_lit_tag(lit.value), lit.value, d)
+            if key in mapping:
+                new = mapping[key]
+                if new is not lit.value and not _same_value(new, lit.value):
+                    return plit(new, lit.d)
+            return lit
+        return map_literals(e, one)
+
+    memo: Dict[int, Node] = {}
+
+    def visit(n: Node) -> Node:
+        got = memo.get(id(n))
+        if got is not None:
+            return got
+        new_inputs = tuple(visit(i) for i in n.inputs())
+        out = n if new_inputs == n.inputs() else _with_inputs(n, new_inputs)
+        out = _map_node_exprs(out, map_expr)
+        memo[id(n)] = out
+        return out
+
+    return visit(plan)
+
+
+def _same_value(a, b) -> bool:
+    try:
+        return bool(a == b) and type(a) is type(b)
+    except Exception:  # srjt-lint: allow-broad-except(exotic literal __eq__ = not rebindable, never an error)
+        return False
 
 
 @dataclasses.dataclass

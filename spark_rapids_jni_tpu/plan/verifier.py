@@ -101,6 +101,7 @@ __all__ = [
     "verify_plan",
     "verify_obligations",
     "verify_estimates",
+    "verify_for_cache",
     "OBLIGATION_DISCHARGERS",
 ]
 
@@ -930,4 +931,22 @@ def verify_estimates(cp, where: str = "plan") -> List[PlanViolation]:
             f"({cp.estimated_memory_bytes}) disagrees with the per-stage "
             f"working-set peak ({peak}) — memgov admission would trust a "
             "stale number"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer 4: cache-insert verification (srjt-cache, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def verify_for_cache(cp, tables, where: str = "cache") -> List[PlanViolation]:
+    """The plan cache's insert gate: a compiled plan enters the cache
+    only when its rewrite obligations discharge AND its stage estimates
+    are consistent — "verifier-green at insert". Hits then reuse the
+    cached structure without re-verifying per submission (the ISSUE 17
+    once-per-structure contract); this is the once."""
+    catalog = {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
+               for t, tbl in tables.items()}
+    out = verify_obligations(cp.obligations, catalog, where=where)
+    out.extend(verify_estimates(cp, where=where))
     return out

@@ -799,7 +799,7 @@ def stats_report(pretty: bool = False):
     Returns a JSON-serializable dict; ``pretty=True`` returns the
     aligned text rendering (utils/metrics.render_report) instead —
     the one-command artifact VERDICT items 5/7/8 ask for."""
-    from . import memgov, serve, sidecar, sidecar_pool
+    from . import cache, memgov, serve, sidecar, sidecar_pool
     from .utils import deadline as deadline_mod
     from .utils import integrity, memory, metrics, retry, trace_sink
 
@@ -818,6 +818,9 @@ def stats_report(pretty: bool = False):
         "health": sidecar_pool.health_section(),
         "hedge": sidecar_pool.hedge_section(),
         "serve": serve.stats_section(),
+        # ISSUE 17: srjt-cache — plan-cache hit economics, governed
+        # subresult footprint, in-flight sharing, knob posture
+        "cache": cache.stats_section(),
         "integrity": integrity.stats_section(),
         "deadline": {
             "default_budget_s": deadline_mod.default_budget(),

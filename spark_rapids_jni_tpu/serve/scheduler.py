@@ -118,7 +118,7 @@ def _shed_trace(qt, cause: str) -> None:
 
 SHED_CAUSES = ("queue_full", "pressure", "doa_deadline", "breaker",
                "quarantine", "cluster_degraded", "shutting_down",
-               "injected")
+               "injected", "forecast")
 
 # stride scheduling: pass advance per dispatch for weight 1.0
 _STRIDE1 = float(1 << 20)
@@ -135,7 +135,7 @@ class QueryHandle:
         "_scheduler", "_fn", "_args", "_kwargs", "tenant", "priority",
         "query_id", "_memory_bytes", "host_eligible", "_token", "_done",
         "_state", "_result", "_exc", "_t_submit", "_t_deadline",
-        "_t_dispatch", "_budget_s", "_trace",
+        "_t_dispatch", "_budget_s", "_trace", "_predicted_cost_s",
     )
 
     def __init__(self, scheduler, fn, args, kwargs, tenant, priority,
@@ -160,6 +160,10 @@ class QueryHandle:
         self._t_deadline = None if budget_s is None else t_submit + budget_s
         self._t_dispatch: Optional[float] = None
         self._trace = None  # srjt-trace root (tracing.QueryTrace), or None
+        # observed-cost EWMA of the cached plan structure (srjt-cache),
+        # None for uncached/never-run plans — the forecast controller's
+        # per-query input
+        self._predicted_cost_s: Optional[float] = None
 
     # -- the public surface --------------------------------------------------
 
@@ -522,9 +526,23 @@ class Scheduler:
             # memory_bytes), so the compile cannot move into the
             # dispatch slot — but the XLA compile itself is lazy
             # (first __call__), so the slot still pays that part
-            from ..plan import compile_ir as _compile_ir
+            from ..utils import knobs as _knobs
 
-            fn = _compile_ir(plan_node, args[0], name=f"serve.{tenant}")
+            if _knobs.get_bool("SRJT_PLAN_CACHE"):
+                # srjt-cache: a parameterized-fingerprint hit skips
+                # rewrite→verify→compile entirely and single-flights
+                # identical concurrent submissions (the CachedQuery
+                # wrapper also carries the structure's cost EWMA for
+                # the forecast controller below)
+                from .. import cache as _cache
+
+                fn = _cache.compile_cached(
+                    plan_node, args[0], name=f"serve.{tenant}"
+                )
+            else:
+                from ..plan import compile_ir as _compile_ir
+
+                fn = _compile_ir(plan_node, args[0], name=f"serve.{tenant}")
             args = ()
         if memory_bytes is None:
             # plan-derived pre-admission (ROADMAP item-2 follow-up):
@@ -532,6 +550,15 @@ class Scheduler:
             # memgov pre-admission and the overload controller see a
             # real footprint instead of a hand-fed number
             memory_bytes = getattr(fn, "estimated_memory_bytes", None)
+        if memory_bytes is not None and memory_bytes <= 0:
+            # a zero/negative estimate is not "needs no memory", it is
+            # "no usable estimate": 0 would sail through memgov
+            # pre-admission as a free query and starve real admissions
+            # of their accounting — normalize to None (un-estimated)
+            # and count the bad input
+            self._reg().counter("serve.bad_estimate").inc()
+            memory_bytes = None
+        predicted_cost_s = getattr(fn, "predicted_cost_s", None)
         shed_exc: Optional[Overloaded] = None
         victim: Optional[QueryHandle] = None
         victim_cause: Optional[str] = None
@@ -547,6 +574,7 @@ class Scheduler:
                 q = QueryHandle(self, fn, args, kwargs, tenant, priority,
                                 eff, memory_bytes, host_eligible,
                                 next(self._ids), now)
+                q._predicted_cost_s = predicted_cost_s
                 # admission shedding, lowest-priority-first, at most
                 # ONE eviction per admitted query. The per-tenant bound
                 # is the harder constraint and is checked first: an
@@ -568,7 +596,9 @@ class Scheduler:
                 else:
                     # overload controller: global depth / queue age /
                     # memgov pressure shed lowest-priority-first
-                    cause = self._pressure_cause_locked(now)
+                    cause = self._pressure_cause_locked(
+                        now, incoming_cost=predicted_cost_s
+                    )
                     if cause is not None:
                         victim = self._evict_locked(None, q, cause)
                         if victim is None:
@@ -616,11 +646,31 @@ class Scheduler:
         )
         return q
 
-    def _pressure_cause_locked(self, now: float) -> Optional[str]:
+    def _pressure_cause_locked(self, now: float,
+                               incoming_cost: Optional[float] = None,
+                               ) -> Optional[str]:
         """The overload controller's trip decision: queue depth, queue
-        age, and memory-governor pressure — admission-time only."""
+        age, memory-governor pressure, and (srjt-cache) predicted-cost
+        forecast — admission-time only."""
         if self._max_queued > 0 and self._queued >= self._max_queued:
             return "pressure"
+        from ..utils import knobs as _knobs
+
+        budget = _knobs.get_float("SRJT_SERVE_FORECAST_BUDGET_SEC")
+        if budget is not None and budget > 0:
+            # admission-cost forecast: cached plans carry an observed
+            # run-cost EWMA; when the PREDICTED seconds of work already
+            # queued plus this query exceed the budget, shed NOW at
+            # queue depth 1-2 instead of after the queue is deep —
+            # depth-based control can't see that two queued monsters
+            # are worse than ten queued trivia. Unknown costs count 0:
+            # the forecast only ever sheds on what it has evidence for.
+            queued_cost = sum(
+                (q._predicted_cost_s or 0.0)
+                for t in self._tenants.values() for q in t.q
+            )
+            if queued_cost + (incoming_cost or 0.0) > budget:
+                return "forecast"
         if self._queued:
             # per-tenant FIFO: each lane's head is its oldest entry,
             # so the global oldest is a min over heads, not a full scan

@@ -105,7 +105,17 @@ __all__ = [
     "maybe_inject",
     "maybe_corrupt",
     "is_enabled",
+    "CacheEvictInjected",
 ]
+
+
+class CacheEvictInjected(RuntimeError):
+    """The ``cache_evict`` chaos payload (srjt-cache, ISSUE 17): raised
+    out of ``maybe_inject("cache.<layer>.<key>")`` at the cache's
+    lookup choke point. The cache layer CATCHES it, drops the named
+    entry, counts ``cache.evict_injected``, and proceeds as a miss —
+    the acceptance contract is that a poisoned/evicted entry recomputes
+    and never serves stale bytes, so this never escapes to a caller."""
 
 
 class _Rule:
@@ -156,7 +166,7 @@ def _parse(cfg: dict) -> None:
         kind = spec.get("type", "retryable")
         if kind not in ("fatal", "retryable", "exception", "delay", "hang",
                         "spill_fail", "crash", "corrupt", "reject",
-                        "netsplit"):
+                        "netsplit", "cache_evict"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -341,6 +351,13 @@ def maybe_inject(op_name: str) -> None:
         raise ConnectionRefusedError(
             f"injected netsplit in {op_name}: connection refused"
         )
+    if kind == "cache_evict":
+        # the cache-eviction chaos (srjt-cache, ISSUE 17): key it
+        # ``cache.*`` (or a specific ``cache.plan.<fp>`` /
+        # ``cache.sub.<fp>`` op) to force eviction of the entry being
+        # looked up, mid-query. The cache layer converts this into
+        # drop-and-recompute — never a caller-visible failure.
+        raise CacheEvictInjected(f"injected cache eviction in {op_name}")
     if kind == "spill_fail":
         # the memory governor's demotion chaos (memgov/catalog.py calls
         # maybe_inject("memgov.spill") around every spill): same

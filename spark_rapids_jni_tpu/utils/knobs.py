@@ -488,6 +488,41 @@ declare("SRJT_SERVE_RETRY_AFTER_SEC", "float", 0.25,
         "default retry_after_s backoff hint carried by a shed's "
         "Overloaded error", positive=True)
 
+# serving-tier caches (cache/, ISSUE 17)
+declare("SRJT_PLAN_CACHE", "bool", False,
+        "arm the compiled-plan cache: serve.submit(plan) keys on the "
+        "parameterized structural fingerprint, a hit skips "
+        "rewrite->verify->compile and rebinds the fresh literals into "
+        "the cached optimized plan (re-verified once per structure at "
+        "insert, not per submission)")
+declare("SRJT_SUBRESULT_CACHE", "bool", False,
+        "arm the subresult cache: scan/aggregate stage outputs are "
+        "registered as memgov catalog entries (kind=cache) keyed by "
+        "(parameterized subtree fingerprint, literal bindings, table "
+        "generations), so eviction/spill tiering/byte accounting ride "
+        "the governor")
+declare("SRJT_CACHE_SHARING", "bool", True,
+        "in-flight single-flight sharing of identical submissions "
+        "(multi-query optimization): concurrent queries with one plan "
+        "key attach to ONE computation and fan the result out — only "
+        "consulted when SRJT_PLAN_CACHE is armed")
+declare("SRJT_CACHE_PLAN_ENTRIES", "int", 64,
+        "parameterized-structure entries the compiled-plan cache "
+        "retains (LRU past it)", minimum=1)
+declare("SRJT_CACHE_PLAN_VARIANTS", "int", 8,
+        "fully-bound CompiledPlan variants retained per structure "
+        "entry (exact-literal resubmission reuses the artifact "
+        "outright; LRU past it)", minimum=1)
+declare("SRJT_CACHE_SUBRESULT_BYTES", "int", 256 * 1024 * 1024,
+        "byte cap on subresult-cache catalog entries; past it the "
+        "cache LRU-unregisters its own entries (on top of — never "
+        "instead of — memgov's spill/eviction pressure)", minimum=1)
+declare("SRJT_SERVE_FORECAST_BUDGET_SEC", "float", 0.0,
+        "admission-cost forecasting: predicted seconds of queued plan "
+        "runtime (observed-cost EWMA carried by cached plans) the "
+        "scheduler accepts before shedding with "
+        "Overloaded(cause=\"forecast\"); 0 disables the forecaster")
+
 # Pallas kernel tier (ops/pallas_kernels.py, ISSUE 13)
 declare("SRJT_PALLAS_JOIN", "bool", True,
         "arm the paged-hash-table Pallas join tier for single int-key "

@@ -783,6 +783,20 @@ def stage_report(stage: str) -> dict:
             "shed_total": _REGISTRY.value("serve.shed_total"),
             "expired_in_queue": _REGISTRY.value("serve.expired_in_queue"),
         },
+        # ISSUE 17 caching counters: plan-cache hit economics, stage
+        # (subresult) reuse, and in-flight sharing — the cache-tier
+        # artifacts gate warm hit rate and share>0 from exactly these
+        "cache": {
+            "hits": _REGISTRY.value("cache.hits"),
+            "misses": _REGISTRY.value("cache.misses"),
+            "rebinds": _REGISTRY.value("cache.rebinds"),
+            "share": _REGISTRY.value("cache.share"),
+            "sub_hits": _REGISTRY.value("cache.sub_hits"),
+            "sub_misses": _REGISTRY.value("cache.sub_misses"),
+            "evictions": (_REGISTRY.value("cache.evictions")
+                          + _REGISTRY.value("cache.sub_evictions")),
+            "evict_injected": _REGISTRY.value("cache.evict_injected"),
+        },
     }
 
 

@@ -84,6 +84,7 @@ __all__ = [
     "span",
     "op_span",
     "closed_span",
+    "event_span",
     "annotate",
     "start_trace",
     "current_context",
@@ -353,6 +354,15 @@ def span(name: str, **annotations):
     finally:
         _current.reset(tok)
         _finish_span(sp)
+
+
+def event_span(name: str, **annotations) -> None:
+    """An instantaneous event recorded as a zero-duration closed child
+    span — how the cache tier (srjt-cache, ISSUE 17) stamps hit/miss/
+    attach decisions into the query's span tree without opening a
+    region. Same no-op contract as ``closed_span``: nothing happens
+    without an active sampled context."""
+    closed_span(name, 0.0, **annotations)
 
 
 def closed_span(name: str, dur_s: float, t_wall: Optional[float] = None,
