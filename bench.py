@@ -109,6 +109,88 @@ def bench_cpu_ref() -> float:
     return float(np.median(times))
 
 
+# --ooc mode (srjt-ooc, ISSUE 18): TPC-H q1's shape at a row count
+# where compute dominates the strategy's fixed overhead, run in-core
+# (unconstrained) and out-of-core (budget pinched to est/4, K=4
+# spill-backed partitions). The BENCH row is the degradation price:
+# ooc_overhead = OOC wall / in-core wall; ci/premerge.sh gates <= 2x.
+# 1M rows: the exact-f64 aggregate path carries a per-invocation fixed
+# cost the K passes each pay — smaller datasets measure that fixed
+# cost x K, not the strategy (200k rows reads ~2.5x; 1M reads ~1.4x
+# with the linear term dominant).
+OOC_ROWS = 1_000_000
+OOC_PARTS = 4
+OOC_REPS = 3
+
+
+def bench_ooc():
+    import os
+
+    from spark_rapids_jni_tpu import memgov
+    from spark_rapids_jni_tpu import plan as P
+    from spark_rapids_jni_tpu.models.tpch import gen_lineitem
+
+    lineitem = gen_lineitem(OOC_ROWS, seed=11)
+    tables = {"lineitem": lineitem}
+    ir = P.Sort(
+        P.Aggregate(
+            P.Filter(P.Scan("lineitem"),
+                     P.pcol("l_quantity") >= P.plit(0.0)),
+            keys=("l_returnflag", "l_linestatus"),
+            aggs=(P.AggSpec("l_quantity", "sum", "sum_qty"),
+                  P.AggSpec("l_extendedprice", "sum", "sum_price"),
+                  P.AggSpec(None, "count_all", "count_order")),
+        ),
+        keys=(("l_returnflag", True), ("l_linestatus", True)),
+    )
+
+    def med_wall(fn):
+        fn()  # warmup: XLA compiles excluded, as everywhere in this file
+        times = []
+        for _ in range(OOC_REPS):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    cp_in = P.compile_ir(ir, tables, name="ooc_bench_incore")
+    t_in = med_wall(cp_in)
+    want = [np.asarray(c.data).tobytes() for c in cp_in().columns]
+
+    est = cp_in.estimated_memory_bytes
+    os.environ["SRJT_OOC_ENABLED"] = "1"  # srjt-lint: allow-environ(bench process owns its env; knobs read live)
+    os.environ["SRJT_OOC_PARTITIONS"] = str(OOC_PARTS)  # srjt-lint: allow-environ(bench process owns its env)
+    os.environ["SRJT_DEVICE_MEMORY_BUDGET"] = str(max(1, est // 4))  # srjt-lint: allow-environ(bench process owns its env)
+    with memgov.enabled():
+        cp_ooc = P.compile_ir(ir, tables, name="ooc_bench")
+        assert isinstance(cp_ooc, P.OutOfCorePlan), \
+            "budget est/4 did not select out-of-core"
+        t_ooc = med_wall(cp_ooc)
+        got = [np.asarray(c.data).tobytes() for c in cp_ooc().columns]
+    assert got == want, "ooc bench diverged from the in-core answer"
+    return t_in, t_ooc, est
+
+
+def main_ooc():
+    t_in, t_ooc, est = bench_ooc()
+    print(json.dumps({
+        "metric": "ooc_overhead",
+        "value": round(t_ooc / t_in, 3),
+        "unit": "x",
+        # the gate ci/premerge.sh enforces on this row (kept in the
+        # artifact so the number and its bar travel together)
+        "gate_max": 2.0,
+        "raw": {
+            "rows": OOC_ROWS,
+            "partitions": OOC_PARTS,
+            "est_peak_bytes": est,
+            "in_core_s": round(t_in, 5),
+            "out_of_core_s": round(t_ooc, 5),
+            "bit_identical": True,
+        },
+    }))
+
+
 def main():
     from spark_rapids_jni_tpu.utils import metrics, retry, trace_sink, tracing
 
@@ -183,4 +265,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--ooc" in sys.argv[1:]:
+        main_ooc()
+    else:
+        main()

@@ -163,6 +163,50 @@ print(f"archived {len(lines)} memgov events ({spilled} bytes spilled) "
       "-> artifacts/memgov_events.jsonl")
 EOF
 
+# out-of-core tier (srjt-ooc, ISSUE 18): the full ooc suite with the
+# strategy armed and the ambient device budget PINCHED below the
+# q1-shape working set — selection, verifier discharge of the
+# partitioning rewrite, the >=4x-budget bit-identity acceptance, the
+# ci/chaos_ooc.json storm on a real 2-worker pool (failed/corrupt
+# partition spills + a kill -9 mid-partition), pin discipline against
+# the pressure loop, and per-partition serve admission. The artifact
+# gate reads the run reports every completed OOC run appends to
+# SRJT_OOC_METRICS: degraded runs really streamed >1 spill-backed
+# partition (partitions>1, spills>0) and the storm really resumed from
+# a checkpoint (resumes>0) — with zero test failures (= zero wrong
+# answers) above it. The BENCH row prices the degradation: an
+# out-of-core pass over an in-core-feasible dataset must stay within
+# 2x of the unconstrained wall (the row carries its own gate_max so
+# the number and its bar travel together).
+rm -f artifacts/ooc_metrics.jsonl artifacts/bench_ooc.jsonl
+timeout -k 10 900 env SRJT_LOCKDEP=1 SRJT_OOC_ENABLED=1 \
+  SRJT_DEVICE_MEMORY_BUDGET=32768 \
+  SRJT_OOC_METRICS=artifacts/ooc_metrics.jsonl \
+  python -m pytest tests/test_ooc.py -q
+python bench.py --ooc | tee artifacts/bench_ooc.jsonl
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/ooc_metrics.jsonl")]
+assert rows, "ooc tier produced no run reports"
+assert all(r["ooc"] for r in rows)
+assert any(r["partitions"] > 1 for r in rows), "no partitioned run recorded"
+spills = sum(r["spills"] for r in rows)
+assert spills > 0, "pinched-budget tier forced no partition spills"
+resumes = sum(r["resumes"] for r in rows)
+assert resumes > 0, "no partition resume recorded under the chaos storm"
+bench = [json.loads(s) for s in open("artifacts/bench_ooc.jsonl")
+         if s.strip()]
+row = next(r for r in bench if r.get("metric") == "ooc_overhead")
+assert row["raw"]["bit_identical"], "ooc bench diverged"
+assert row["value"] <= row["gate_max"], (
+    f"out-of-core overhead {row['value']}x exceeds the "
+    f"{row['gate_max']}x degradation bar")
+print(f"ooc tier: {len(rows)} degraded runs ({spills} spills, "
+      f"{resumes} resumes) -> artifacts/ooc_metrics.jsonl; "
+      f"ooc_overhead {row['value']}x (gate {row['gate_max']}x) "
+      "-> artifacts/bench_ooc.jsonl")
+EOF
+
 # crash-storm tier (ISSUE 5): the full sidecar-pool + integrity suite
 # with the crash/corrupt chaos profile armed INSIDE real workers — a
 # pool of 2 survives kill -9 mid-query (failover + arena re-hydration)

@@ -123,6 +123,12 @@ class CachedQuery:
         return getattr(self._cp, "estimated_memory_bytes", None)
 
     @property
+    def partition_memory_bytes(self):
+        # srjt-ooc (ISSUE 18): when the cached binding degraded to
+        # out-of-core, serve admission wants the per-partition peak
+        return getattr(self._cp, "partition_memory_bytes", None)
+
+    @property
     def name(self):
         return getattr(self._cp, "name", "plan")
 

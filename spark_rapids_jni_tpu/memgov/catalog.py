@@ -230,6 +230,16 @@ class BufferCatalog:
             self._update_gauges_locked()
         return h
 
+    def lookup(self, key: str) -> Optional[SpillableHandle]:
+        """The live handle registered under ``key``, or None — how the
+        out-of-core partition loop (plan/ooc.py, ISSUE 18) finds a prior
+        attempt's checkpointed partials to resume from."""
+        with self._lock:
+            h = self._entries.get(key)
+            if h is None or h._closed:
+                return None
+            return h
+
     def unregister(self, key: str) -> bool:
         with self._lock:
             h = self._entries.pop(key, None)
@@ -396,7 +406,7 @@ class BufferCatalog:
         spill containers (SRJTSPL1 envelope, plain npz) written before
         this layout still load — see ``_load_disk_locked``."""
         from ..columnar import frames
-        from ..utils import metrics
+        from ..utils import faultinj, metrics
 
         reg = _registry()
         t0 = time.perf_counter()
@@ -404,8 +414,14 @@ class BufferCatalog:
         path = os.path.join(
             self._resolve_spill_dir(), f"{safe}-{h._seq}.frm"
         )
+        # chaos crossing (ISSUE 18): a `corrupt` rule keyed
+        # "memgov.spill.frame" flips bytes AFTER the frame's CRCs were
+        # computed — the bit-rot-on-disk model; re-materialization must
+        # surface it as DataCorruption, never as wrong rows
+        blob = faultinj.maybe_corrupt("memgov.spill.frame",
+                                      frames.encode_leaves(h._host))
         with open(path, "wb") as f:
-            f.write(frames.encode_leaves(h._host))
+            f.write(blob)
         h._disk_path = path
         h._host = None
         reg.counter("memgov.disk_spills").inc()

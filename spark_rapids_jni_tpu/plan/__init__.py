@@ -38,8 +38,13 @@ from .exprs import (  # noqa: F401
     pcol,
     plike,
     plit,
+    ppart,
     prlike,
     pwhen,
+)
+from .ooc import (  # noqa: F401
+    OutOfCorePlan,
+    maybe_out_of_core,
 )
 from .nodes import (  # noqa: F401
     Aggregate,
@@ -81,7 +86,8 @@ from .verifier import (  # noqa: F401
 
 __all__ = [
     "CompiledPlan", "compile_ir", "lower_ir",
-    "PExpr", "PlanError", "pcol", "plit", "pwhen", "plike", "prlike",
+    "OutOfCorePlan", "maybe_out_of_core",
+    "PExpr", "PlanError", "pcol", "plit", "pwhen", "plike", "prlike", "ppart",
     "Node", "Scan", "Filter", "Project", "Join", "Aggregate", "AggSpec",
     "Window", "Sort", "Limit", "UnionAll", "SetOp", "Exists", "Having",
     "CorrelatedAggFilter", "Exchange", "rollup", "infer_schema",

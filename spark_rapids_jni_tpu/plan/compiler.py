@@ -916,9 +916,15 @@ def compile_ir(plan: Node, tables: Dict[str, Table],
         _durable(f"plan.rewrites.{rule}").inc(n)
     low = _Lowerer(tables, catalog)
     root = low.lower(res.plan)
-    return CompiledPlan(name, root, tables, low.all_execs, raw_nodes,
-                        _count_nodes(res.plan), res.fired, res.plan,
-                        obligations=res.obligations, node_execs=low._execs)
+    cp = CompiledPlan(name, root, tables, low.all_execs, raw_nodes,
+                      _count_nodes(res.plan), res.fired, res.plan,
+                      obligations=res.obligations, node_execs=low._execs)
+    # srjt-ooc (ISSUE 18): a plan whose peak exceeds the armed device
+    # budget degrades to streamed partitioned execution instead of
+    # split-retrying to failure; a no-op unless SRJT_OOC_ENABLED
+    from .ooc import maybe_out_of_core
+
+    return maybe_out_of_core(cp, tables)
 
 
 def lower_ir(opt_plan: Node, tables: Dict[str, Table], name: str = "plan", *,
@@ -940,7 +946,13 @@ def lower_ir(opt_plan: Node, tables: Dict[str, Table], name: str = "plan", *,
     low = _Lowerer(tables, catalog)
     root = low.lower(opt_plan)
     _durable("plan.lower_only").inc()
-    return CompiledPlan(name, root, tables, low.all_execs,
-                        raw_nodes if raw_nodes is not None else opt_nodes,
-                        opt_nodes, dict(rewrites_fired or {}), opt_plan,
-                        obligations=obligations, node_execs=low._execs)
+    cp = CompiledPlan(name, root, tables, low.all_execs,
+                      raw_nodes if raw_nodes is not None else opt_nodes,
+                      opt_nodes, dict(rewrites_fired or {}), opt_plan,
+                      obligations=obligations, node_execs=low._execs)
+    # srjt-ooc (ISSUE 18): the cache-hit path re-selects out-of-core
+    # per binding — the cached entry stores the UN-partitioned plan
+    # (partition count is a budget decision, not plan structure)
+    from .ooc import maybe_out_of_core
+
+    return maybe_out_of_core(cp, tables)

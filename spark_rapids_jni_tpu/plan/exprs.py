@@ -32,8 +32,8 @@ from ..columnar import dtype as dt
 from ..columnar.dtype import DType, TypeId
 from ..ops import expressions as rt
 
-__all__ = ["PExpr", "pcol", "plit", "pwhen", "plike", "prlike", "PlanError",
-           "map_literals"]
+__all__ = ["PExpr", "pcol", "plit", "pwhen", "plike", "prlike", "ppart",
+           "PlanError", "map_literals"]
 
 
 class PlanError(ValueError):
@@ -396,6 +396,57 @@ class _PLike(PExpr):
         return ("like", self.kind, self.pattern, self.a.structure())
 
 
+class _PartHashEval(rt.Expression):
+    """Runtime bridge for ``_PPartHash``: the murmur3-pmod partition map
+    over the key columns (``ops/hashing.hash_partition_map``) — the same
+    partitioner the physical shuffle uses, so a plan-level partition
+    predicate selects *exactly* the rows the executor's
+    ``hash_partition`` would route to that partition."""
+
+    def __init__(self, names: Tuple[str, ...], parts: int):
+        self.names, self.parts = names, parts
+
+    def _eval(self, table):
+        from ..ops.hashing import hash_partition_map
+
+        ids = hash_partition_map(
+            [table.column(n) for n in self.names], self.parts
+        )
+        return rt._Value(ids, None, None)
+
+
+class _PPartHash(PExpr):
+    """``part_hash(keys, K)`` — the INT32 partition id (murmur3 pmod K)
+    of each row's key tuple. The out-of-core rewrite's partition
+    predicate is ``ppart(keys, K) == plit(i)``; because every row of a
+    group hashes identically, each group lands whole in one branch."""
+
+    def __init__(self, names: Tuple[str, ...], parts: int):
+        names = tuple(names)
+        if not names:
+            raise PlanError("part_hash needs at least one key column")
+        if int(parts) < 2:
+            raise PlanError(f"part_hash needs >= 2 partitions, got {parts}")
+        self.names, self.parts = names, int(parts)
+
+    def dtype(self, schema: Schema) -> DType:
+        for n in self.names:
+            if n not in schema:
+                raise PlanError(
+                    f"column {n!r} not in schema {sorted(schema)}"
+                )
+        return dt.INT32
+
+    def refs(self):
+        return frozenset(self.names)
+
+    def lower(self):
+        return _PartHashEval(self.names, self.parts)
+
+    def structure(self):
+        return ("part_hash", self.names, self.parts)
+
+
 def _wrap(v) -> PExpr:
     if isinstance(v, PExpr):
         return v
@@ -425,6 +476,12 @@ def plike(expr: PExpr, pattern: str) -> PExpr:
 def prlike(expr: PExpr, pattern: str) -> PExpr:
     """Spark ``RLIKE`` — regex substring search."""
     return _PLike(expr, pattern, "rlike")
+
+
+def ppart(names, parts: int) -> PExpr:
+    """Row partition id: murmur3-pmod of the key tuple into ``parts``
+    buckets — bit-matches the physical shuffle partitioner."""
+    return _PPartHash(tuple(names), parts)
 
 
 def conjuncts(e: PExpr) -> Tuple[PExpr, ...]:
@@ -468,6 +525,8 @@ def substitute(e: PExpr, mapping: Dict[str, str]) -> PExpr:
                       substitute(e.other, mapping))
     if isinstance(e, _PLike):
         return _PLike(substitute(e.a, mapping), e.pattern, e.kind)
+    if isinstance(e, _PPartHash):
+        return _PPartHash(tuple(mapping.get(n, n) for n in e.names), e.parts)
     raise PlanError(f"unknown expression node {type(e).__name__}")
 
 
@@ -493,6 +552,10 @@ def map_literals(e: PExpr, fn) -> PExpr:
                       map_literals(e.other, fn))
     if isinstance(e, _PLike):
         return _PLike(map_literals(e.a, fn), e.pattern, e.kind)
+    if isinstance(e, _PPartHash):
+        # partition structure is never a cache parameter — K and the key
+        # set are part of the plan's shape, not its literals
+        return e
     raise PlanError(f"unknown expression node {type(e).__name__}")
 
 

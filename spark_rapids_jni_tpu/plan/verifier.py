@@ -817,6 +817,41 @@ def _d_prune(ob, catalog) -> List[str]:
     return msgs
 
 
+def _d_partition_ooc(ob, catalog) -> List[str]:
+    """srjt-ooc (ISSUE 18): Aggregate -> UnionAll of per-partition
+    aggregates. Branch ``i`` must be the ORIGINAL aggregate (same keys,
+    same agg specs, no grouping sets) over ``Filter(<original input>,
+    part_hash(keys, K) == i)``. With the branches ordered ``i =
+    0..K-1``, disjointness and completeness hold by construction — the
+    partition ids partition the rows — and every group lands whole in
+    exactly one branch because all of its rows share one key tuple."""
+    b, a = ob.before, ob.after
+    if not (isinstance(b, Aggregate) and b.keys
+            and b.grouping_sets is None):
+        return ["before-subtree is not a keyed Aggregate (no grouping "
+                "sets)"]
+    if not (isinstance(a, UnionAll) and len(a.branches) >= 2):
+        return ["after-subtree is not a UnionAll of >= 2 partition "
+                "branches"]
+    msgs: List[str] = []
+    parts = len(a.branches)
+    want_aggs = [(s.source, s.how, s.name) for s in b.aggs]
+    for i, br in enumerate(a.branches):
+        if not (isinstance(br, Aggregate) and br.keys == b.keys
+                and br.grouping_sets is None
+                and [(s.source, s.how, s.name) for s in br.aggs] == want_aggs):
+            msgs.append(f"branch {i} is not the original Aggregate "
+                        "(keys/aggs changed)")
+            continue
+        f = br.input
+        want = (ex.ppart(b.keys, parts) == ex.plit(i)).structure()
+        if not (isinstance(f, Filter) and f.input is b.input
+                and f.predicate.structure() == want):
+            msgs.append(f"branch {i} input is not Filter(<original "
+                        f"input>, part_hash(keys, {parts}) == {i})")
+    return msgs
+
+
 # rule name -> discharge fn(obligation, catalog) -> list of failure
 # messages. srjt-lint SRJT011 statically requires every rule registered
 # in rewrites.RULES (plus prune_columns) to appear here or carry
@@ -832,6 +867,8 @@ OBLIGATION_DISCHARGERS: Dict[str, Callable] = {
     "push_filter_through_union": _d_push_union,
     "push_filter_into_join": _d_push_join,
     "prune_columns": _d_prune,
+    # emitted by plan/ooc.py (compiler tail), not rewrites.RULES
+    "partition_for_ooc": _d_partition_ooc,
 }
 
 

@@ -548,8 +548,17 @@ class Scheduler:
             # plan-derived pre-admission (ROADMAP item-2 follow-up):
             # compiled plans carry per-stage estimates — the scheduler's
             # memgov pre-admission and the overload controller see a
-            # real footprint instead of a hand-fed number
-            memory_bytes = getattr(fn, "estimated_memory_bytes", None)
+            # real footprint instead of a hand-fed number. An
+            # out-of-core plan (srjt-ooc, ISSUE 18) admits its
+            # PER-PARTITION peak: the whole-plan estimate exceeds the
+            # budget by construction, and admitting it would reject the
+            # very strategy chosen to fit — the downgrade is counted.
+            ooc_peak = getattr(fn, "partition_memory_bytes", None)
+            if ooc_peak is not None and ooc_peak > 0:
+                memory_bytes = ooc_peak
+                self._reg().counter("memgov.ooc_admissions").inc()
+            else:
+                memory_bytes = getattr(fn, "estimated_memory_bytes", None)
         if memory_bytes is not None and memory_bytes <= 0:
             # a zero/negative estimate is not "needs no memory", it is
             # "no usable estimate": 0 would sail through memgov

@@ -469,6 +469,25 @@ declare("SRJT_MEMGOV_DROP_SMCACHE", "bool", False,
         "1 lets pressure drop compiled shard_map executables as a "
         "last resort")
 
+# out-of-core partitioned execution (plan/ooc.py, ISSUE 18)
+declare("SRJT_OOC_ENABLED", "bool", False,
+        "arm out-of-core degradation: a plan whose estimated peak "
+        "exceeds the armed SRJT_DEVICE_MEMORY_BUDGET is rewritten "
+        "(partition_for_ooc, verifier-discharged) into K hash "
+        "partitions streamed through the compiled pipeline and merged")
+declare("SRJT_OOC_PARTITIONS", "int", 0,
+        "partition count K for out-of-core plans; 0 = auto (smallest "
+        "K <= 64 whose per-partition estimate fits half the device "
+        "budget)")
+declare("SRJT_OOC_PREFETCH", "bool", True,
+        "overlap the next partition's spill-in (catalog "
+        "re-materialization + a sidecar-pool ping) with the current "
+        "partition's compute")
+declare("SRJT_OOC_METRICS", "str", None,
+        "JSONL path appended one line per out-of-core run (partitions, "
+        "resumes, lineage recomputes, spill count, wall) — the "
+        "premerge ooc tier's artifact gate")
+
 # concurrent serving runtime (serve/, ISSUE 8)
 declare("SRJT_SERVE_MAX_CONCURRENT", "int", 4,
         "scheduler dispatch slots: queries executing concurrently "

@@ -77,6 +77,27 @@ def _assert_no_scheduler_thread_leak():
         )
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _assert_no_partition_entry_leak():
+    """ISSUE 18 leak tripwire (mirrors the slab/scheduler checks): every
+    out-of-core partition catalog entry (kind="partition") registered
+    during the session must be unregistered by session end — success,
+    failure, deadline expiry, and chaos paths all release them
+    (OutOfCorePlan._release). A surviving entry is leaked spill bytes
+    plus a stale checkpoint a later run could wrongly resume from. Lazy
+    sys.modules lookup: runs only when the suite touched memgov."""
+    yield
+    import sys as _sys
+
+    memgov_mod = _sys.modules.get("spark_rapids_jni_tpu.memgov")
+    if memgov_mod is not None and memgov_mod._catalog is not None:
+        entries, nbytes = memgov_mod._catalog.kind_stats("partition")
+        assert (entries, nbytes) == (0, 0), (
+            f"{entries} out-of-core partition catalog entrie(s) "
+            f"({nbytes} bytes) leaked past session teardown"
+        )
+
+
 # ---------------------------------------------------------------------------
 # premerge fast tier (VERDICT r3 item 9)
 # ---------------------------------------------------------------------------
