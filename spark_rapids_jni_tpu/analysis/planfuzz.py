@@ -600,13 +600,73 @@ def _t_optier(rng):
                  int(rng.integers(1, 30)))
 
 
+def _t_multijoin(rng):
+    """srjt-cbo (ISSUE 19): 3-5 dim star joined in the generator's
+    (arbitrary) order, with strategy hints drawn from the full
+    {None, True, False} tri-state, optionally extended by a fact ->
+    customer [-> customer_address] chain hop. This is every CBO rule's
+    habitat: ``cbo_reorder_joins`` (multi-dim inner star),
+    ``cbo_join_strategy`` (``bounded=None`` abstentions), and
+    ``cbo_build_side`` (the backwards-authored PK->FK variant probes
+    from the unique-keyed side into a 4x bigger build, so the commute
+    fires)."""
+    from ..plan import AggSpec, Aggregate, Filter, Join, Scan
+
+    if rng.random() < 0.3:
+        # backwards-authored: customer_address (unique ca_address_sk,
+        # <= 500 rows) probes into customer (2000 rows) — exactly the
+        # shape cbo_build_side exists to flip
+        y = Scan("customer_address")
+        if rng.random() < 0.6:
+            y = Filter(y, _int_pred(rng, "ca_zip5", 0, 300))
+        y = Join(y, Scan("customer"),
+                 on=(("ca_address_sk", "c_current_addr_sk"),))
+        how = str(rng.choice(_AGG_HOWS[:2]))  # int measure: sum/mean
+        return Aggregate(y, keys=("ca_zip5",),
+                         aggs=(AggSpec("c_customer_id", how, "a0"),
+                               AggSpec(None, "count_all", "cnt")))
+    x = Scan("store_sales")
+    ndims = int(rng.integers(3, 6))
+    picks = [int(i) for i in
+             rng.choice(len(_DIMS), size=min(ndims, len(_DIMS)),
+                        replace=False)]
+    payloads: List[str] = []
+    for di in picks:
+        tbl, fk, pk, cols = _DIMS[di]
+        right = Scan(tbl)
+        if rng.random() < 0.6:
+            right = Filter(right, _dim_pred(rng, cols))
+        hint = (None, True, False)[int(rng.integers(0, 3))]
+        x = Join(x, right, on=((fk, pk),), bounded=hint)
+        payloads += [c for c, _, _ in cols]
+    if rng.random() < 0.5:
+        x = Join(x, Scan("customer"),
+                 on=(("ss_customer_sk", "c_customer_sk"),))
+        payloads += ["c_current_addr_sk", "c_customer_id"]
+        if rng.random() < 0.5:
+            # snowflake hop: probe key is customer payload, not a fact
+            # column — the reorder rule must leave this chain alone
+            x = Join(x, Scan("customer_address"),
+                     on=(("c_current_addr_sk", "ca_address_sk"),))
+            payloads += ["ca_zip5"]
+    nkeys = int(rng.integers(1, 3))
+    keypool = list(_FACT_KEYS) + payloads
+    keys = tuple(str(k) for k in
+                 rng.choice(keypool, size=nkeys, replace=False))
+    m = str(rng.choice(_MEASURES))
+    aggs = (AggSpec(m, str(rng.choice(_AGG_HOWS)), "a0"),
+            AggSpec(None, "count_all", "cnt"))
+    return Aggregate(x, keys=keys, aggs=aggs)
+
+
 _TEMPLATES = (
-    ("star", _t_star, 0.40),
+    ("star", _t_star, 0.30),
     ("corr", _t_corr, 0.12),
     ("setop", _t_setop, 0.12),
     ("exists", _t_exists, 0.12),
     ("union", _t_union, 0.14),
     ("optier", _t_optier, 0.10),
+    ("multijoin", _t_multijoin, 0.10),
 )
 
 

@@ -37,7 +37,8 @@ from .tpcds import _f64_col, _int_col, gen_store_wide, gen_web
 
 __all__ = [
     "gen_store_returns", "gen_catalog", "gen_channels",
-    "q1", "q13", "q20", "q26", "q27", "q38", "q43", "q48", "q65", "q69",
+    "q1", "q8", "q9", "q10", "q13", "q15", "q20", "q26", "q27", "q28",
+    "q30", "q32", "q34", "q35", "q38", "q39", "q43", "q48", "q65", "q69",
     "q73", "q87", "q88", "q92", "q96", "q3_plan", "q55_plan",
     "PLAN_QUERIES", "PlanQueryDef",
 ]
@@ -181,7 +182,7 @@ def gen_channels(num_rows: int, seed: int = 29) -> Dict[str, Table]:
             [cust_col, date_col],
         )
 
-    return {
+    tables = {
         "date_dim": date_dim,
         "customer": customer,
         "customer_address": customer_address,
@@ -190,6 +191,15 @@ def gen_channels(num_rows: int, seed: int = 29) -> Dict[str, Table]:
         "web_sales": fact("ws_bill_customer_sk", "ws_sold_date_sk", max(num_rows // 2, 1)),
         "catalog_sales": fact("cs_ship_customer_sk", "cs_sold_date_sk", max(num_rows // 2, 1)),
     }
+    # srjt-cbo (ISSUE 19) extension — the q35 dependent-count lane is
+    # drawn AFTER every pre-existing draw (the gen_store_wide append
+    # pattern), so the original columns' random sequences are untouched.
+    tables["customer_demographics"] = Table(
+        list(customer_demographics.columns)
+        + [_int_col(rng.integers(0, 10, n_cdemo))],  # cd_dep_count
+        list(customer_demographics.names) + ["cd_dep_count"],
+    )
+    return tables
 
 
 # ---------------------------------------------------------------------------
@@ -829,6 +839,464 @@ def q65(tables: Dict[str, Table], lo: int = 400, hi: int = 1100,
 
 
 # ---------------------------------------------------------------------------
+# srjt-cbo (ISSUE 19) mass-green campaign — ten more lowers go green
+# through the compiler; the multi-join chains among them (q8/q15/q30/
+# q34/q35) double as checked-in exercise for the cost-based join
+# enumeration (cbo_reorder_joins / cbo_build_side / cbo_join_strategy).
+# ---------------------------------------------------------------------------
+
+
+def q9_plan(thresholds=(2100, 2100, 2100, 2100, 1800)) -> P.Node:
+    """TPC-DS q9 — the bucketed CASE report: five quantity bands over
+    store_sales alone; each band's output column picks one of two
+    global averages depending on the band's row count. SQL shape (per
+    bucket)::
+
+        SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                          WHERE ss_quantity BETWEEN :lo AND :hi) > :t
+                    THEN (SELECT avg(ss_ext_sales_price) ...)
+                    ELSE (SELECT avg(ss_coupon_amt) ...) END bucket_n
+
+    Each bucket is one fused global aggregate; the CASE is a projection
+    over the aggregate's (cnt, avg, avg) row; buckets UNION ALL into a
+    (bucket, val) report."""
+    branches = []
+    for i, th in enumerate(thresholds):
+        lo, hi = 1 + 20 * i, 20 + 20 * i
+        band = (P.pcol("ss_quantity") >= P.plit(lo)) & (P.pcol("ss_quantity") <= P.plit(hi))
+        agg = P.Aggregate(
+            P.Filter(P.Scan("store_sales"), band), keys=(),
+            aggs=(
+                P.AggSpec(None, "count_all", "cnt"),
+                P.AggSpec("ss_ext_sales_price", "mean", "avg_ext"),
+                P.AggSpec("ss_coupon_amt", "mean", "avg_coup"),
+            ),
+        )
+        branches.append(P.Project(agg, (
+            ("bucket", P.plit(np.int32(i))),
+            ("val", P.pwhen(P.pcol("cnt") > P.plit(th),
+                            P.pcol("avg_ext"), P.pcol("avg_coup"))),
+        )))
+    return P.UnionAll(tuple(branches))
+
+
+def q9(tables: Dict[str, Table], thresholds=(2100, 2100, 2100, 2100, 1800)) -> Table:
+    return _run(q9_plan(thresholds), tables, "q9")
+
+
+def q28_plan() -> P.Node:
+    """TPC-DS q28 — six band aggregates over store_sales alone:
+    per quantity band (with OR'ed list-price/coupon side bands),
+    avg / count / count(DISTINCT) of ss_list_price, UNION ALLed. SQL
+    shape (per band)::
+
+        SELECT avg(ss_list_price), count(ss_list_price),
+               count(DISTINCT ss_list_price)
+        FROM store_sales
+        WHERE ss_quantity BETWEEN :lo AND :hi
+          AND (ss_list_price BETWEEN :a AND :b
+               OR ss_coupon_amt BETWEEN :c AND :d)
+    """
+    branches = []
+    for i in range(6):
+        qlo, qhi = 1 + 16 * i, 16 + 16 * i
+        pred = ((P.pcol("ss_quantity") >= P.plit(qlo))
+                & (P.pcol("ss_quantity") <= P.plit(qhi))
+                & (((P.pcol("ss_list_price") >= P.plit(20.0 + 10 * i))
+                    & (P.pcol("ss_list_price") <= P.plit(120.0 + 10 * i)))
+                   | ((P.pcol("ss_coupon_amt") >= P.plit(5.0 * i))
+                      & (P.pcol("ss_coupon_amt") <= P.plit(20.0 + 5.0 * i)))))
+        agg = P.Aggregate(
+            P.Filter(P.Scan("store_sales"), pred), keys=(),
+            aggs=(
+                P.AggSpec("ss_list_price", "mean", "avg_lp"),
+                P.AggSpec("ss_list_price", "count", "cnt_lp"),
+                P.AggSpec("ss_list_price", "nunique", "uniq_lp"),
+            ),
+        )
+        branches.append(P.Project(agg, (
+            ("band", P.plit(np.int32(i))),
+            ("avg_lp", P.pcol("avg_lp")),
+            ("cnt_lp", P.pcol("cnt_lp")),
+            ("uniq_lp", P.pcol("uniq_lp")),
+        )))
+    return P.UnionAll(tuple(branches))
+
+
+def q28(tables: Dict[str, Table]) -> Table:
+    return _run(q28_plan(), tables, "q28")
+
+
+def q15_plan(year: int = 2000, moy_lo: int = 1, moy_hi: int = 3,
+             price: float = 120.0) -> P.Node:
+    """TPC-DS q15 — the zip-band catalog star on the store channel:
+    fact -> customer -> customer_address chain plus the date dim, kept
+    rows are (zip band) OR (big ticket), revenue grouped by zip. The
+    customer/address hops form a DEPENDENT join chain (the address key
+    only exists after the customer join) — the enumeration's schema
+    guard must keep that order while still reordering the independent
+    date dim. SQL shape::
+
+        SELECT ca_zip, sum(cs_sales_price)
+        FROM catalog_sales, customer, customer_address, date_dim
+        WHERE cs_bill_customer_sk = c_customer_sk
+          AND c_current_addr_sk = ca_address_sk
+          AND (substr(ca_zip,1,5) IN (...) OR cs_sales_price > 500)
+          AND cs_sold_date_sk = d_date_sk AND d_qoy = :q AND d_year = :y
+        GROUP BY ca_zip ORDER BY ca_zip
+    """
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"),
+                           (P.pcol("d_year") == P.plit(year))
+                           & (P.pcol("d_moy") >= P.plit(moy_lo))
+                           & (P.pcol("d_moy") <= P.plit(moy_hi))),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer"), on=(("ss_customer_sk", "c_customer_sk"),),
+               bounded=True)
+    x = P.Join(x, P.Scan("customer_address"),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    zips = ((P.pcol("ca_zip5") < P.plit(40))
+            | ((P.pcol("ca_zip5") >= P.plit(120)) & (P.pcol("ca_zip5") < P.plit(160)))
+            | (P.pcol("ca_zip5") >= P.plit(260)))
+    x = P.Filter(x, zips | (P.pcol("ss_sales_price") >= P.plit(price)))
+    agg = P.Aggregate(x, keys=("ca_zip5",),
+                      aggs=(P.AggSpec("ss_sales_price", "sum", "sum_sales"),))
+    return P.Sort(agg, (("ca_zip5", True),))
+
+
+def q15(tables: Dict[str, Table], year: int = 2000, moy_lo: int = 1,
+        moy_hi: int = 3, price: float = 120.0) -> Table:
+    return _run(q15_plan(year, moy_lo, moy_hi, price), tables, "q15")
+
+
+def q8_plan(year: int = 2000, moy_lo: int = 10, moy_hi: int = 12,
+            id_cut: int = 400) -> P.Node:
+    """TPC-DS q8 — store revenue restricted to zip prefixes in the
+    INTERSECT of a literal zip band and the zips of preferred
+    customers; the set op lowers to a semi-join on deduped keys, and
+    the store restriction is itself an EXISTS (semi-join) against that
+    set. SQL shape::
+
+        SELECT s_store_name, sum(ss_net_profit)
+        FROM store_sales, date_dim, store,
+          (SELECT zip FROM (zip_list INTERSECT
+            SELECT ca_zip FROM customer_address, customer
+            WHERE ca_address_sk = c_current_addr_sk
+              AND c_preferred_cust_flag = 'Y' ...)) v
+        WHERE ss_store_sk = s_store_sk AND d_qoy = ..
+          AND substr(s_zip,1,2) = substr(v.zip,1,2)
+        GROUP BY s_store_name
+
+    ``c_customer_id < :cut`` stands in for the preferred flag, as
+    dictionary codes do everywhere in this tier."""
+    band = ((P.pcol("ca_zip5") < P.plit(30))
+            | ((P.pcol("ca_zip5") >= P.plit(100)) & (P.pcol("ca_zip5") < P.plit(130)))
+            | (P.pcol("ca_zip5") >= P.plit(270)))
+    a1 = P.Project(P.Filter(P.Scan("customer_address"), band),
+                   (("zip5", P.pcol("ca_zip5")),))
+    pref = P.Join(P.Filter(P.Scan("customer"),
+                           P.pcol("c_customer_id") < P.plit(id_cut)),
+                  P.Scan("customer_address"),
+                  on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    a2 = P.Project(pref, (("zip5", P.pcol("ca_zip5")),))
+    zips = P.SetOp(a1, a2, "intersect")
+    stores = P.Exists(P.Scan("store"), zips, on=(("s_zip5", "zip5"),))
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"),
+                           (P.pcol("d_year") == P.plit(year))
+                           & (P.pcol("d_moy") >= P.plit(moy_lo))
+                           & (P.pcol("d_moy") <= P.plit(moy_hi))),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(x, stores, on=(("ss_store_sk", "s_store_sk"),), bounded=True)
+    agg = P.Aggregate(x, keys=("ss_store_sk",),
+                      aggs=(P.AggSpec("ss_ext_sales_price", "sum", "net"),))
+    return P.Sort(agg, (("ss_store_sk", True),))
+
+
+def q8(tables: Dict[str, Table], year: int = 2000, moy_lo: int = 10,
+       moy_hi: int = 12, id_cut: int = 400) -> Table:
+    return _run(q8_plan(year, moy_lo, moy_hi, id_cut), tables, "q8")
+
+
+def q34_plan(year: int = 2000, moy_lo: int = 4, moy_hi: int = 6,
+             buys=(0, 3), lo: int = 1, hi: int = 3) -> P.Node:
+    """TPC-DS q34 — q73's wider HAVING band: per-(ticket, customer)
+    item counts in a count band, demographic filter includes the
+    vehicle lane, join-back to customer. SQL shape::
+
+        SELECT c_customer_id, cnt FROM (
+          SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+          FROM store_sales, date_dim, household_demographics
+          WHERE ss_sold_date_sk = d_date_sk AND ss_hdemo_sk = hd_demo_sk
+            AND d_year = :y AND d_moy BETWEEN :l AND :h
+            AND hd_buy_potential IN (:b1, :b2) AND hd_vehicle_count > 0
+          GROUP BY ss_ticket_number, ss_customer_sk) dj, customer
+        WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN :lo AND :hi
+        ORDER BY cnt DESC, c_customer_id
+    """
+    x = P.Scan("store_sales")
+    x = P.Join(x, P.Filter(P.Scan("date_dim"),
+                           (P.pcol("d_year") == P.plit(year))
+                           & (P.pcol("d_moy") >= P.plit(moy_lo))
+                           & (P.pcol("d_moy") <= P.plit(moy_hi))),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    x = P.Join(
+        x,
+        P.Filter(P.Scan("household_demographics"),
+                 ((P.pcol("hd_buy_potential") == P.plit(buys[0]))
+                  | (P.pcol("hd_buy_potential") == P.plit(buys[1])))
+                 & (P.pcol("hd_vehicle_count") > P.plit(0))),
+        on=(("ss_hdemo_sk", "hd_demo_sk"),), bounded=True,
+    )
+    agg = P.Aggregate(x, keys=("ss_ticket_number", "ss_customer_sk"),
+                      aggs=(P.AggSpec(None, "count_all", "cnt"),))
+    hv = P.Having(agg, (P.pcol("cnt") >= P.plit(lo)) & (P.pcol("cnt") <= P.plit(hi)))
+    j = P.Join(hv, P.Scan("customer"), on=(("ss_customer_sk", "c_customer_sk"),))
+    proj = P.Project(j, (("c_customer_id", P.pcol("c_customer_id")),
+                         ("cnt", P.pcol("cnt"))))
+    return P.Sort(proj, (("cnt", False), ("c_customer_id", True)))
+
+
+def q34(tables: Dict[str, Table], year: int = 2000, moy_lo: int = 4,
+        moy_hi: int = 6, buys=(0, 3), lo: int = 1, hi: int = 3) -> Table:
+    return _run(q34_plan(year, moy_lo, moy_hi, buys, lo, hi), tables, "q34")
+
+
+def q39_plan(cov: float = 0.55) -> P.Node:
+    """TPC-DS q39 — the native stdev/mean shape: per-(store, month)
+    quantity mean and sample standard deviation, kept where the
+    coefficient of variation clears a bar (HAVING over agg outputs).
+    SQL shape::
+
+        SELECT w_warehouse_sk, d_moy, avg(inv_quantity_on_hand) mean,
+               stddev_samp(inv_quantity_on_hand) stdev
+        FROM inventory, date_dim, warehouse WHERE ...
+        GROUP BY w_warehouse_sk, d_moy
+        HAVING stdev / mean > 1.0
+
+    store_sales/store stand in for inventory/warehouse (same relational
+    shape; the var/std aggregates are the part under test)."""
+    x = P.Join(P.Scan("store_sales"), P.Scan("date_dim"),
+               on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True)
+    agg = P.Aggregate(
+        x, keys=("ss_store_sk", "d_moy"),
+        aggs=(
+            P.AggSpec("ss_quantity", "mean", "mean_q"),
+            P.AggSpec("ss_quantity", "std", "std_q"),
+        ),
+    )
+    hv = P.Having(agg, P.pcol("std_q") > P.pcol("mean_q") * P.plit(cov))
+    return P.Sort(hv, (("ss_store_sk", True), ("d_moy", True)))
+
+
+def q39(tables: Dict[str, Table], cov: float = 0.55) -> Table:
+    return _run(q39_plan(cov), tables, "q39")
+
+
+def q30_plan(year: int = 1999, factor: float = 1.2) -> P.Node:
+    """TPC-DS q30 — the STATE-level decorrelation (q1's shape one
+    grouping level up): per-(customer, state) return totals vs the
+    per-state average * 1.2. SQL shape::
+
+        WITH customer_total_return AS (
+          SELECT wr_returning_customer_sk ctr_customer_sk, ca_state,
+                 sum(wr_return_amt) ctr_total_return
+          FROM web_returns, date_dim, customer_address WHERE d_year = :y ...
+          GROUP BY wr_returning_customer_sk, ca_state)
+        SELECT c_customer_id, ... FROM customer_total_return ctr1, customer
+        WHERE ctr1.ctr_total_return >
+              (SELECT avg(ctr_total_return) * 1.2 FROM customer_total_return
+               ctr2 WHERE ctr1.ca_state = ctr2.ca_state)
+          AND ctr1.ctr_customer_sk = c_customer_sk
+        ORDER BY c_customer_id LIMIT 100
+
+    store_returns/store(s_state) stand in for web_returns/
+    customer_address(ca_state)."""
+    ctr = P.Aggregate(
+        P.Join(
+            P.Join(
+                P.Scan("store_returns"),
+                P.Filter(P.Scan("date_dim"), P.pcol("d_year") == P.plit(year)),
+                on=(("sr_returned_date_sk", "d_date_sk"),), bounded=True,
+            ),
+            P.Scan("store"),
+            on=(("sr_store_sk", "s_store_sk"),), bounded=True,
+        ),
+        keys=("sr_customer_sk", "s_state"),
+        aggs=(P.AggSpec("sr_return_amt", "sum", "ctr_total_return"),),
+    )
+    x = P.CorrelatedAggFilter(
+        ctr, ctr, on=("s_state", "s_state"),
+        agg=P.AggSpec("ctr_total_return", "mean", "ctr_avg"),
+        predicate=P.pcol("ctr_total_return") > P.pcol("ctr_avg") * P.plit(factor),
+    )
+    x = P.Join(x, P.Scan("customer"), on=(("sr_customer_sk", "c_customer_sk"),))
+    x = P.Project(x, (("c_customer_id", P.pcol("c_customer_id")),
+                      ("ctr_total_return", P.pcol("ctr_total_return"))))
+    # a customer can clear the bar in several states — the total is a
+    # deterministic tie-break for those duplicate ids
+    return P.Limit(P.Sort(x, (("c_customer_id", True),
+                              ("ctr_total_return", True))), 100)
+
+
+def q30(tables: Dict[str, Table], year: int = 1999, factor: float = 1.2) -> Table:
+    return _run(q30_plan(year, factor), tables, "q30")
+
+
+def q32_plan(category: int = 4, lo: int = 300, hi: int = 390,
+             factor: float = 1.3) -> P.Node:
+    """TPC-DS q32 — q92's catalog-channel twin (excess discount): sum
+    of discounts exceeding 1.3x the per-item average inside a date
+    window; the date-filtered fact is ONE shared node on both sides of
+    the correlation. SQL shape::
+
+        SELECT sum(cs_ext_discount_amt)
+        FROM catalog_sales, item, date_dim
+        WHERE i_manufact_id = :m AND i_item_sk = cs_item_sk
+          AND d_date_sk = cs_sold_date_sk AND d_date BETWEEN :lo AND :hi
+          AND cs_ext_discount_amt >
+              (SELECT 1.3 * avg(cs_ext_discount_amt) FROM catalog_sales,
+               date_dim WHERE cs_item_sk = i_item_sk AND ...)
+
+    cs_coupon_amt stands in for the discount lane; the category id
+    stands in for the manufacturer filter."""
+    dated = P.Join(
+        P.Scan("catalog_sales"),
+        P.Filter(P.Scan("date_dim"),
+                 (P.pcol("d_date_sk") >= P.plit(lo))
+                 & (P.pcol("d_date_sk") <= P.plit(hi))),
+        on=(("cs_sold_date_sk", "d_date_sk"),), bounded=True,
+    )
+    main = P.Join(
+        dated,
+        P.Filter(P.Scan("item"), P.pcol("i_category_id") == P.plit(category)),
+        on=(("cs_item_sk", "i_item_sk"),), bounded=True,
+    )
+    x = P.CorrelatedAggFilter(
+        main, dated, on=("cs_item_sk", "cs_item_sk"),
+        agg=P.AggSpec("cs_coupon_amt", "mean", "avg_disc"),
+        predicate=P.pcol("cs_coupon_amt") > P.plit(factor) * P.pcol("avg_disc"),
+    )
+    return P.Aggregate(x, keys=(),
+                       aggs=(P.AggSpec("cs_coupon_amt", "sum", "excess"),))
+
+
+def q32(tables: Dict[str, Table], category: int = 4, lo: int = 300,
+        hi: int = 390, factor: float = 1.3) -> Table:
+    return _run(q32_plan(category, lo, hi, factor), tables, "q32")
+
+
+def _any_channel_active(year: int, moy_lo: int, moy_hi: int) -> P.Node:
+    """Customer sks with web OR catalog activity in the window — the
+    OR of two EXISTS is one EXISTS over the UNION ALL of the
+    subqueries, which is how the q10/q35 family lowers."""
+    dates = P.Filter(P.Scan("date_dim"),
+                     (P.pcol("d_year") == P.plit(year))
+                     & (P.pcol("d_moy") >= P.plit(moy_lo))
+                     & (P.pcol("d_moy") <= P.plit(moy_hi)))
+    web = P.Join(P.Scan("web_sales"), dates,
+                 on=(("ws_sold_date_sk", "d_date_sk"),), bounded=True)
+    cat = P.Join(P.Scan("catalog_sales"), dates,
+                 on=(("cs_sold_date_sk", "d_date_sk"),), bounded=True)
+    return P.UnionAll((
+        P.Project(web, (("any_customer_sk", P.pcol("ws_bill_customer_sk")),)),
+        P.Project(cat, (("any_customer_sk", P.pcol("cs_ship_customer_sk")),)),
+    ))
+
+
+def q10_plan(states=(1, 4, 7), year: int = 1999, moy_lo: int = 1,
+             moy_hi: int = 4) -> P.Node:
+    """TPC-DS q10 — demographic counts of in-county customers with
+    store activity AND (web OR catalog) activity in the window: the OR
+    of EXISTS lowers as one EXISTS over a UNION ALL, then both EXISTS
+    become semi-joins. SQL shape::
+
+        SELECT cd_gender, cd_marital_status, cd_education_status, count(*)
+        FROM customer c, customer_address ca, customer_demographics
+        WHERE c_current_addr_sk = ca_address_sk AND ca_county IN (...)
+          AND cd_demo_sk = c_current_cdemo_sk
+          AND EXISTS (SELECT * FROM store_sales, date_dim WHERE ...)
+          AND (EXISTS (SELECT * FROM web_sales, date_dim WHERE ...)
+               OR EXISTS (SELECT * FROM catalog_sales, date_dim WHERE ...))
+        GROUP BY ... ORDER BY ...
+    """
+    in_states = None
+    for s in states:
+        e = P.pcol("ca_state") == P.plit(s)
+        in_states = e if in_states is None else (in_states | e)
+    dates = P.Filter(P.Scan("date_dim"),
+                     (P.pcol("d_year") == P.plit(year))
+                     & (P.pcol("d_moy") >= P.plit(moy_lo))
+                     & (P.pcol("d_moy") <= P.plit(moy_hi)))
+    x = P.Join(P.Scan("customer"),
+               P.Filter(P.Scan("customer_address"), in_states),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer_demographics"),
+               on=(("c_current_cdemo_sk", "cd_demo_sk"),), bounded=True)
+    x = P.Exists(x, P.Join(P.Scan("store_sales"), dates,
+                           on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True),
+                 on=(("c_customer_sk", "ss_customer_sk"),))
+    x = P.Exists(x, _any_channel_active(year, moy_lo, moy_hi),
+                 on=(("c_customer_sk", "any_customer_sk"),))
+    agg = P.Aggregate(
+        x, keys=("cd_gender", "cd_marital_status", "cd_education_status"),
+        aggs=(P.AggSpec(None, "count_all", "cnt"),),
+    )
+    return P.Sort(agg, (("cd_gender", True), ("cd_marital_status", True),
+                        ("cd_education_status", True)))
+
+
+def q10(tables: Dict[str, Table], states=(1, 4, 7), year: int = 1999,
+        moy_lo: int = 1, moy_hi: int = 4) -> Table:
+    return _run(q10_plan(states, year, moy_lo, moy_hi), tables, "q10")
+
+
+def q35_plan(year: int = 1999, moy_lo: int = 1, moy_hi: int = 6) -> P.Node:
+    """TPC-DS q35 — q10's reporting sibling: state-level demographic
+    stats (count plus max/sum/avg of the dependent count) over the same
+    EXISTS-store AND (EXISTS-web OR EXISTS-catalog) population. SQL
+    shape::
+
+        SELECT ca_state, cd_gender, cd_marital_status, count(*),
+               max(cd_dep_count), sum(cd_dep_count), avg(cd_dep_count)
+        FROM customer c, customer_address ca, customer_demographics
+        WHERE c_current_addr_sk = ca_address_sk
+          AND cd_demo_sk = c_current_cdemo_sk
+          AND EXISTS (...store...) AND (EXISTS (...web...) OR EXISTS (...catalog...))
+        GROUP BY ca_state, cd_gender, cd_marital_status ORDER BY ...
+    """
+    dates = P.Filter(P.Scan("date_dim"),
+                     (P.pcol("d_year") == P.plit(year))
+                     & (P.pcol("d_moy") >= P.plit(moy_lo))
+                     & (P.pcol("d_moy") <= P.plit(moy_hi)))
+    x = P.Join(P.Scan("customer"), P.Scan("customer_address"),
+               on=(("c_current_addr_sk", "ca_address_sk"),), bounded=True)
+    x = P.Join(x, P.Scan("customer_demographics"),
+               on=(("c_current_cdemo_sk", "cd_demo_sk"),), bounded=True)
+    x = P.Exists(x, P.Join(P.Scan("store_sales"), dates,
+                           on=(("ss_sold_date_sk", "d_date_sk"),), bounded=True),
+                 on=(("c_customer_sk", "ss_customer_sk"),))
+    x = P.Exists(x, _any_channel_active(year, moy_lo, moy_hi),
+                 on=(("c_customer_sk", "any_customer_sk"),))
+    agg = P.Aggregate(
+        x, keys=("ca_state", "cd_gender", "cd_marital_status"),
+        aggs=(
+            P.AggSpec(None, "count_all", "cnt"),
+            P.AggSpec("cd_dep_count", "max", "max_dep"),
+            P.AggSpec("cd_dep_count", "sum", "sum_dep"),
+            P.AggSpec("cd_dep_count", "mean", "avg_dep"),
+        ),
+    )
+    return P.Sort(agg, (("ca_state", True), ("cd_gender", True),
+                        ("cd_marital_status", True)))
+
+
+def q35(tables: Dict[str, Table], year: int = 1999, moy_lo: int = 1,
+        moy_hi: int = 6) -> Table:
+    return _run(q35_plan(year, moy_lo, moy_hi), tables, "q35")
+
+
+# ---------------------------------------------------------------------------
 # hand-built greens re-expressed as plans (bit-identity contract)
 # ---------------------------------------------------------------------------
 
@@ -886,16 +1354,36 @@ PLAN_QUERIES: Dict[str, PlanQueryDef] = {
     for d in (
         PlanQueryDef("q1", lambda n, s=21: gen_store_returns(n, seed=s),
                      q1_plan, q1, 8000),
+        PlanQueryDef("q8", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q8_plan, q8, 10000),
+        PlanQueryDef("q9", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q9_plan, q9, 10000),
+        PlanQueryDef("q10", lambda n, s=29: gen_channels(n, seed=s),
+                     q10_plan, q10, 6000),
         PlanQueryDef("q13", lambda n, s=42: gen_store_wide(n, seed=s),
                      q13_plan, q13, 10000),
+        PlanQueryDef("q15", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q15_plan, q15, 10000),
         PlanQueryDef("q20", lambda n, s=23: gen_catalog(n, seed=s),
                      q20_plan, q20, 10000),
         PlanQueryDef("q26", lambda n, s=23: gen_catalog(n, seed=s),
                      q26_plan, q26, 10000),
         PlanQueryDef("q27", lambda n, s=42: gen_store_wide(n, seed=s),
                      q27_plan, q27, 10000),
+        PlanQueryDef("q28", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q28_plan, q28, 10000),
+        PlanQueryDef("q30", lambda n, s=21: gen_store_returns(n, seed=s),
+                     q30_plan, q30, 8000),
+        PlanQueryDef("q32", lambda n, s=23: gen_catalog(n, seed=s),
+                     q32_plan, q32, 10000),
+        PlanQueryDef("q34", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q34_plan, q34, 10000),
+        PlanQueryDef("q35", lambda n, s=29: gen_channels(n, seed=s),
+                     q35_plan, q35, 6000),
         PlanQueryDef("q38", lambda n, s=29: gen_channels(n, seed=s),
                      q38_plan, q38, 6000),
+        PlanQueryDef("q39", lambda n, s=42: gen_store_wide(n, seed=s),
+                     q39_plan, q39, 10000),
         PlanQueryDef("q43", lambda n, s=42: gen_store_wide(n, seed=s),
                      q43_plan, q43, 10000),
         PlanQueryDef("q48", lambda n, s=42: gen_store_wide(n, seed=s),

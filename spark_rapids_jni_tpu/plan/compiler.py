@@ -60,6 +60,7 @@ from .nodes import (
     infer_schema,
 )
 from .rewrites import rewrite
+from .stats.model import calibration_factor
 
 
 def _durable(name: str):
@@ -182,7 +183,13 @@ class _Exec:
         self.schema = schema
         self.est_rows = max(int(est_rows), 1)
         self.inputs = inputs
-        self.est_bytes = self.est_rows * _width(schema)
+        # srjt-cbo (ISSUE 19): byte estimates carry the per-kind factor
+        # learned from archived estimate-vs-actual reports (neutral 1.0
+        # on a fresh checkout, clamped to [0.5, 2x]); the floor keeps
+        # the verifier's est_bytes >= est_rows invariant under any factor
+        self.est_bytes = max(self.est_rows,
+                             int(self.est_rows * _width(schema)
+                                 * calibration_factor(self.kind)))
 
     def run(self, ctx: _RunContext) -> Table:
         key = id(self)
@@ -230,9 +237,11 @@ class _ScanExec(_Exec):
 class _FilterExec(_Exec):
     kind = "filter"
 
-    def __init__(self, node: Filter, schema: Schema, child: _Exec):
-        super().__init__(schema, math.ceil(child.est_rows * _FILTER_SELECTIVITY),
-                         [child])
+    def __init__(self, node: Filter, schema: Schema, child: _Exec,
+                 est_rows: Optional[int] = None):
+        if est_rows is None:
+            est_rows = math.ceil(child.est_rows * _FILTER_SELECTIVITY)
+        super().__init__(schema, min(est_rows, child.est_rows), [child])
         self.pred = node.predicate
 
     def _run(self, ctx):
@@ -259,10 +268,12 @@ class _ProjectExec(_Exec):
 class _JoinExec(_Exec):
     kind = "join"
 
-    def __init__(self, node: Join, schema: Schema, left: _Exec, right: _Exec):
-        rows = (left.est_rows + right.est_rows if node.how == "full"
-                else left.est_rows)
-        super().__init__(schema, rows, [left, right])
+    def __init__(self, node: Join, schema: Schema, left: _Exec, right: _Exec,
+                 est_rows: Optional[int] = None):
+        if est_rows is None:
+            est_rows = (left.est_rows + right.est_rows if node.how == "full"
+                        else left.est_rows)
+        super().__init__(schema, est_rows, [left, right])
         self.on = node.on
         self.how = node.how
 
@@ -637,6 +648,11 @@ class _Fuser:
         out_names = list(out_schema.keys())
         est_rows = min(self.low.exec_of(self.fact).est_rows,
                        domain_product if gks else 1)
+        if self.low.est is not None and gks:
+            # sketch ndv product is usually tighter than the dense
+            # key-domain product (domains count holes, ndv does not)
+            est_rows = min(est_rows, self.low.est.agg_rows(
+                self.low.exec_of(self.fact).est_rows, agg.keys))
         pipeline = compile_plan(spec)
         fact_exec = self.low.exec_of(self.fact)
         _durable("plan.fused_stages").inc()
@@ -715,9 +731,13 @@ class _Fuser:
 
 
 class _Lowerer:
-    def __init__(self, tables: Dict[str, Table], catalog: Dict[str, Schema]):
+    def __init__(self, tables: Dict[str, Table], catalog: Dict[str, Schema],
+                 est=None):
         self.tables = tables
         self.catalog = catalog
+        # srjt-cbo (ISSUE 19): sketch-backed stats.Estimator, or None —
+        # stages then keep the original hand-tuned row heuristics
+        self.est = est
         self._schemas: dict = {}
         self._execs: Dict[int, _Exec] = {}
         self.all_execs: List[_Exec] = []
@@ -743,19 +763,29 @@ class _Lowerer:
         if isinstance(node, Scan):
             return _ScanExec(node, schema, self.tables)
         if isinstance(node, Filter):
-            return _FilterExec(node, schema, self.lower(node.input))
+            child = self.lower(node.input)
+            rows = (self.est.filter_rows(child.est_rows, node.predicate)
+                    if self.est is not None else None)
+            return _FilterExec(node, schema, child, est_rows=rows)
         if isinstance(node, Project):
             return _ProjectExec(node, schema, self.lower(node.input))
         if isinstance(node, Join):
-            return _JoinExec(node, schema, self.lower(node.left),
-                             self.lower(node.right))
+            left = self.lower(node.left)
+            right = self.lower(node.right)
+            rows = (self.est.join_rows(node.how, left.est_rows,
+                                       right.est_rows, node.on)
+                    if self.est is not None else None)
+            return _JoinExec(node, schema, left, right, est_rows=rows)
         if isinstance(node, Aggregate):
             fused = _Fuser(self, node).try_build()
             if fused is not None:
                 self.all_execs.append(fused)
                 return fused
             _durable("plan.ops_stages").inc()
-            return _AggExec(node, schema, self.lower(node.input))
+            child = self.lower(node.input)
+            rows = (self.est.agg_rows(child.est_rows, node.keys)
+                    if self.est is not None else None)
+            return _AggExec(node, schema, child, est_rows=rows)
         if isinstance(node, Exchange):
             return _ExchangeExec(node, schema, self.lower(node.input))
         if isinstance(node, Window):
@@ -801,7 +831,8 @@ class CompiledPlan:
                  stages: List[_Exec], raw_nodes: int, opt_nodes: int,
                  rewrites_fired: Dict[str, int], opt_plan: Node,
                  obligations: Optional[list] = None,
-                 node_execs: Optional[Dict[int, _Exec]] = None):
+                 node_execs: Optional[Dict[int, _Exec]] = None,
+                 modeled: Optional[dict] = None):
         self.name = name
         self.schema = dict(root.schema)
         self.optimized = opt_plan
@@ -821,6 +852,10 @@ class CompiledPlan:
         # plan is ever run concurrently.
         self._node_execs = dict(node_execs or {})
         self.subcache = None
+        # srjt-cbo (ISSUE 19): {"author": cost, "chosen": cost,
+        # "joins": n} when the search ran — the premerge modeled-cost
+        # gate's source; None on the cache-hit / CBO-off paths
+        self.modeled = dict(modeled) if modeled else None
         self.estimated_memory_bytes = max(
             s.working_set_est() for s in stages
         )
@@ -898,6 +933,12 @@ class CompiledPlan:
             "actual_peak_bytes": actual_peak,
             "peak_blowup": (actual_peak / est_peak) if est_peak else None,
             "memgov_admitted_bytes": admitted,
+            "modeled_cost_author": (
+                None if self.modeled is None else self.modeled["author"]),
+            "modeled_cost_chosen": (
+                None if self.modeled is None else self.modeled["chosen"]),
+            "join_count": (
+                None if self.modeled is None else self.modeled["joins"]),
         }
 
 
@@ -912,13 +953,32 @@ def compile_ir(plan: Node, tables: Dict[str, Table],
     raw_nodes = _count_nodes(plan)
     infer_schema(plan, catalog)
     res = rewrite(plan, catalog)
-    for rule, n in res.fired.items():
+    # srjt-cbo (ISSUE 19): the cost-based search runs AFTER the default
+    # rewrite (so rule-idempotence of the default set is undisturbed);
+    # every reorder / build-side / strategy fire lands in the same
+    # obligation ledger the verifier discharges
+    from . import optimizer as _cbo
+    from . import stats as _stats
+
+    opt_plan, fired, obligations = res.plan, dict(res.fired), list(res.obligations)
+    modeled = None
+    est = _stats.make_estimator(tables)
+    if _cbo.enabled() and est is not None:
+        cres = _cbo.optimize(opt_plan, catalog, tables, est=est)
+        opt_plan = cres.plan
+        for rule, n in cres.fired.items():
+            fired[rule] = fired.get(rule, 0) + n
+        obligations.extend(cres.obligations)
+        modeled = {"author": cres.author_cost, "chosen": cres.chosen_cost,
+                   "joins": cres.join_count}
+    for rule, n in fired.items():
         _durable(f"plan.rewrites.{rule}").inc(n)
-    low = _Lowerer(tables, catalog)
-    root = low.lower(res.plan)
+    low = _Lowerer(tables, catalog, est=est)
+    root = low.lower(opt_plan)
     cp = CompiledPlan(name, root, tables, low.all_execs, raw_nodes,
-                      _count_nodes(res.plan), res.fired, res.plan,
-                      obligations=res.obligations, node_execs=low._execs)
+                      _count_nodes(opt_plan), fired, opt_plan,
+                      obligations=obligations, node_execs=low._execs,
+                      modeled=modeled)
     # srjt-ooc (ISSUE 18): a plan whose peak exceeds the armed device
     # budget degrades to streamed partitioned execution instead of
     # split-retrying to failure; a no-op unless SRJT_OOC_ENABLED
@@ -943,7 +1003,12 @@ def lower_ir(opt_plan: Node, tables: Dict[str, Table], name: str = "plan", *,
                for t, tbl in tables.items()}
     infer_schema(opt_plan, catalog)
     opt_nodes = _count_nodes(opt_plan)
-    low = _Lowerer(tables, catalog)
+    # srjt-cbo (ISSUE 19): the cache-hit path skips the SEARCH (the
+    # cached structure already won it) but keeps sketch-driven row
+    # estimates — admission numbers must not depend on cache hit/miss
+    from . import stats as _stats
+
+    low = _Lowerer(tables, catalog, est=_stats.make_estimator(tables))
     root = low.lower(opt_plan)
     _durable("plan.lower_only").inc()
     cp = CompiledPlan(name, root, tables, low.all_execs,

@@ -113,14 +113,18 @@ class Join(Node):
     """Equi-join on ``on = ((left_col, right_col), ...)`` pairs.
     ``bounded=True`` hints the fused tier to lower a single-int-key
     inner/semi/anti join through the dense bounded-domain map (domain
-    scanned from the build table at bind time); the default lowers
-    sort-merge. The hint never changes semantics, only the kernel."""
+    scanned from the build table at bind time); ``False`` (the
+    default) lowers sort-merge; ``None`` means "author abstains" and
+    lets the cost-based optimizer resolve the strategy from the build
+    key's sketch (``cbo_join_strategy`` — falsy, so an unresolved
+    ``None`` still lowers sort-merge). The hint never changes
+    semantics, only the kernel."""
 
     left: Node
     right: Node
     on: Tuple[Tuple[str, str], ...]
     how: str = "inner"
-    bounded: bool = False
+    bounded: Optional[bool] = False
 
     def __post_init__(self):
         if self.how not in _JOIN_HOWS:

@@ -191,7 +191,16 @@ def maybe_out_of_core(cp, tables: Dict):
         return cp
     parts = knobs.get_int("SRJT_OOC_PARTITIONS") or 0
     if parts < 2:
-        parts = _auto_partitions(cp.estimated_memory_bytes, budget)
+        # srjt-cbo (ISSUE 19): K comes from the cost model (calibrated
+        # per-partition peak vs half the budget) — the knob is now an
+        # explicit OVERRIDE, not the primary source; the uncalibrated
+        # ladder remains the fallback when even max_parts cannot fit
+        from .stats.model import choose_ooc_partitions
+
+        parts = choose_ooc_partitions(
+            cp.estimated_memory_bytes, budget,
+            max_parts=_MAX_AUTO_PARTITIONS,
+        ) or _auto_partitions(cp.estimated_memory_bytes, budget)
     union = partition_rewrite(target.agg, parts)
     catalog = {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
                for t, tbl in tables.items()}

@@ -852,6 +852,124 @@ def _d_partition_ooc(ob, catalog) -> List[str]:
     return msgs
 
 
+def _chain_sig(joins) -> Counter:
+    """Multiset signature of a join chain: one entry per chain member
+    carrying its key pairs, build-subtree fingerprint, join kind, and
+    strategy hint — exactly what a pure REORDER must preserve."""
+    from .rewrites import fingerprint
+    return Counter((j.how, j.on, fingerprint(j.right), j.bounded)
+                   for j in joins)
+
+
+def _d_cbo_reorder(ob, catalog) -> List[str]:
+    """srjt-cbo (ISSUE 19): a join-order enumeration fire. The after-
+    subtree must be a passthrough Project (restoring the witnessed
+    column order — checked by the common schema discharge) over a
+    rebuilt chain of the SAME inner joins: same base, and the multiset
+    of (how, on-pairs, build fingerprint, bounded) chain members
+    preserved. Only inner joins may move (outer-join legality): the
+    chain walk itself admits nothing else, so a reorder that absorbed
+    a left/semi/anti join shows up as a base-fingerprint mismatch."""
+    from .optimizer import collect_chain, is_passthrough_project
+    from .rewrites import fingerprint
+    b, a = ob.before, ob.after
+    if not (isinstance(b, Join) and b.how == "inner"):
+        return ["before-subtree is not an inner Join chain head"]
+    if not is_passthrough_project(a):
+        return ["after-subtree is not a passthrough column-restoring "
+                "Project"]
+    b_base, b_joins = collect_chain(b, catalog)
+    a_base, a_joins = collect_chain(a.input, catalog)
+    msgs: List[str] = []
+    if len(b_joins) < 2:
+        msgs.append("reorder fired on a chain of fewer than 2 joins")
+    if fingerprint(b_base) != fingerprint(a_base):
+        msgs.append("chain base changed across the reorder (a non-inner "
+                    "join or the fact subtree was restructured)")
+    if _chain_sig(b_joins) != _chain_sig(a_joins):
+        msgs.append("join-predicate multiset not preserved: a chain "
+                    "member's keys, build side, kind, or strategy hint "
+                    "was dropped, duplicated, or invented")
+    return msgs
+
+
+def _d_cbo_build_side(ob, catalog) -> List[str]:
+    """srjt-cbo (ISSUE 19): a build/probe commute. after must be
+    Project(Join(right, left, on-swapped)) with the Project renaming
+    the surviving right key back to the dropped left key's name — legal
+    only for INNER joins with exactly-matching key dtypes (equi-join
+    output has the pair equal row-for-row, so the rename is the
+    identity on every surviving row)."""
+    from .optimizer import is_passthrough_project  # noqa: F401 (shape doc)
+    from .rewrites import fingerprint
+    b, a = ob.before, ob.after
+    if not (isinstance(b, Join) and b.how == "inner"):
+        return ["before-subtree is not an inner Join"]
+    if not (isinstance(a, Project) and isinstance(a.input, Join)):
+        return ["after-subtree is not Project(Join(...))"]
+    aj = a.input
+    msgs: List[str] = []
+    if aj.how != "inner":
+        msgs.append("commuted join is not inner (outer-join commutes are "
+                    "illegal)")
+    if aj.on != tuple((r, l) for l, r in b.on):
+        msgs.append("key pairs are not the originals swapped")
+    if fingerprint(aj.left) != fingerprint(b.right) \
+            or fingerprint(aj.right) != fingerprint(b.left):
+        msgs.append("commuted join sides are not the original sides "
+                    "swapped")
+    if aj.bounded != b.bounded:
+        msgs.append("strategy hint changed across the commute")
+    try:
+        ls = infer_schema(b.left, catalog)
+        rs = infer_schema(b.right, catalog)
+    except PlanError as exc:
+        msgs.append(f"join sides no longer infer: {exc}")
+        return msgs
+    if any(l in ls and r in rs
+           and (ls[l].id != rs[r].id or ls[l].scale != rs[r].scale)
+           for l, r in b.on):
+        msgs.append("key dtypes differ — the restoring rename would "
+                    "retype the key column")
+    rename = {l: r for l, r in b.on if l != r}
+    try:
+        want = list(infer_schema(b, catalog))
+    except PlanError as exc:
+        msgs.append(f"before-subtree no longer infers: {exc}")
+        return msgs
+    got = [(n, ex.is_col(e)) for n, e in a.exprs]
+    if [n for n, _ in got] != want or any(
+            src != rename.get(n, n) for n, src in got):
+        msgs.append("restoring Project is not the identity-or-key-rename "
+                    "mapping over the original schema")
+    return msgs
+
+
+def _d_cbo_join_strategy(ob, catalog) -> List[str]:
+    """srjt-cbo (ISSUE 19): a physical-strategy resolution. Everything
+    but the ``bounded`` hint must be identical, the before-hint must be
+    None (author abstained — author-written hints are binding), and the
+    after-hint a concrete bool. The hint never changes semantics (the
+    dense path re-validates its domain at bind time and falls back),
+    so structure preservation IS the proof."""
+    from .rewrites import fingerprint
+    b, a = ob.before, ob.after
+    if not (isinstance(b, Join) and isinstance(a, Join)):
+        return ["strategy fire is not Join -> Join"]
+    msgs: List[str] = []
+    if b.bounded is not None:
+        msgs.append("author-written strategy hint overridden (before-"
+                    "bounded was not None)")
+    if not isinstance(a.bounded, bool):
+        msgs.append("strategy not resolved to a concrete bool")
+    if a.how != b.how or a.on != b.on:
+        msgs.append("join how/keys changed in a strategy-only rewrite")
+    if fingerprint(a.left) != fingerprint(b.left) \
+            or fingerprint(a.right) != fingerprint(b.right):
+        msgs.append("join inputs changed in a strategy-only rewrite")
+    return msgs
+
+
 # rule name -> discharge fn(obligation, catalog) -> list of failure
 # messages. srjt-lint SRJT011 statically requires every rule registered
 # in rewrites.RULES (plus prune_columns) to appear here or carry
@@ -869,6 +987,11 @@ OBLIGATION_DISCHARGERS: Dict[str, Callable] = {
     "prune_columns": _d_prune,
     # emitted by plan/ooc.py (compiler tail), not rewrites.RULES
     "partition_for_ooc": _d_partition_ooc,
+    # emitted by the cost-based optimizer pass (plan/optimizer.py,
+    # srjt-cbo ISSUE 19), not rewrites.RULES
+    "cbo_reorder_joins": _d_cbo_reorder,
+    "cbo_build_side": _d_cbo_build_side,
+    "cbo_join_strategy": _d_cbo_join_strategy,
 }
 
 

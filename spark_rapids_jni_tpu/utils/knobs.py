@@ -588,6 +588,39 @@ declare("SRJT_PLANCHECK_FUZZ_PLANS", "int", 50,
         "plans generated per base seed by the planfuzz CLI",
         scope="harness", minimum=1)
 
+# statistics + cost-based optimizer (plan/stats/, plan/optimizer.py,
+# ISSUE 19)
+declare("SRJT_STATS_ENABLED", "bool", True,
+        "collect per-column sketches (row count, min/max, HLL distinct "
+        "count, equi-depth histogram, null fraction) lazily at Scan and "
+        "cache them against table generation stamps; 0 falls the "
+        "compiler back to its hand-tuned selectivity/width heuristics")
+declare("SRJT_STATS_HISTOGRAM_BINS", "int", 16,
+        "equi-depth histogram bins per sketched column (more bins = "
+        "tighter range-predicate selectivity, more stats memory)",
+        minimum=2)
+declare("SRJT_STATS_HLL_BITS", "int", 9,
+        "HyperLogLog register-index bits per sketched column (2^bits "
+        "registers; 9 = 512 registers ~= 3.6% standard error; read "
+        "sites clamp to at most 14)", minimum=4)
+declare("SRJT_STATS_MAX_ROWS", "int", 262144,
+        "head-sample cap per column when collecting sketches; counts "
+        "above the cap are scaled back up by the sampling ratio",
+        positive=True)
+declare("SRJT_CBO_ENABLED", "bool", True,
+        "run the cost-based optimizer pass after the default rewrite: "
+        "join-order enumeration, build-side commutes, and physical join "
+        "strategy resolution, each fired as a verified rewrite with its "
+        "own PLAN006 obligation (requires SRJT_STATS_ENABLED)")
+declare("SRJT_CBO_DP_TABLES", "int", 6,
+        "join-chain length up to which the exact subset-DP order search "
+        "runs; longer chains use the greedy fanout-sorted fallback",
+        minimum=2)
+declare("SRJT_CBO_CALIBRATION", "str", "artifacts/plan_compile.jsonl",
+        "plan-report JSONL the byte-estimate calibration is learned "
+        "from (per-stage-kind median actual/est, clamped to [0.5, 2x]); "
+        "missing file = neutral factors")
+
 # correctness tooling (analysis/, ISSUE 7)
 declare("SRJT_LOCKDEP", "bool", False,
         "arm the runtime lock-order instrumentation "

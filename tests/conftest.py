@@ -227,6 +227,22 @@ _SLOW_TESTS = {
     "test_plan_queries.py::TestFusedStars::test_q26_matches_exact_oracle",
     "test_plan_queries.py::TestSetOpsExists::test_q69_exists_chain_matches_oracle",
     "test_plan_queries.py::TestWindowRatio::test_q20_matches_oracle",
+    # srjt-cbo (ISSUE 19): the mass-green campaign's oracle tier (each
+    # test pays a fused-pipeline compile; measured 104 s total) and the
+    # OOC model-chosen-K acceptance (pays two q1-shape executions);
+    # ci/premerge.sh runs both files env-armed in their dedicated
+    # compiler/ooc tiers (no slow filter there), nightly runs them too
+    "test_plan_queries.py::TestCboCampaign::test_q8_zip_intersect_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q9_bucketed_case_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q10_or_exists_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q15_zip_band_star_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q28_band_aggregates_match_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q30_state_decorrelation_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q32_catalog_excess_discount_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q34_having_band_matches_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q35_state_demo_stats_match_oracle",
+    "test_plan_queries.py::TestCboCampaign::test_q39_std_over_mean_matches_oracle",
+    "test_ooc.py::TestCostModelPartitions::test_model_chosen_k_overhead_bounded",
 }
 
 

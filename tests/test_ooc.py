@@ -55,11 +55,13 @@ def _clean_state():
 @pytest.fixture
 def _ooc_env(monkeypatch):
     """Arm out-of-core with a deterministic 4-way split and a budget
-    the q1-style aggregate's estimate exceeds by >=4x (the working set
-    is ~132 KB for 3000 rows; 32 KB forces the degradation)."""
+    the q1-style aggregate's estimate exceeds several-fold (the
+    sketch-calibrated estimate is 132 KB for 3000 rows — srjt-cbo
+    closed the old 0.75x filter-selectivity underestimate — so 36 KB
+    forces the degradation while each 33 KB quarter still admits)."""
     monkeypatch.setenv("SRJT_OOC_ENABLED", "1")
     monkeypatch.setenv("SRJT_OOC_PARTITIONS", "4")
-    monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(32 * 1024))
+    monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(36 * 1024))
     yield
 
 
@@ -267,7 +269,7 @@ class TestFailurePaths:
         tables, ir, want = q1_case
         monkeypatch.setenv("SRJT_OOC_ENABLED", "1")
         monkeypatch.setenv("SRJT_OOC_PARTITIONS", "4")
-        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(32 * 1024))
+        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(36 * 1024))
         # a tiny host budget cascades partition spills host -> disk,
         # where the CRC framing (and the corrupt rule) lives
         monkeypatch.setenv("SRJT_HOST_MEMORY_BUDGET", "1024")
@@ -311,7 +313,7 @@ class TestFailurePaths:
         tables, ir, want = q1_case
         monkeypatch.setenv("SRJT_OOC_ENABLED", "1")
         monkeypatch.setenv("SRJT_OOC_PARTITIONS", "4")
-        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(32 * 1024))
+        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(36 * 1024))
         monkeypatch.setenv("SRJT_HOST_MEMORY_BUDGET", "1024")
         memgov.reset()
         faultinj.configure_from_file(_OOC_CHAOS)
@@ -447,7 +449,7 @@ class TestServeAdmission:
             with memgov.enabled():
                 h = s.submit(ir, tables, tenant="ooc")
                 assert h._memory_bytes is not None
-                assert h._memory_bytes <= 32 * 1024, \
+                assert h._memory_bytes <= 36 * 1024, \
                     "admission saw the whole-plan estimate"
                 out = h.result(timeout_s=600)
             assert _col_bytes(out) == want
@@ -455,6 +457,68 @@ class TestServeAdmission:
             assert memgov.catalog().kind_stats("partition") == (0, 0)
         finally:
             s.shutdown(drain=False, timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# cost-model partition count (srjt-cbo, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModelPartitions:
+    def test_choose_k_is_minimal_fit(self, monkeypatch):
+        """Unit contract: smallest K whose calibrated per-partition
+        peak fits HALF the budget; 0 when max_parts cannot fit."""
+        from spark_rapids_jni_tpu.plan.stats.model import (
+            choose_ooc_partitions, reset_calibration)
+
+        monkeypatch.setenv("SRJT_CBO_CALIBRATION", "/nonexistent/cal.jsonl")
+        reset_calibration()
+        try:
+            # 16 KiB estimate vs 4 KiB budget: ceil(16Ki/8) == 2 KiB
+            # == budget//2, so K == 8 exactly at factor 1.0
+            assert choose_ooc_partitions(16 << 10, 4 << 10) == 8
+            assert choose_ooc_partitions(1 << 30, 1024, max_parts=64) == 0
+        finally:
+            reset_calibration()
+
+    def test_model_chosen_k_overhead_bounded(self, q1_case, monkeypatch):
+        """Regression for the ISSUE 19 satellite: with NO partition
+        override, a plan ~4x over budget gets its K from the cost
+        model, within 2x of the minimal half-budget fit (no
+        pathological over-partitioning), and the degraded run stays
+        bit-identical to the in-core oracle."""
+        from spark_rapids_jni_tpu.plan.stats.model import reset_calibration
+
+        tables, ir, want = q1_case
+        plain = P.compile_ir(ir, tables, name="k_plain")
+        est = plain.estimated_memory_bytes
+        budget = max(1024, est // 4)
+        monkeypatch.setenv("SRJT_OOC_ENABLED", "1")
+        monkeypatch.delenv("SRJT_OOC_PARTITIONS", raising=False)
+        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(budget))
+        monkeypatch.setenv("SRJT_CBO_CALIBRATION", "/nonexistent/cal.jsonl")
+        reset_calibration()
+        try:
+            with memgov.enabled():
+                cp = P.compile_ir(ir, tables, name="k_model")
+            assert isinstance(cp, P.OutOfCorePlan)
+            floor = -(-est // max(1, budget // 2))
+            assert floor <= cp.partitions <= 2 * floor
+            # the per-partition peak the serve tier admits really fits
+            assert cp.partition_memory_bytes * 2 <= budget
+            assert _col_bytes(cp()) == want
+        finally:
+            reset_calibration()
+
+    def test_knob_still_overrides_model(self, q1_case, monkeypatch, _ooc_env):
+        """SRJT_OOC_PARTITIONS stays an explicit override: the model
+        never second-guesses an armed K."""
+        tables, ir, want = q1_case
+        with memgov.enabled():
+            cp = P.compile_ir(ir, tables, name="k_override")
+        assert isinstance(cp, P.OutOfCorePlan)
+        assert cp.partitions == 4
+        assert _col_bytes(cp()) == want
 
 
 # ---------------------------------------------------------------------------
@@ -471,7 +535,7 @@ class TestMetricsArtifact:
         path = tmp_path / "ooc_metrics.jsonl"
         monkeypatch.setenv("SRJT_OOC_ENABLED", "1")
         monkeypatch.setenv("SRJT_OOC_PARTITIONS", "4")
-        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(32 * 1024))
+        monkeypatch.setenv("SRJT_DEVICE_MEMORY_BUDGET", str(36 * 1024))
         monkeypatch.setenv("SRJT_OOC_METRICS", str(path))
         with memgov.enabled():
             cp = P.compile_ir(ir, tables, name="art")

@@ -1,0 +1,264 @@
+"""srjt-cbo (ISSUE 19): the cost-based optimizer fires as VERIFIED
+rewrites — reorder/build-side/strategy fires discharge their PLAN006
+obligations, a tampered reorder FAILS PLAN006 (the gate can fail),
+planfuzz bisection blames an intentionally order-breaking reorder by
+name and fire index, and the cost-chosen plan stays bit-identical to
+the authored one."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu import plan as P
+from spark_rapids_jni_tpu.analysis import planfuzz
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.plan import nodes as pn
+from spark_rapids_jni_tpu.plan import optimizer as opt
+from spark_rapids_jni_tpu.plan import rewrites as rw
+from spark_rapids_jni_tpu.plan import stats as plan_stats
+
+
+def icol(a, d=dt.INT32):
+    return Column(d, data=jnp.asarray(np.asarray(a, np.dtype(d.np_dtype))))
+
+
+def fcol(a):
+    return Column(dt.FLOAT64,
+                  data=jnp.asarray(np.asarray(a, np.float64).view(np.uint64)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    plan_stats.reset()
+    yield
+    plan_stats.reset()
+
+
+@pytest.fixture
+def star(rng):
+    n = 3000
+    fact = Table(
+        [icol(rng.integers(0, 300, n)), icol(rng.integers(0, 500, n)),
+         fcol(rng.uniform(0, 50, n).round(2))],
+        ["f_d_sk", "f_i_sk", "f_val"],
+    )
+    dates = Table([icol(np.arange(300)), icol(1 + np.arange(300) % 12)],
+                  ["d_sk", "d_moy"])
+    item = Table([icol(np.arange(500)), icol(np.arange(500) % 7)],
+                 ["i_sk", "i_cls"])
+    # a second fact-shaped table: duplicate keys, bigger than `dates`
+    # — the negative build-side fixture
+    mini = Table([icol(rng.integers(0, 300, 800)),
+                  icol(rng.integers(1, 9, 800), dt.INT64)],
+                 ["m_d_sk", "m_qty"])
+    return {"fact": fact, "dates": dates, "item": item, "mini": mini}
+
+
+def cat_of(tabs):
+    return {t: {n: c.dtype for n, c in zip(tbl.names, tbl.columns)}
+            for t, tbl in tabs.items()}
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+def _joins_of(node):
+    out, seen, stack = [], set(), [node]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if isinstance(n, pn.Join):
+            out.append(n)
+        for attr in ("input", "left", "right", "sub"):
+            c = getattr(n, attr, None)
+            if c is not None:
+                stack.append(c)
+        for c in getattr(n, "branches", None) or ():
+            stack.append(c)
+    return out
+
+
+def _star_ir():
+    """Author order joins the wide UNfiltered dim first and the
+    selective date filter last — the worst order, which the model must
+    undo (move the 1-in-12 date filter innermost)."""
+    j1 = pn.Join(pn.Scan("fact"), pn.Scan("item"),
+                 on=(("f_i_sk", "i_sk"),), how="inner")
+    j2 = pn.Join(j1,
+                 pn.Filter(pn.Scan("dates"),
+                           P.pcol("d_moy") == P.plit(np.int32(3))),
+                 on=(("f_d_sk", "d_sk"),), how="inner")
+    return pn.Sort(
+        pn.Aggregate(j2, keys=("i_cls",),
+                     aggs=(pn.AggSpec("f_val", "sum", "total"),
+                           pn.AggSpec(None, "count_all", "cnt"))),
+        keys=(("i_cls", True),),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the search: fires, discharges, converges, preserves results
+# ---------------------------------------------------------------------------
+
+
+class TestSearch:
+    def test_reorder_fires_and_discharges(self, star):
+        cat = cat_of(star)
+        res = opt.optimize(_star_ir(), cat, star)
+        assert res.fired.get("cbo_reorder_joins", 0) >= 1
+        assert res.join_count == 2
+        assert res.author_cost is not None and res.chosen_cost is not None
+        assert res.chosen_cost <= res.author_cost
+        # every enumeration fire discharges like any other rewrite
+        assert P.verify_obligations(res.obligations, cat) == []
+
+    def test_search_is_idempotent(self, star):
+        cat = cat_of(star)
+        first = opt.optimize(_star_ir(), cat, star)
+        again = opt.optimize(first.plan, cat, star, est=first.estimator)
+        assert again.fired == {}
+        assert again.chosen_cost == pytest.approx(again.author_cost)
+
+    def test_compiled_results_identical_cbo_on_off(self, star, monkeypatch):
+        ir = _star_ir()
+        on = P.compile_ir(ir, star, name="cbo_on")
+        assert on.rewrites_fired.get("cbo_reorder_joins", 0) >= 1
+        assert on.modeled is not None
+        assert on.modeled["chosen"] <= on.modeled["author"]
+        got_on = on()
+        monkeypatch.setenv("SRJT_CBO_ENABLED", "0")
+        off = P.compile_ir(ir, star, name="cbo_off")
+        assert "cbo_reorder_joins" not in off.rewrites_fired
+        assert off.modeled is None
+        got_off = off()
+        assert got_on.names == got_off.names
+        for a, b in zip(got_on.columns, got_off.columns):
+            assert np.asarray(a.data).tobytes() == np.asarray(b.data).tobytes()
+
+    def test_build_side_commutes_to_unique_dim(self, star):
+        # author builds on the 3000-row fact; d_sk carries the exact
+        # uniqueness witness, so the commute is provably safe
+        cat = cat_of(star)
+        ir = pn.Sort(
+            pn.Aggregate(
+                pn.Join(pn.Scan("dates"), pn.Scan("fact"),
+                        on=(("d_sk", "f_d_sk"),), how="inner"),
+                keys=("d_moy",),
+                aggs=(pn.AggSpec("f_val", "sum", "total"),)),
+            keys=(("d_moy", True),),
+        )
+        res = opt.optimize(ir, cat, star)
+        assert res.fired.get("cbo_build_side", 0) == 1
+        assert P.verify_obligations(res.obligations, cat) == []
+
+    def test_no_commute_onto_duplicate_build_keys(self, star):
+        # mini's m_d_sk has duplicates: the dense build map would
+        # reject it at runtime, so the sketch witness must block the
+        # fire even though the row counts alone say "commute"
+        cat = cat_of(star)
+        ir = pn.Aggregate(
+            pn.Join(pn.Scan("mini"), pn.Scan("fact"),
+                    on=(("m_d_sk", "f_d_sk"),), how="inner"),
+            keys=("m_d_sk",), aggs=(pn.AggSpec("f_val", "sum", "total"),))
+        res = opt.optimize(ir, cat, star)
+        assert "cbo_build_side" not in res.fired
+
+    def test_join_strategy_resolves_author_abstention(self, star):
+        # bounded=None is "author abstains": the strategy rule resolves
+        # it from the build key's sketch (unique + dense domain)
+        cat = cat_of(star)
+        ir = pn.Aggregate(
+            pn.Join(pn.Scan("fact"), pn.Scan("item"),
+                    on=(("f_i_sk", "i_sk"),), how="inner", bounded=None),
+            keys=("i_cls",), aggs=(pn.AggSpec("f_val", "sum", "total"),))
+        res = opt.optimize(ir, cat, star)
+        assert res.fired.get("cbo_join_strategy", 0) == 1
+        assert any(j.bounded is True for j in _joins_of(res.plan))
+        assert P.verify_obligations(res.obligations, cat) == []
+
+    def test_stats_off_disables_search(self, star, monkeypatch):
+        monkeypatch.setenv("SRJT_STATS_ENABLED", "0")
+        res = opt.optimize(_star_ir(), cat_of(star), star)
+        assert res.fired == {} and res.author_cost is None
+
+
+# ---------------------------------------------------------------------------
+# the gate can fail: a tampered reorder is caught, and bisection blames
+# an order-breaking one
+# ---------------------------------------------------------------------------
+
+
+class TestGateCanFail:
+    def test_tampered_reorder_fails_plan006(self, star):
+        """A rule wearing the real name that 'reorders' the chain while
+        flipping every member's strategy hint: the chain-signature
+        multiset check catches the lie with exactly one PLAN006."""
+        cat = cat_of(star)
+
+        def tampered(node, catalog, memo):
+            if not (isinstance(node, pn.Join) and node.how == "inner"):
+                return None
+            base, chain = opt.collect_chain(node, catalog)
+            if len(chain) < 2 or any(j.bounded for j in chain):
+                return None  # single fire: the rebuild is all-bounded
+            rebuilt = base
+            for j in reversed(chain):
+                rebuilt = pn.Join(rebuilt, j.right, on=j.on, how="inner",
+                                  bounded=True)
+            names = tuple(P.infer_schema(node, catalog))
+            return pn.Project(rebuilt,
+                              tuple((n, P.pcol(n)) for n in names))
+
+        res = P.rewrite(_star_ir(), cat,
+                        rules=(("cbo_reorder_joins", tampered),),
+                        prune=False)
+        assert res.fired.get("cbo_reorder_joins") == 1
+        viols = P.verify_obligations(res.obligations, cat)
+        assert rules_of(viols) == ["PLAN006"]
+        assert "multiset not preserved" in viols[0].message
+
+    def test_bisection_blames_order_breaking_reorder(self, star):
+        """An 'enumeration fire' that moves the date dim innermost but
+        weakens its filter from eq to le on the way: the differential
+        replay must blame the rule by name with a concrete fire index."""
+        cat = cat_of(star)
+
+        def order_breaking(node, catalog, memo):
+            if not (isinstance(node, pn.Join) and node.how == "inner"):
+                return None
+            base, chain = opt.collect_chain(node, catalog)
+            if len(chain) != 2:
+                return None
+            outer, inner = chain
+            f = outer.right
+            if not (isinstance(f, pn.Filter)
+                    and getattr(f.predicate, "op", None) == "eq"):
+                return None
+            weak = pn.Filter(f.input, f.predicate.a <= f.predicate.b)
+            moved = pn.Join(base, weak, on=outer.on, how="inner",
+                            bounded=outer.bounded)
+            rebuilt = pn.Join(moved, inner.right, on=inner.on, how="inner",
+                              bounded=inner.bounded)
+            names = tuple(P.infer_schema(node, catalog))
+            return pn.Project(rebuilt,
+                              tuple((n, P.pcol(n)) for n in names))
+
+        rules = rw.RULES + (("cbo_reorder_joins", order_breaking),)
+        rels = {t: planfuzz.rel_of_table(tbl) for t, tbl in star.items()}
+        blame = planfuzz.bisect_mismatch(_star_ir(), rels, cat, rules=rules)
+        assert blame["rule"] == "cbo_reorder_joins"
+        assert blame["first_bad_fire"] is not None
+
+    def test_real_cbo_rules_bisect_clean(self, star):
+        cat = cat_of(star)
+        est = plan_stats.make_estimator(star)
+        rules = rw.RULES + opt.reorder_rules(est) + opt.physical_rules(est)
+        rels = {t: planfuzz.rel_of_table(tbl) for t, tbl in star.items()}
+        ok = planfuzz.bisect_mismatch(_star_ir(), rels, cat, rules=rules)
+        assert ok["first_bad_fire"] is None
+        assert ok["rule"] == "lowering"

@@ -394,7 +394,9 @@ class TestExecution:
         assert rep["nodes_raw"] >= 4 and rep["nodes_optimized"] >= 4
         assert rep["est_peak_bytes"] == cp.estimated_memory_bytes
         assert rep["actual_peak_bytes"] > 0
-        assert rep["peak_blowup"] <= 3.0, rep
+        # tightened 3.0 -> 2.5 with the sketch-calibrated estimates
+        # (srjt-cbo, ISSUE 19)
+        assert rep["peak_blowup"] <= 2.5, rep
         assert all("est_bytes" in s and "actual_bytes" in s for s in rep["stages"])
 
     def test_plan_report_knob_appends_jsonl(self, rng, tmp_path, monkeypatch):

@@ -42,7 +42,9 @@ def _run_checked(name: str, tables):
     rep = cp.last_report
     assert rep["nodes_raw"] > 0 and rep["nodes_optimized"] > 0
     assert rep["est_peak_bytes"] > 0
-    assert rep["peak_blowup"] is None or rep["peak_blowup"] <= 3.0, rep
+    # tightened 3.0 -> 2.5 with the sketch-calibrated estimates
+    # (srjt-cbo, ISSUE 19)
+    assert rep["peak_blowup"] is None or rep["peak_blowup"] <= 2.5, rep
     return out, cp
 
 
@@ -547,6 +549,322 @@ class TestSetOpsExists:
             got[key] = int(_i(out.column("cnt"))[row])
         assert got == counts
         assert sorted(got) == list(got)  # ORDER BY held
+
+
+class TestCboCampaign:
+    """srjt-cbo (ISSUE 19) mass-green campaign: ten more lowers go
+    green through the compiler, each against a pandas/Fraction exact
+    oracle (q39's sample stddev at the operator tier's 1e-9, the same
+    bound ops/aggregate.py is tested to)."""
+
+    def test_q9_bucketed_case_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, _ = _run_checked("q9", tabs)
+        ss = tabs["store_sales"]
+        qty = _i(ss.column("ss_quantity"))
+        ext = _f64(ss.column("ss_ext_sales_price"))
+        coup = _f64(ss.column("ss_coupon_amt"))
+        ths = (2100, 2100, 2100, 2100, 1800)
+        assert _i(out.column("bucket")).tolist() == list(range(5))
+        for i, th in enumerate(ths):
+            sel = (qty >= 1 + 20 * i) & (qty <= 20 + 20 * i)
+            assert sel.sum() > 0
+            src = ext if int(sel.sum()) > th else coup
+            want = _exact_mean(src[sel].tolist())
+            assert _f64(out.column("val"))[i] == want, i
+        # both CASE arms must be exercised by the default thresholds
+        takes = [int(((qty >= 1 + 20 * i) & (qty <= 20 + 20 * i)).sum()) > th
+                 for i, th in enumerate(ths)]
+        assert any(takes) and not all(takes)
+
+    def test_q28_band_aggregates_match_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, _ = _run_checked("q28", tabs)
+        ss = tabs["store_sales"]
+        qty = _i(ss.column("ss_quantity"))
+        lp = _f64(ss.column("ss_list_price"))
+        coup = _f64(ss.column("ss_coupon_amt"))
+        assert _i(out.column("band")).tolist() == list(range(6))
+        for i in range(6):
+            sel = ((qty >= 1 + 16 * i) & (qty <= 16 + 16 * i)
+                   & (((lp >= 20.0 + 10 * i) & (lp <= 120.0 + 10 * i))
+                      | ((coup >= 5.0 * i) & (coup <= 20.0 + 5.0 * i))))
+            vals = lp[sel]
+            assert len(vals) > 0
+            assert _f64(out.column("avg_lp"))[i] == _exact_mean(vals.tolist()), i
+            assert int(_i(out.column("cnt_lp"))[i]) == len(vals)
+            assert int(_i(out.column("uniq_lp"))[i]) == len(set(vals.tolist()))
+
+    def _store_wide_customer_zip(self, tabs):
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        addr = dict(zip(_i(cu.column("c_customer_sk")).tolist(),
+                        _i(cu.column("c_current_addr_sk")).tolist()))
+        zip5 = dict(zip(_i(ca.column("ca_address_sk")).tolist(),
+                        _i(ca.column("ca_zip5")).tolist()))
+        return addr, zip5
+
+    def test_q15_zip_band_star_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, _ = _run_checked("q15", tabs)
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        addr, zip5 = self._store_wide_customer_zip(tabs)
+        ok = {d for d, y, m in zip(_i(dd.column("d_date_sk")).tolist(),
+                                   _i(dd.column("d_year")).tolist(),
+                                   _i(dd.column("d_moy")).tolist())
+              if y == 2000 and 1 <= m <= 3}
+        sums = {}
+        for d, c, p in zip(_i(ss.column("ss_sold_date_sk")).tolist(),
+                           _i(ss.column("ss_customer_sk")).tolist(),
+                           _f64(ss.column("ss_sales_price")).tolist()):
+            if d not in ok:
+                continue
+            z = zip5[addr[c]]
+            zband = z < 40 or 120 <= z < 160 or z >= 260
+            if zband or p >= 120.0:
+                sums.setdefault(z, []).append(p)
+        want = sorted((z, math.fsum(v)) for z, v in sums.items())
+        assert want  # the bands must select real rows
+        assert _i(out.column("ca_zip5")).tolist() == [z for z, _ in want]
+        np.testing.assert_array_equal(
+            _f64(out.column("sum_sales")), np.array([s for _, s in want]))
+
+    def test_q8_zip_intersect_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q8", tabs)
+        assert cp.last_report["rewrites"].get("setop_to_joins") == 1
+        assert cp.last_report["rewrites"].get("exists_to_semijoin") == 1
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        st = tabs["store"]
+        zips = _i(ca.column("ca_zip5")).tolist()
+        band = {z for z in zips if z < 30 or 100 <= z < 130 or z >= 270}
+        zip5 = dict(zip(_i(ca.column("ca_address_sk")).tolist(), zips))
+        pref = {zip5[a] for cid, a in zip(_i(cu.column("c_customer_id")).tolist(),
+                                          _i(cu.column("c_current_addr_sk")).tolist())
+                if cid < 400}
+        keep_zips = band & pref
+        stores = {s for s, z in zip(_i(st.column("s_store_sk")).tolist(),
+                                    _i(st.column("s_zip5")).tolist())
+                  if z in keep_zips}
+        assert stores  # the intersect must keep real stores
+        ok = {d for d, y, m in zip(_i(dd.column("d_date_sk")).tolist(),
+                                   _i(dd.column("d_year")).tolist(),
+                                   _i(dd.column("d_moy")).tolist())
+              if y == 2000 and 10 <= m <= 12}
+        sums = {}
+        for d, s, p in zip(_i(ss.column("ss_sold_date_sk")).tolist(),
+                           _i(ss.column("ss_store_sk")).tolist(),
+                           _f64(ss.column("ss_ext_sales_price")).tolist()):
+            if d in ok and s in stores:
+                sums.setdefault(s, []).append(p)
+        want = sorted((s, math.fsum(v)) for s, v in sums.items())
+        assert _i(out.column("ss_store_sk")).tolist() == [s for s, _ in want]
+        np.testing.assert_array_equal(
+            _f64(out.column("net")), np.array([v for _, v in want]))
+
+    def test_q34_having_band_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q34", tabs)
+        assert cp.last_report["rewrites"].get("having_to_filter") == 1
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        hd = tabs["household_demographics"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "t": _i(ss.column("ss_ticket_number")),
+            "c": _i(ss.column("ss_customer_sk")),
+            "h": _i(ss.column("ss_hdemo_sk")),
+        }).merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                               "y": _i(dd.column("d_year")),
+                               "m": _i(dd.column("d_moy"))}), on="d") \
+          .merge(pd.DataFrame({"h": _i(hd.column("hd_demo_sk")),
+                               "buy": _i(hd.column("hd_buy_potential")),
+                               "veh": _i(hd.column("hd_vehicle_count"))}), on="h")
+        df = df[(df.y == 2000) & (df.m >= 4) & (df.m <= 6)
+                & df.buy.isin((0, 3)) & (df.veh > 0)]
+        cid = dict(zip(_i(tabs["customer"].column("c_customer_sk")).tolist(),
+                       _i(tabs["customer"].column("c_customer_id")).tolist()))
+        rows = [(cid[c], len(g)) for (t, c), g in df.groupby(["t", "c"])
+                if 1 <= len(g) <= 3]
+        rows.sort(key=lambda r: (-r[1], r[0]))
+        assert rows
+        got = list(zip(_i(out.column("c_customer_id")).tolist(),
+                       _i(out.column("cnt")).tolist()))
+        assert got == rows
+
+    def test_q39_std_over_mean_matches_oracle(self):
+        tabs = tpcds.gen_store_wide(10_000)
+        out, cp = _run_checked("q39", tabs)
+        assert cp.last_report["rewrites"].get("having_to_filter") == 1
+        ss = tabs["store_sales"]
+        dd = tabs["date_dim"]
+        df = pd.DataFrame({
+            "d": _i(ss.column("ss_sold_date_sk")),
+            "st": _i(ss.column("ss_store_sk")),
+            "q": _i(ss.column("ss_quantity")),
+        }).merge(pd.DataFrame({"d": _i(dd.column("d_date_sk")),
+                               "m": _i(dd.column("d_moy"))}), on="d")
+        rows = []
+        for (st, m), g in df.groupby(["st", "m"]):
+            mean = _exact_mean(g.q.tolist())
+            std = float(g.q.std(ddof=1))
+            if std > mean * 0.55:
+                rows.append((st, m, mean, std))
+        rows.sort()
+        assert rows and len(rows) < len(df.groupby(["st", "m"]))  # filter bites
+        assert _i(out.column("ss_store_sk")).tolist() == [r[0] for r in rows]
+        assert _i(out.column("d_moy")).tolist() == [r[1] for r in rows]
+        np.testing.assert_array_equal(
+            _f64(out.column("mean_q")), np.array([r[2] for r in rows]))
+        np.testing.assert_allclose(
+            _f64(out.column("std_q")), np.array([r[3] for r in rows]), rtol=1e-9)
+
+    def test_q30_state_decorrelation_matches_oracle(self):
+        tabs = tp.gen_store_returns(8000)
+        out, cp = _run_checked("q30", tabs)
+        assert cp.last_report["rewrites"].get("decorrelate_scalar_agg") == 1
+        sr = tabs["store_returns"]
+        dd = tabs["date_dim"]
+        st = tabs["store"]
+        years = dict(zip(_i(dd.column("d_date_sk")).tolist(),
+                         _i(dd.column("d_year")).tolist()))
+        states = dict(zip(_i(st.column("s_store_sk")).tolist(),
+                          _i(st.column("s_state")).tolist()))
+        ctr = {}
+        for d, c, s, a in zip(_i(sr.column("sr_returned_date_sk")).tolist(),
+                              _i(sr.column("sr_customer_sk")).tolist(),
+                              _i(sr.column("sr_store_sk")).tolist(),
+                              _f64(sr.column("sr_return_amt")).tolist()):
+            if years[d] == 1999:
+                ctr.setdefault((c, states[s]), []).append(a)
+        ctr = {k: math.fsum(v) for k, v in ctr.items()}
+        per_state = {}
+        for (c, s), v in ctr.items():
+            per_state.setdefault(s, []).append(v)
+        avg = {s: _exact_mean(v) for s, v in per_state.items()}
+        cid = dict(zip(_i(tabs["customer"].column("c_customer_sk")).tolist(),
+                       _i(tabs["customer"].column("c_customer_id")).tolist()))
+        keep = sorted((cid[c], v) for (c, s), v in ctr.items()
+                      if v > avg[s] * 1.2)[:100]
+        assert keep
+        assert _i(out.column("c_customer_id")).tolist() == [k for k, _ in keep]
+        np.testing.assert_array_equal(
+            _f64(out.column("ctr_total_return")), np.array([v for _, v in keep]))
+
+    def test_q32_catalog_excess_discount_matches_oracle(self):
+        tabs = tp.gen_catalog(10_000)
+        out, cp = _run_checked("q32", tabs)
+        assert cp.last_report["rewrites"].get("decorrelate_scalar_agg") == 1
+        cs = tabs["catalog_sales"]
+        it = tabs["item"]
+        df = pd.DataFrame({
+            "d": _i(cs.column("cs_sold_date_sk")),
+            "i": _i(cs.column("cs_item_sk")),
+            "disc": _f64(cs.column("cs_coupon_amt")),
+        })
+        dated = df[(df.d >= 300) & (df.d <= 390)]
+        avg = {i: _exact_mean(g.disc.tolist()) for i, g in dated.groupby("i")}
+        cat = dict(zip(_i(it.column("i_item_sk")).tolist(),
+                       _i(it.column("i_category_id")).tolist()))
+        kept = [r.disc for r in dated.itertuples()
+                if cat[r.i] == 4 and r.disc > 1.3 * avg[r.i]]
+        assert kept
+        assert _f64(out.column("excess"))[0] == math.fsum(kept)
+
+    def _channels_population(self, tabs, year, moy_lo, moy_hi):
+        dd = tabs["date_dim"]
+        ok = {d for d, y, m in zip(_i(dd.column("d_date_sk")).tolist(),
+                                   _i(dd.column("d_year")).tolist(),
+                                   _i(dd.column("d_moy")).tolist())
+              if y == year and moy_lo <= m <= moy_hi}
+
+        def active(fact, cust, date):
+            f = tabs[fact]
+            return {c for c, d in zip(_i(f.column(cust)).tolist(),
+                                      _i(f.column(date)).tolist()) if d in ok}
+
+        s_act = active("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+        w_act = active("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+        c_act = active("catalog_sales", "cs_ship_customer_sk", "cs_sold_date_sk")
+        return s_act, w_act | c_act
+
+    def test_q10_or_exists_matches_oracle(self):
+        tabs = tp.gen_channels(6000)
+        out, cp = _run_checked("q10", tabs)
+        assert cp.last_report["rewrites"].get("exists_to_semijoin") == 2
+        s_act, any_act = self._channels_population(tabs, 1999, 1, 4)
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        cd = tabs["customer_demographics"]
+        state = dict(zip(_i(ca.column("ca_address_sk")).tolist(),
+                         _i(ca.column("ca_state")).tolist()))
+        demo = {k: (g, ms, ed)
+                for k, g, ms, ed in zip(
+                    _i(cd.column("cd_demo_sk")).tolist(),
+                    _i(cd.column("cd_gender")).tolist(),
+                    _i(cd.column("cd_marital_status")).tolist(),
+                    _i(cd.column("cd_education_status")).tolist())}
+        counts = {}
+        for csk, cdemo, addr in zip(_i(cu.column("c_customer_sk")).tolist(),
+                                    _i(cu.column("c_current_cdemo_sk")).tolist(),
+                                    _i(cu.column("c_current_addr_sk")).tolist()):
+            if state[addr] not in (1, 4, 7):
+                continue
+            if csk not in s_act or csk not in any_act:
+                continue
+            counts[demo[cdemo]] = counts.get(demo[cdemo], 0) + 1
+        assert counts
+        got = {}
+        for row in range(out.num_rows):
+            key = (int(_i(out.column("cd_gender"))[row]),
+                   int(_i(out.column("cd_marital_status"))[row]),
+                   int(_i(out.column("cd_education_status"))[row]))
+            got[key] = int(_i(out.column("cnt"))[row])
+        assert got == counts
+        assert sorted(got) == list(got)
+
+    def test_q35_state_demo_stats_match_oracle(self):
+        tabs = tp.gen_channels(6000)
+        out, cp = _run_checked("q35", tabs)
+        assert cp.last_report["rewrites"].get("exists_to_semijoin") == 2
+        s_act, any_act = self._channels_population(tabs, 1999, 1, 6)
+        cu = tabs["customer"]
+        ca = tabs["customer_address"]
+        cd = tabs["customer_demographics"]
+        state = dict(zip(_i(ca.column("ca_address_sk")).tolist(),
+                         _i(ca.column("ca_state")).tolist()))
+        demo = {k: (g, ms) for k, g, ms in zip(
+            _i(cd.column("cd_demo_sk")).tolist(),
+            _i(cd.column("cd_gender")).tolist(),
+            _i(cd.column("cd_marital_status")).tolist())}
+        deps = dict(zip(_i(cd.column("cd_demo_sk")).tolist(),
+                        _i(cd.column("cd_dep_count")).tolist()))
+        groups = {}
+        for csk, cdemo, addr in zip(_i(cu.column("c_customer_sk")).tolist(),
+                                    _i(cu.column("c_current_cdemo_sk")).tolist(),
+                                    _i(cu.column("c_current_addr_sk")).tolist()):
+            if csk not in s_act or csk not in any_act:
+                continue
+            g, ms = demo[cdemo]
+            groups.setdefault((state[addr], g, ms), []).append(deps[cdemo])
+        assert groups
+        assert out.num_rows == len(groups)
+        keys_sorted = sorted(groups)
+        for row, key in enumerate(keys_sorted):
+            v = groups[key]
+            assert (int(_i(out.column("ca_state"))[row]),
+                    int(_i(out.column("cd_gender"))[row]),
+                    int(_i(out.column("cd_marital_status"))[row])) == key
+            assert int(_i(out.column("cnt"))[row]) == len(v)
+            # min/max/sum over int lanes ride the f64 accumulator in the
+            # fused path — exact for these magnitudes, FLOAT64 dtype
+            assert _f64(out.column("max_dep"))[row] == float(max(v))
+            assert _f64(out.column("sum_dep"))[row] == float(sum(v))
+            assert _f64(out.column("avg_dep"))[row] == _exact_mean(v)
 
 
 class TestWindowRatio:

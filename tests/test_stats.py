@@ -1,0 +1,246 @@
+"""srjt-cbo (ISSUE 19): property tests for the statistics subsystem —
+HLL distinct counts within 2x of truth on uniform/skewed/null-heavy/
+empty columns, equi-depth histogram selectivity bounds, the exact
+``unique`` witness (never True under sampling or nulls — the build-side
+rules bet correctness on it), and generation-stamp cache hygiene
+(a declared mutation is never served a stale sketch)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import spark_rapids_jni_tpu  # noqa: F401
+from spark_rapids_jni_tpu import plan as P
+from spark_rapids_jni_tpu.columnar import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.plan import stats as S
+
+
+def icol(a, d=dt.INT32):
+    return Column(d, data=jnp.asarray(np.asarray(a, np.dtype(d.np_dtype))))
+
+
+def fcol(a):
+    return Column(dt.FLOAT64,
+                  data=jnp.asarray(np.asarray(a, np.float64).view(np.uint64)))
+
+
+def vcol(a, valid):
+    return Column(dt.INT32,
+                  data=jnp.asarray(np.asarray(a, np.int32)),
+                  validity=jnp.asarray(np.asarray(valid, bool)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    S.reset()
+    yield
+    S.reset()
+
+
+# ---------------------------------------------------------------------------
+# HLL distinct counts: within 2x of truth across value shapes
+# ---------------------------------------------------------------------------
+
+
+class TestHll:
+    def test_uniform_within_2x(self, rng):
+        vals = rng.integers(0, 5000, 20000)
+        truth = len(set(vals.tolist()))
+        sk = S.sketch_column(icol(vals))
+        assert truth / 2 <= sk.ndv <= truth * 2
+        assert sk.rows == 20000 and sk.nulls == 0
+        assert sk.min_val == float(vals.min())
+        assert sk.max_val == float(vals.max())
+
+    def test_skewed_within_2x(self, rng):
+        # zipf head-heavy: a few values carry most of the mass, long
+        # sparse tail — the regime plain sampling misestimates worst
+        vals = np.minimum(rng.zipf(1.3, 20000), np.int64(1) << 40)
+        truth = len(set(vals.tolist()))
+        sk = S.sketch_column(icol(vals, dt.INT64))
+        assert truth / 2 <= sk.ndv <= truth * 2
+
+    def test_null_heavy_within_2x(self, rng):
+        n = 8000
+        vals = rng.integers(0, 300, n)
+        valid = rng.random(n) < 0.1  # ~90% null
+        truth = len(set(vals[valid].tolist()))
+        sk = S.sketch_column(vcol(vals, valid))
+        assert truth / 2 <= sk.ndv <= truth * 2
+        assert sk.null_fraction == pytest.approx(1.0 - valid.mean(), abs=0.01)
+        assert not sk.unique
+
+    def test_float_lanes_decoded_before_sketching(self, rng):
+        # FLOAT64 columns store uint64 bit-lanes; min/max/ndv must come
+        # from the decoded logical domain, not the raw lane integers
+        vals = rng.uniform(-10, 10, 5000).round(4)
+        truth = len(set(vals.tolist()))
+        sk = S.sketch_column(fcol(vals))
+        assert sk.min_val == float(vals.min())
+        assert sk.max_val == float(vals.max())
+        assert truth / 2 <= sk.ndv <= truth * 2
+
+    def test_empty_column(self):
+        sk = S.sketch_column(icol([]))
+        assert sk.rows == 0 and sk.ndv == 0.0
+        assert sk.min_val is None and sk.max_val is None and sk.edges == ()
+        assert sk.sel_cmp("lt", 5.0) == 0.0
+        assert sk.sel_eq(1.0) == 0.0
+
+    def test_all_null_column(self):
+        n = 64
+        sk = S.sketch_column(vcol(np.zeros(n), np.zeros(n, bool)))
+        assert sk.nulls == n and sk.null_fraction == 1.0
+        assert sk.ndv == 0.0 and sk.min_val is None
+        assert not sk.unique
+
+
+# ---------------------------------------------------------------------------
+# equi-depth histogram selectivity
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_sel_cmp_tracks_truth_within_a_bin(self, rng):
+        n = 10000
+        vals = rng.integers(0, 1000, n)
+        sk = S.sketch_column(icol(vals), bins=16)
+        for cut in (100.0, 250.0, 500.0, 900.0):
+            truth = float((vals < cut).mean())
+            est = sk.sel_cmp("lt", cut)
+            assert 0.0 <= est <= 1.0
+            # partial bins count in full: within one bin of the truth
+            assert abs(est - truth) <= 1.0 / 16 + 0.02
+        # complements partition the non-null mass
+        assert sk.sel_cmp("lt", 500.0) + sk.sel_cmp("ge", 500.0) == \
+            pytest.approx(1.0)
+
+    def test_out_of_range_cuts_clamp(self, rng):
+        vals = rng.integers(100, 200, 2000)
+        sk = S.sketch_column(icol(vals))
+        assert sk.sel_cmp("lt", 50.0) == 0.0
+        assert sk.sel_cmp("gt", 500.0) == 0.0
+        assert sk.sel_cmp("le", 500.0) == pytest.approx(1.0)
+        assert sk.sel_eq(999.0) == 0.0
+
+    def test_sel_eq_scales_with_ndv(self, rng):
+        vals = rng.integers(0, 100, 5000)
+        sk = S.sketch_column(icol(vals))
+        # ~uniform over 100 distinct values: eq keeps ~1% (HLL slack)
+        assert 0.004 <= sk.sel_eq(50.0) <= 0.03
+
+    def test_predicate_selectivity_bounds(self, rng):
+        n = 6000
+        sketches = {
+            "a": S.sketch_column(icol(rng.integers(0, 1000, n))),
+            "b": S.sketch_column(icol(rng.integers(0, 10, n))),
+        }
+        resolve = sketches.get
+        a_half = P.pcol("a") < P.plit(np.int32(500))
+        b_half = P.pcol("b") >= P.plit(np.int32(5))
+        half = S.selectivity(a_half, resolve)
+        assert 0.35 <= half <= 0.65
+        conj = S.selectivity(a_half & b_half, resolve)
+        disj = S.selectivity(a_half | b_half, resolve)
+        assert 0.0 <= conj <= half <= disj <= 1.0
+        # unsketched column: the default, still a valid fraction
+        unknown = S.selectivity(P.pcol("zzz") < P.plit(np.int32(3)), resolve)
+        assert 0.0 < unknown < 1.0
+
+
+# ---------------------------------------------------------------------------
+# the exact `unique` witness
+# ---------------------------------------------------------------------------
+
+
+class TestUniqueWitness:
+    def test_permutation_is_witnessed_unique(self, rng):
+        assert S.sketch_column(icol(rng.permutation(1000))).unique
+
+    def test_single_duplicate_defeats_witness(self, rng):
+        v = rng.permutation(1000)
+        v[500] = v[3]
+        assert not S.sketch_column(icol(v)).unique
+
+    def test_sampling_never_claims_unique(self, rng):
+        # a head sample cannot PROVE global uniqueness, and the dense
+        # build-side map rejects duplicate keys at runtime — so the
+        # witness must drop to False the moment the scan is capped
+        v = rng.permutation(4096)
+        sk = S.sketch_column(icol(v), max_rows=1024)
+        assert not sk.unique
+        assert sk.rows == 4096
+        # the sampled ndv is still scaled back to full-table ballpark
+        assert 4096 / 2 <= sk.ndv <= 4096 * 2
+
+    def test_nulls_defeat_witness(self):
+        n = 100
+        valid = np.ones(n, bool)
+        valid[7] = False
+        assert not S.sketch_column(vcol(np.arange(n), valid)).unique
+
+
+# ---------------------------------------------------------------------------
+# generation-stamp cache: never serve a stale sketch
+# ---------------------------------------------------------------------------
+
+
+class TestStampCache:
+    def test_cache_hit_then_invalidate(self):
+        t = Table([icol(np.arange(100))], ["k"])
+        s1 = S.table_stats(t)
+        assert S.table_stats(t) is s1  # generation-stamp hit
+        S.invalidate_table(t)
+        assert S.table_stats(t) is not s1
+
+    def test_invalidate_never_serves_stale(self):
+        t = Table([icol(np.arange(100))], ["k"])
+        assert S.table_stats(t).sketch("k").max_val == 99.0
+        t.columns[0] = icol(2 * np.arange(100))
+        S.invalidate_table(t)
+        assert S.table_stats(t).sketch("k").max_val == 198.0
+
+    def test_distinct_tables_never_share(self):
+        a = Table([icol(np.arange(10))], ["k"])
+        b = Table([icol(np.arange(10))], ["k"])  # equal data, new identity
+        assert S.table_stats(a) is not S.table_stats(b)
+
+    def test_reset_clears(self):
+        t = Table([icol(np.arange(10))], ["k"])
+        s1 = S.table_stats(t)
+        S.reset()
+        assert S.table_stats(t) is not s1
+
+    def test_memory_bytes_bounded(self, rng):
+        t = Table(
+            [icol(rng.integers(0, 1000, 50000)),
+             fcol(rng.uniform(0, 1, 50000)),
+             icol(rng.integers(0, 5, 50000), dt.INT64)],
+            ["a", "b", "c"],
+        )
+        ts = S.table_stats(t)
+        # sketches are O(bins), independent of the 50k-row table
+        assert 0 < ts.memory_bytes < 16 * 1024
+
+
+# ---------------------------------------------------------------------------
+# knob surface
+# ---------------------------------------------------------------------------
+
+
+class TestKnobs:
+    def test_stats_disabled_no_estimator(self, monkeypatch):
+        monkeypatch.setenv("SRJT_STATS_ENABLED", "0")
+        t = Table([icol(np.arange(10))], ["k"])
+        assert S.make_estimator({"t": t}) is None
+
+    def test_histogram_bins_knob(self, monkeypatch):
+        monkeypatch.setenv("SRJT_STATS_HISTOGRAM_BINS", "4")
+        t = Table([icol(np.arange(1000))], ["k"])
+        assert len(S.table_stats(t).sketch("k").edges) == 5
+
+    def test_max_rows_knob_forces_sampling(self, monkeypatch, rng):
+        monkeypatch.setenv("SRJT_STATS_MAX_ROWS", "256")
+        t = Table([icol(rng.permutation(2048))], ["k"])
+        assert not S.table_stats(t).sketch("k").unique
