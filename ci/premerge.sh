@@ -212,6 +212,47 @@ print(f"ooc tier: {len(rows)} degraded runs ({spills} spills, "
       "-> artifacts/bench_ooc.jsonl")
 EOF
 
+# restart tier (srjt-durable, ISSUE 20): a child coordinator serves a
+# journaled mixed-plan storm (journal + spill manifests + durable OOC
+# checkpoints armed against shared dirs), checkpoints two of four OOC
+# partitions, arms ci/chaos_restart.json — the next manifest write and
+# the next journal append TORN mid-frame, what a kill -9 racing the
+# disk produces — and SIGKILLs itself mid-storm. The recovered process
+# (the bench parent) must replay the journal past the torn tail,
+# answer every DONE query from its recorded digest (verified against
+# a recomputed oracle's bits, zero re-executions), refuse to invent
+# the torn submission, resubmit the surviving incomplete query through
+# the rebind path, and resume the OOC query past the re-attached
+# checkpoints — ooc.partition_resumes crossing PROCESSES. The artifact
+# gate re-asserts the row's own verdict: replays/reattached/resumes
+# all nonzero, a truncated record, manifest rot counted on the torn
+# sidecar, zero duplicate executions, bit-identical throughout.
+rm -f artifacts/restart_metrics.jsonl
+timeout -k 10 900 env JAX_PLATFORMS=cpu SRJT_LOCKDEP=1 \
+  SRJT_METRICS_ENABLED=1 \
+  SRJT_RESULTS=artifacts/restart_metrics.jsonl \
+  python benchmarks/bench_restart.py
+python - <<'EOF'
+import json
+rows = [json.loads(s) for s in open("artifacts/restart_metrics.jsonl")
+        if s.strip()]
+row = next(r for r in rows if r.get("metric") == "restart_recovery")
+assert row["bit_identical"], "restart tier recovered a wrong answer"
+assert row["replays"] > 0, "recovered process never replayed the journal"
+assert row["truncated_records"] > 0, "the torn journal tail never landed"
+assert row["reattached"] > 0, "no checkpoint re-attached across the restart"
+assert row["resumes"] > 0, "no cross-process partition resume recorded"
+assert row["manifest_rot"] > 0, "the torn manifest was never caught"
+assert row["duplicate_executions"] == 0, (
+    f"{row['duplicate_executions']} DONE queries re-executed after restart")
+assert row["recovered_resubmits"] > 0, "incomplete work never resubmitted"
+print(f"restart tier: {row['replayed_records']} records replayed "
+      f"({row['truncated_records']} truncated), {row['reattached']} "
+      f"checkpoints re-attached, {row['resumes']} partition resumes, "
+      f"{row['idempotent_hits']} digest answers, 0 duplicate executions "
+      "-> artifacts/restart_metrics.jsonl")
+EOF
+
 # crash-storm tier (ISSUE 5): the full sidecar-pool + integrity suite
 # with the crash/corrupt chaos profile armed INSIDE real workers — a
 # pool of 2 survives kill -9 mid-query (failover + arena re-hydration)

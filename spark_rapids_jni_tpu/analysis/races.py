@@ -76,6 +76,11 @@ __all__ = ["run", "scan_source", "main", "RACE_GOVERNED"]
 # exactly the substrate PRs 4-9 built — everything with a lock worth
 # proving
 RACE_GOVERNED = (
+    # srjt-durable (ISSUE 20): serve/journal.py (the QueryJournal
+    # _lock serializing append/replay against scheduler worker
+    # threads) and memgov/persist.py (manifest writes under the
+    # catalog lock, the startup re-attach scan) ride these two
+    # prefixes — no new entries needed
     "serve/",
     "sidecar_pool.py",
     "sidecar.py",

@@ -170,7 +170,15 @@ def catalog() -> BufferCatalog:
     if _catalog is None:
         with _lock:
             if _catalog is None:
-                _catalog = BufferCatalog()
+                cat = BufferCatalog()
+                # srjt-durable (ISSUE 20): with manifests armed, a fresh
+                # catalog re-attaches surviving spill files from dead
+                # owners and GCs the unidentifiable rest. startup()
+                # never raises (counted memgov.persist_startup_failures)
+                from . import persist
+                if persist.manifests_enabled():
+                    persist.startup(cat)
+                _catalog = cat
     return _catalog
 
 

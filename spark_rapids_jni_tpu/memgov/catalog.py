@@ -266,6 +266,8 @@ class BufferCatalog:
                 os.unlink(h._disk_path)
             except OSError:
                 pass
+            from . import persist
+            persist.remove_manifest(h._disk_path)
             h._disk_path = None
 
     # -- accounting ----------------------------------------------------------
@@ -424,6 +426,15 @@ class BufferCatalog:
             f.write(blob)
         h._disk_path = path
         h._host = None
+        # srjt-durable (ISSUE 20): a sidecar manifest makes the spill
+        # file survivable — a fresh process re-registers it instead of
+        # GC'ing an unidentifiable .frm. Write failure degrades to
+        # today's volatile posture (counted), never fails the demotion.
+        from . import persist
+        if persist.manifests_enabled():
+            persist.write_manifest(
+                path, h.key, h.kind, h.nbytes, h._n_leaves, h._treedef
+            )
         reg.counter("memgov.disk_spills").inc()
         reg.counter("memgov.disk_spilled_bytes").inc(h.nbytes)
         reg.histogram("memgov.spill_us").record((time.perf_counter() - t0) * 1e6)
@@ -566,6 +577,8 @@ class BufferCatalog:
             os.unlink(path)
         except OSError:
             pass
+        from . import persist
+        persist.remove_manifest(path)
         h._disk_path = None
 
     def _get(self, h: SpillableHandle):

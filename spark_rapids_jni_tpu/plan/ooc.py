@@ -402,7 +402,19 @@ class OutOfCorePlan:
             # deliberate drop: a later rebuild (e.g. for a rotted
             # checkpoint, counted at the merge site) is not a new hole
             built_inputs.discard(i)
-            demote(ckpt)
+            # srjt-durable (ISSUE 20): force the checkpoint all the way
+            # to the DISK tier so its manifest survives a coordinator
+            # kill -9 — a restarted process re-attaches it and the
+            # resume fast path below fires ACROSS processes. Same
+            # best-effort posture as the plain demotion.
+            from ..utils import knobs
+            if knobs.get_bool("SRJT_OOC_DURABLE_CHECKPOINTS"):
+                try:
+                    ckpt.spill(to_disk=True)
+                except (ValueError, RetryableError, OSError):
+                    pass
+            else:
+                demote(ckpt)
 
         prefetcher: Optional[threading.Thread] = None
         # ONE plan-level admission sized to the per-partition peak for

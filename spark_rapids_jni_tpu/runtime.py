@@ -796,10 +796,16 @@ def stats_report(pretty: bool = False):
     and every live scheduler's tenant/queue snapshot — None until a
     scheduler has ever been created).
 
+    ``durability`` is the crash-recovery tier (ISSUE 20): the query
+    journal's append/replay/truncation/idempotent-hit counters (None
+    until a journal was ever active) and the spill-manifest layer's
+    written/rot/re-attached/orphans-reclaimed counters.
+
     Returns a JSON-serializable dict; ``pretty=True`` returns the
     aligned text rendering (utils/metrics.render_report) instead —
     the one-command artifact VERDICT items 5/7/8 ask for."""
     from . import cache, memgov, serve, sidecar, sidecar_pool
+    from .memgov import persist as _persist  # noqa: F401 (binds memgov.persist)
     from .utils import deadline as deadline_mod
     from .utils import integrity, memory, metrics, retry, trace_sink
 
@@ -821,6 +827,14 @@ def stats_report(pretty: bool = False):
         # ISSUE 17: srjt-cache — plan-cache hit economics, governed
         # subresult footprint, in-flight sharing, knob posture
         "cache": cache.stats_section(),
+        # ISSUE 20: srjt-durable — the journal half is None until a
+        # journal was ever active this process; the persist half is
+        # registry-direct (zeros) so the sweep/re-attach counters
+        # answer even when manifests never armed
+        "durability": {
+            "journal": serve.journal.stats_section(),
+            "persist": memgov.persist.stats_counters(),
+        },
         "integrity": integrity.stats_section(),
         "deadline": {
             "default_budget_s": deadline_mod.default_budget(),

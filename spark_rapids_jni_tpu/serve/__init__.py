@@ -27,8 +27,18 @@ data-movement-bounded executors the same way; PAPERS.md):
 p50/p99/p999 for a mixed TPC q1/q6/q98 workload at fixed offered load,
 plus a chaos tier (crash + hang + reject storm while serving) that
 ``ci/premerge.sh`` gates on zero wrong answers.
+
+srjt-durable (ISSUE 20) adds **crash recoverability**: with
+``SRJT_JOURNAL_DIR`` set, every admitted query is journaled (fsync'd,
+CRC-framed) BEFORE its handle returns, state transitions are recorded
+after-the-fact, and a restarted coordinator replays the journal —
+answering duplicate idempotency keys from the recorded digest and
+resubmitting journaled-but-incomplete work through
+``journal.recover()``. See ``journal.py``.
 """
 
+from . import journal
+from .journal import DigestAnswer, recover
 from .scheduler import (
     QueryHandle,
     Scheduler,
@@ -42,9 +52,12 @@ from .scheduler import (
 )
 
 __all__ = [
+    "DigestAnswer",
     "QueryHandle",
     "Scheduler",
     "SHED_CAUSES",
+    "journal",
+    "recover",
     "leak_report",
     "live_scheduler_count",
     "scheduler",

@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -136,6 +137,7 @@ class QueryHandle:
         "query_id", "_memory_bytes", "host_eligible", "_token", "_done",
         "_state", "_result", "_exc", "_t_submit", "_t_deadline",
         "_t_dispatch", "_budget_s", "_trace", "_predicted_cost_s",
+        "_jid",
     )
 
     def __init__(self, scheduler, fn, args, kwargs, tenant, priority,
@@ -164,6 +166,10 @@ class QueryHandle:
         # None for uncached/never-run plans — the forecast controller's
         # per-query input
         self._predicted_cost_s: Optional[float] = None
+        # durable-journal id (srjt-durable, ISSUE 20): set under the
+        # admission lock when the journal is armed, None otherwise —
+        # the one-attribute-read gate every state-transition write pays
+        self._jid: Optional[str] = None
 
     # -- the public surface --------------------------------------------------
 
@@ -407,6 +413,8 @@ class Scheduler:
         memory_bytes: Optional[int] = None,
         host_eligible: bool = True,
         weight: Optional[float] = None,
+        idempotency_key: Optional[str] = None,
+        recovered: bool = False,
         **kwargs,
     ) -> QueryHandle:
         """Admit one query (a callable or a CompiledPipeline — anything
@@ -418,7 +426,15 @@ class Scheduler:
         rejected on arrival. ``memory_bytes`` pre-admits the whole
         query's footprint with the memory governor when it is armed
         (inner op boundaries then skip their own admission, the
-        standard nesting discipline)."""
+        standard nesting discipline).
+
+        srjt-durable (ISSUE 20): with ``SRJT_JOURNAL_DIR`` armed, a
+        client-supplied ``idempotency_key`` whose journaled twin
+        already reached DONE returns a pre-completed handle carrying a
+        ``journal.DigestAnswer`` (no re-execution); otherwise the
+        admitted query's submit record is fsync'd to the journal before
+        this method returns. ``recovered=True`` marks a recovery
+        resubmission — the trace ring annotates the restart seam."""
         plan_node = None
         if not callable(fn):
             # srjt-plan (ISSUE 14): a logical-plan node is submittable
@@ -449,6 +465,40 @@ class Scheduler:
         qt = tracing.start_trace(
             "serve.query", tenant=tenant, priority=int(priority)
         )
+        # srjt-durable (ISSUE 20): one env read when the journal is off
+        # — the whole cost of the volatile posture
+        from . import journal as journal_mod
+
+        jrn = journal_mod.active()
+        if recovered and qt is not None:
+            # the restart seam: explain_last() on a resumed query shows
+            # where the pre-crash lifetime ended and this one began
+            qt.annotate(recovery=True)
+        if idempotency_key is not None and jrn is not None:
+            hit = jrn.done_digest(idempotency_key)
+            if hit is not None:
+                # the original completed before the crash: answer by
+                # journaled digest, never re-execute DONE work
+                jid, digest = hit
+                self._reg().counter("journal.idempotent_hits").inc()
+                with self._cond:
+                    qid = next(self._ids)
+                q = QueryHandle(self, None, (), {}, tenant, priority,
+                                None, None, host_eligible, qid,
+                                self._clock())
+                q._state = S_DONE
+                q._result = journal_mod.DigestAnswer(
+                    idempotency_key, digest, jid
+                )
+                q._done.set()
+                metrics.event(
+                    "serve.idempotent_hit", query=qid, tenant=tenant,
+                    idem=idempotency_key, jid=jid,
+                )
+                if qt is not None:
+                    qt.annotate(idempotent_hit=True, jid=jid)
+                    qt.finish("ok")
+                return q
         # deterministic shed chaos: the `reject` kind keyed serve.admit
         try:
             faultinj.maybe_inject("serve.admit")
@@ -630,6 +680,13 @@ class Scheduler:
                         # in-lock-safe; trace I/O stays outside)
                         qt.annotate(query=q.query_id, budget_s=eff)
                         q._trace = qt
+                    if jrn is not None:
+                        # the jid is published BEFORE the handle becomes
+                        # dispatchable (string assignment only — journal
+                        # I/O stays outside the lock): a slot that runs
+                        # the query immediately must see it, or its
+                        # DISPATCHED record would be lost
+                        q._jid = f"{os.getpid()}-{q.query_id}"
                     t.q.append(q)
                     t.submitted += 1
                     self._queued += 1
@@ -644,11 +701,45 @@ class Scheduler:
         if victim is not None:
             self._shed_event(victim.tenant, victim_cause)
             _shed_trace(victim._trace, victim_cause)
+            # an admitted-then-evicted query's lifecycle closes in the
+            # journal too (outside the lock, before its waiters wake)
+            self._journal_state(victim, S_SHED, cause=victim_cause)
             victim._done.set()
         if shed_exc is not None:
             self._shed_event(tenant, shed_exc.cause)
             _shed_trace(qt, shed_exc.cause)
             raise shed_exc
+        if q._jid is not None:
+            # the durable submit record, fsync'd BEFORE the handle is
+            # returned: a coordinator that dies after this point can
+            # replay the query; one that dies before it never handed
+            # out a handle. Submit-time sheds above never journal —
+            # they were never admitted.
+            rec: Dict[str, Any] = {
+                "jid": q._jid, "tenant": tenant,
+                "priority": int(priority), "deadline_s": eff,
+                "memory_bytes": memory_bytes,
+                "host_eligible": bool(host_eligible),
+            }
+            if idempotency_key is not None:
+                rec["idem"] = idempotency_key
+            if recovered:
+                rec["recovered"] = True
+            bindings = None
+            if plan_node is not None:
+                from ..plan.rewrites import parameterized_fingerprint
+
+                pf = parameterized_fingerprint(plan_node)
+                bindings = journal_mod.sanitize_bindings(pf.bindings)
+                if bindings is not None:
+                    rec["pf"] = pf.key
+                    rec["bindings"] = bindings
+            if bindings is None:
+                # plain callables (and plans with unslottable literals)
+                # journal opaque: the lifecycle and idempotency index
+                # still replay; recovery skips the resubmit
+                rec["opaque"] = True
+            jrn.append_submit(rec)
         metrics.event(
             "serve.submit", query=q.query_id, tenant=tenant,
             priority=priority, budget_s=eff,
@@ -734,6 +825,28 @@ class Scheduler:
         self._count_shed(cause)
         return victim
 
+    def _journal_state(self, q: QueryHandle, state: str,
+                       result: Any = None, cause: Optional[str] = None,
+                       ) -> None:
+        """Append one state-transition record for an admitted query
+        (srjt-durable, ISSUE 20). One attribute read when the journal is
+        off (``_jid`` is None); always called strictly OUTSIDE the
+        dispatch lock — journal appends are fsync'd file I/O, governed
+        by the same rule as every event write. A DONE record carries the
+        result digest so a restarted coordinator answers the query's
+        idempotency key without re-running it."""
+        if q._jid is None:
+            return
+        from . import journal as journal_mod
+
+        jrn = journal_mod.active()
+        if jrn is None:
+            return
+        digest = None
+        if state == S_DONE:
+            digest = journal_mod.result_digest(result)
+        jrn.append_state(q._jid, state, digest=digest, cause=cause)
+
     # -- completion bookkeeping ----------------------------------------------
 
     def _finish_locked(self, q: QueryHandle, state: str,
@@ -792,6 +905,11 @@ class Scheduler:
             "serve.done", query=q.query_id, tenant=q.tenant, state=state,
             cls=None if exc is None else type(exc).__name__,
         )
+        # durable terminal record BEFORE waiters wake: a result() that
+        # returned implies the DONE digest is already journaled, so a
+        # crash after the client read its answer still answers the
+        # idempotency key by digest on restart
+        self._journal_state(q, state, result=result)
         q._done.set()
 
     def _cancel(self, q: QueryHandle, reason: str) -> bool:
@@ -829,6 +947,7 @@ class Scheduler:
             if qt is not None:
                 qt.annotate(cancel_reason=reason)
                 qt.finish("cancelled")
+            self._journal_state(q, S_CANCELLED, cause=reason)
             q._done.set()
         return True
 
@@ -871,6 +990,7 @@ class Scheduler:
                 if e._trace is not None:
                     e._trace.annotate(expired_in_queue=True)
                     e._trace.finish("expired")
+                self._journal_state(e, S_EXPIRED)
                 e._done.set()
             if q is None:
                 if exiting:
@@ -968,6 +1088,11 @@ class Scheduler:
             "serve.dispatch", query=q.query_id, tenant=q.tenant,
             wait_us=round((q._t_dispatch - q._t_submit) * 1e6, 1),
         )
+        # DISPATCHED is journaled after-the-fact (the slot thread,
+        # outside the dispatch lock): replay distinguishes queued-only
+        # work from work that may have partially executed — both
+        # resubmit, but the seam is visible in the replayed lifecycle
+        self._journal_state(q, "dispatched")
         budget = None
         if q._t_deadline is not None:
             # remaining after the queue wait; an expiry between pop and
@@ -1031,6 +1156,7 @@ class Scheduler:
         for q in shed_queued:  # event I/O + wakeups outside the lock
             self._shed_event(q.tenant, "shutting_down")
             _shed_trace(q._trace, "shutting_down")
+            self._journal_state(q, S_SHED, cause="shutting_down")
             q._done.set()
         t_end = None if timeout_s is None else time.monotonic() + timeout_s
         for w in self._workers:

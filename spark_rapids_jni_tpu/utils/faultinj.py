@@ -59,7 +59,13 @@ sit. Feature parity:
   crosses before its socket connect) with an ``@r<N>`` rank tag to
   partition exactly one rank: the client-side UNAVAILABLE
   classification and the cluster liveness/recovery machinery see
-  precisely what a real network partition produces),
+  precisely what a real network partition produces), ``torn_write``
+  (truncates a durable record mid-write — inert under
+  ``maybe_inject``, it fires only through ``maybe_torn(op, data)``,
+  the hook the query journal (``journal.append``) and spill-manifest
+  writer (``memgov.manifest``) cross on every record; ``delayMs``
+  carries the bytes kept, so replay-past-torn-tail is
+  deterministically testable),
 - ``percent`` probability + ``interceptionCount`` budget (:255-315),
 - per-rule SCHEDULING so chaos tests hit backoff/timeout paths
   deterministically: ``after`` skips the first N matching dispatches
@@ -104,6 +110,7 @@ __all__ = [
     "disable",
     "maybe_inject",
     "maybe_corrupt",
+    "maybe_torn",
     "is_enabled",
     "CacheEvictInjected",
 ]
@@ -166,7 +173,7 @@ def _parse(cfg: dict) -> None:
         kind = spec.get("type", "retryable")
         if kind not in ("fatal", "retryable", "exception", "delay", "hang",
                         "spill_fail", "crash", "corrupt", "reject",
-                        "netsplit", "cache_evict"):
+                        "netsplit", "cache_evict", "torn_write"):
             raise ValueError(f"faultinj: unknown fault type {kind!r}")
         percent = float(spec.get("percent", 100))
         budget = spec.get("interceptionCount")
@@ -268,19 +275,25 @@ def _resolve_rule_locked(op_name: str) -> Optional[_Rule]:
     return _state.rules.get("*")
 
 
-def _draw_locked(op_name: str, corrupt: bool):
-    """Locked half of fault arming shared by ``maybe_inject`` and
-    ``maybe_corrupt``: resolve the rule, run the `after`/`ramp`/budget
-    scheduling, draw the RNG, and return (kind, delay_ms) when the rule
-    fires, else None. ``corrupt`` selects which rule family this call
-    site services — a ``corrupt`` rule never burns scheduling state or
-    budget on a ``maybe_inject`` dispatch (its choke point is the
-    payload producer), and vice versa."""
+# rule families: each producer-side hook services only its own kinds,
+# so a ``corrupt`` (or ``torn_write``) rule never burns scheduling
+# state or budget on a ``maybe_inject`` dispatch — its choke point is
+# the payload producer — and vice versa
+_PRODUCER_FAMILIES = {"corrupt": "corrupt", "torn_write": "torn_write"}
+
+
+def _draw_locked(op_name: str, family: str):
+    """Locked half of fault arming shared by ``maybe_inject``,
+    ``maybe_corrupt``, and ``maybe_torn``: resolve the rule, run the
+    `after`/`ramp`/budget scheduling, draw the RNG, and return
+    (kind, delay_ms) when the rule fires, else None. ``family`` selects
+    which rule family this call site services ("inject", "corrupt", or
+    "torn_write")."""
     _reload_if_changed()
     rule = _resolve_rule_locked(op_name)
     if rule is None:
         return None
-    if (rule.kind == "corrupt") != corrupt:
+    if _PRODUCER_FAMILIES.get(rule.kind, "inject") != family:
         return None
     if rule.budget is not None and rule.budget <= 0:
         return None
@@ -311,7 +324,7 @@ def maybe_inject(op_name: str) -> None:
     if not _state.enabled:
         return
     with _state.lock:
-        hit = _draw_locked(op_name, corrupt=False)
+        hit = _draw_locked(op_name, family="inject")
         if hit is None:
             return
         kind, delay_ms = hit
@@ -414,7 +427,7 @@ def maybe_corrupt(op_name: str, data: bytes) -> bytes:
     if not _state.enabled or not data:
         return data
     with _state.lock:
-        hit = _draw_locked(op_name, corrupt=True)
+        hit = _draw_locked(op_name, family="corrupt")
         if hit is None:
             return data
         # up to 8 contiguous bytes XOR 0xFF at a seeded offset: enough
@@ -427,6 +440,34 @@ def maybe_corrupt(op_name: str, data: bytes) -> bytes:
 
     metrics.event("faultinj.corrupt", op=op_name, offset=off, nbytes=len(data))
     return bytes(buf)
+
+
+def maybe_torn(op_name: str, data: bytes) -> bytes:
+    """Chaos hook for durable-write producers (srjt-durable, ISSUE 20):
+    when a matched ``torn_write`` rule fires, return a PREFIX of
+    ``data`` — modeling the process dying (or the disk filling) mid
+    ``write(2)``, the failure journal/manifest replay must truncate
+    past. Key it ``journal.append`` (the query journal crosses it on
+    every record) or ``memgov.manifest`` (the spill-manifest writer).
+    ``delayMs`` carries the bytes KEPT when positive (clamped to
+    len-1 so the tear is never a no-op); otherwise half the record is
+    kept. Honors the same `after`/`ramp`/budget scheduling as every
+    other kind. Returns ``data`` unchanged when disabled, unmatched,
+    or too short to tear."""
+    if not _state.enabled or len(data) < 2:
+        return data
+    with _state.lock:
+        hit = _draw_locked(op_name, family="torn_write")
+        if hit is None:
+            return data
+        _kind, delay_ms = hit
+    keep = int(delay_ms) if delay_ms > 0 else len(data) // 2
+    keep = max(1, min(keep, len(data) - 1))
+    from . import metrics
+
+    metrics.event("faultinj.torn_write", op=op_name, kept=keep,
+                  nbytes=len(data))
+    return data[:keep]
 
 
 # env-var activation, like CUDA_INJECTION64_PATH + FAULT_INJECTOR_CONFIG_PATH.

@@ -542,6 +542,34 @@ declare("SRJT_SERVE_FORECAST_BUDGET_SEC", "float", 0.0,
         "scheduler accepts before shedding with "
         "Overloaded(cause=\"forecast\"); 0 disables the forecaster")
 
+# crash-recoverable serving: durable query journal + spill/checkpoint
+# re-attach (serve/journal.py, memgov/persist.py, ISSUE 20)
+declare("SRJT_JOURNAL_DIR", "str", None,
+        "arm the durable query journal: serve.submit appends an "
+        "fsync'd CRC-framed record per admitted query (and its state "
+        "transitions) to segmented logs under this directory; a "
+        "restarted coordinator replays it to answer DONE work by "
+        "digest and resubmit incomplete work (unset: today's "
+        "volatile posture — zero new files, no fsync on submit)")
+declare("SRJT_JOURNAL_SEGMENT_BYTES", "int", 4 * 1024 * 1024,
+        "journal segment roll threshold: an append that would push "
+        "the active segment past this many bytes opens a new one",
+        minimum=4096)
+declare("SRJT_JOURNAL_FSYNC", "bool", True,
+        "0 skips the per-append fsync (crash window widens to the OS "
+        "page cache; replay still truncates any torn tail)")
+declare("SRJT_SPILL_MANIFESTS", "bool", False,
+        "arm durable spill metadata: every disk-tier spill/checkpoint "
+        "frame gains a CRC-framed sidecar manifest, a fresh process "
+        "re-attaches surviving entries into its catalog "
+        "(memgov.reattached) and a startup sweep reclaims frames "
+        "owned by a provably-dead PID (memgov.orphans_reclaimed)")
+declare("SRJT_OOC_DURABLE_CHECKPOINTS", "bool", False,
+        "force every completed out-of-core partition checkpoint to "
+        "the disk tier at registration (with SRJT_SPILL_MANIFESTS "
+        "this is what a restarted coordinator resumes past; off, "
+        "checkpoints demote to host and die with the process)")
+
 # Pallas kernel tier (ops/pallas_kernels.py, ISSUE 13)
 declare("SRJT_PALLAS_JOIN", "bool", True,
         "arm the paged-hash-table Pallas join tier for single int-key "

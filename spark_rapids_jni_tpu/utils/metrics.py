@@ -797,6 +797,27 @@ def stage_report(stage: str) -> dict:
                           + _REGISTRY.value("cache.sub_evictions")),
             "evict_injected": _REGISTRY.value("cache.evict_injected"),
         },
+        # ISSUE 20 durability counters: journal append/replay volume,
+        # manifest re-attach, and orphan reclamation — the restart-tier
+        # artifacts assert replays/reattached/resumes > 0 from exactly
+        # these
+        "durability": {
+            "journal_appends": _REGISTRY.value("journal.appends"),
+            "journal_append_failures": _REGISTRY.value(
+                "journal.append_failures"),
+            "journal_replays": _REGISTRY.value("journal.replays"),
+            "journal_replayed_records": _REGISTRY.value(
+                "journal.replayed_records"),
+            "journal_truncated_records": _REGISTRY.value(
+                "journal.truncated_records"),
+            "idempotent_hits": _REGISTRY.value("journal.idempotent_hits"),
+            "recovered_resubmits": _REGISTRY.value(
+                "journal.recovered_resubmits"),
+            "manifests_written": _REGISTRY.value("memgov.manifests_written"),
+            "reattached": _REGISTRY.value("memgov.reattached"),
+            "orphans_reclaimed": _REGISTRY.value("memgov.orphans_reclaimed"),
+            "partition_resumes": _REGISTRY.value("ooc.partition_resumes"),
+        },
     }
 
 

@@ -78,6 +78,40 @@ def _assert_no_scheduler_thread_leak():
 
 
 @pytest.fixture(autouse=True, scope="session")
+def _assert_no_spill_file_leak():
+    """ISSUE 20 leak tripwire (the spill-file lifecycle satellite):
+    the test session must leave the spill dir empty — every disk-spilled
+    frame (and its durable manifest sidecar) written during the session
+    is unlinked by catalog close/unregister/re-materialization by
+    session end. A surviving .frm is leaked disk bytes no process will
+    reclaim until the next manifest-armed startup sweep. Lazy
+    sys.modules lookup: runs only when the suite touched memgov."""
+    yield
+    import glob as _glob
+    import sys as _sys
+    import tempfile as _tempfile
+
+    memgov_mod = _sys.modules.get("spark_rapids_jni_tpu.memgov")
+    if memgov_mod is None:
+        return
+    # close any surviving catalog first: its own teardown is the
+    # mechanism under test, not the tripwire's job to replicate
+    memgov_mod.reset()
+    dirs = {os.path.join(_tempfile.gettempdir(), f"srjt-spill-{os.getpid()}")}
+    spill_dir = os.environ.get("SRJT_SPILL_DIR")  # srjt-lint: allow-environ(session-teardown tripwire: knobs may already be monkeypatch-reverted; the raw env var is exactly what the CI tier armed)
+    if spill_dir:
+        dirs.add(spill_dir)
+    leaked = []
+    for d in dirs:
+        leaked += _glob.glob(os.path.join(d, "*.frm"))
+        leaked += _glob.glob(os.path.join(d, "*.mf"))
+    assert not leaked, (
+        f"{len(leaked)} spill file(s) leaked past session teardown: "
+        f"{sorted(leaked)[:10]}"
+    )
+
+
+@pytest.fixture(autouse=True, scope="session")
 def _assert_no_partition_entry_leak():
     """ISSUE 18 leak tripwire (mirrors the slab/scheduler checks): every
     out-of-core partition catalog entry (kind="partition") registered
@@ -243,6 +277,12 @@ _SLOW_TESTS = {
     "test_plan_queries.py::TestCboCampaign::test_q35_state_demo_stats_match_oracle",
     "test_plan_queries.py::TestCboCampaign::test_q39_std_over_mean_matches_oracle",
     "test_ooc.py::TestCostModelPartitions::test_model_chosen_k_overhead_bounded",
+    # srjt-durable (ISSUE 20): the kill -9 acceptance spawns a child
+    # coordinator (jax import + two plan compiles) and SIGKILLs it;
+    # ci/premerge.sh covers the restart posture in the dedicated
+    # restart tier (bench_restart-driven), nightly runs this too
+    "test_durable.py::TestKillNineAcceptance::"
+    "test_restart_answers_journaled_queries_bit_identical",
 }
 
 
